@@ -1,0 +1,27 @@
+"""openr_tpu — a TPU-native rebuild of Open/R (distributed link-state routing).
+
+Open/R (reference: fredxia/openr, a fork of facebook/openr) is a link-state
+interior routing platform: nodes discover neighbors (Spark), flood adjacency
+and prefix state through an eventually-consistent replicated KV store
+(KvStore), compute shortest-path routes (Decision/SpfSolver), and program
+them into the forwarding plane (Fib → FibService).
+
+This rebuild keeps the same module graph and capability surface but is
+designed TPU-first:
+
+- The Decision hot path (all the SPF / ECMP / KSP / LFA compute) is a batched
+  JAX program over a padded CSR link-state database resident in HBM, sharded
+  across TPU cores by SPF source node with ``jax.sharding`` + ``shard_map``.
+- The control plane (Spark, KvStore flooding, LinkMonitor, PrefixManager,
+  Fib) is host-side asyncio message-passing — the moral equivalent of the
+  reference's one-``OpenrEventBase``-thread-per-module design
+  (reference: openr/common/OpenrEventBase.* †, openr/messaging/ †).
+- Native C++ is used for the LSDB/merge/graph-build runtime core
+  (``native/``), bound via ctypes.
+
+The dagger † in docstring citations marks upstream facebook/openr paths: the
+reference mount was empty at survey time (see SURVEY.md §0), so citations are
+path-level into the upstream tree layout, not file:line.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Common runtime substrate (reference: openr/common/ †)."""

@@ -1,0 +1,96 @@
+"""Protocol constants and key conventions.
+
+Equivalent of the reference's single constants header
+(reference: openr/common/Constants.h † — all timer defaults, key prefixes,
+port numbers live in one place there too).
+"""
+
+from __future__ import annotations
+
+# ---- KvStore key conventions (reference: Constants.h † kAdjDbMarker,
+# kPrefixDbMarker) -----------------------------------------------------------
+ADJ_DB_MARKER = "adj:"
+PREFIX_DB_MARKER = "prefix:"
+KEY_DELIMITER = ":"
+
+# ---- Default ports (reference: Constants.h † kOpenrCtrlPort etc.) ----------
+CTRL_PORT = 2018  # OpenrCtrl thrift port upstream; our ctrl RPC port
+KVSTORE_PORT = 2019  # our KvStore peer TCP port (upstream shares ctrl port)
+SPARK_MCAST_PORT = 6666  # Spark UDP port (upstream kSparkMcastPort)
+
+# ---- Spark timers, ms (reference: SparkConfig in OpenrConfig.thrift †) -----
+SPARK_HELLO_INTERVAL_MS = 500
+SPARK_FASTINIT_HELLO_INTERVAL_MS = 100
+SPARK_HANDSHAKE_INTERVAL_MS = 500
+SPARK_HEARTBEAT_INTERVAL_MS = 500
+SPARK_HOLD_TIME_MS = 2_000
+SPARK_GR_HOLD_TIME_MS = 30_000
+
+# ---- KvStore (reference: KvstoreConfig †) ----------------------------------
+KVSTORE_DEFAULT_TTL_MS = 300_000  # key_ttl_ms
+KVSTORE_TTL_DECREMENT_MS = 1  # min decrement applied when flooding
+KVSTORE_SYNC_INTERVAL_S = 60  # anti-entropy full-sync cadence
+KVSTORE_FLOOD_RATE_MSGS_PER_SEC = 600
+KVSTORE_FLOOD_RATE_BURST = 300
+TTL_REFRESH_FRACTION = 0.25  # originator refreshes at ttl * fraction left
+
+# ---- Decision debounce (reference: DecisionConfig † debounce_min/max_ms) ---
+DECISION_DEBOUNCE_MIN_MS = 10
+DECISION_DEBOUNCE_MAX_MS = 250
+
+# ---- LinkMonitor (reference: LinkMonitorConfig †) --------------------------
+LINK_FLAP_INITIAL_BACKOFF_MS = 60
+LINK_FLAP_MAX_BACKOFF_MS = 300_000
+ADJACENCY_THROTTLE_MS = 1_000
+
+# ---- Fib (reference: openr/fib/Fib.cpp † retry constants) ------------------
+FIB_INITIAL_RETRY_MS = 8
+FIB_MAX_RETRY_MS = 4_096
+FIB_SYNC_INTERVAL_S = 60
+
+# ---- SR-MPLS label spaces (reference: Constants.h † label ranges) ----------
+MPLS_LABEL_MIN = 16
+MPLS_LABEL_MAX = (1 << 20) - 1
+SR_GLOBAL_RANGE = (101, 49_999)  # node segment labels
+SR_LOCAL_RANGE = (50_000, 59_999)  # adjacency labels
+
+# ---- Misc ------------------------------------------------------------------
+DEFAULT_AREA = "0"
+OVERLOAD_METRIC = 1 << 30  # soft-drain path cost; fits i32 sums in i64 math
+INT_MAX_METRIC = (1 << 31) - 1
+
+# ---- Watchdog (reference: openr/watchdog/Watchdog.cpp †) -------------------
+WATCHDOG_INTERVAL_S = 20
+WATCHDOG_THREAD_TIMEOUT_S = 300
+
+
+def adj_key(node: str) -> str:
+    """`adj:<node>` (reference: LinkMonitor advertiseAdjacencies †)."""
+    return f"{ADJ_DB_MARKER}{node}"
+
+
+def prefix_key(node: str, area: str, prefix: str) -> str:
+    """Per-prefix key `prefix:<node>:<area>:[<prefix>]`
+    (reference: openr/common/LsdbUtil † createPrefixKey)."""
+    return f"{PREFIX_DB_MARKER}{node}{KEY_DELIMITER}{area}{KEY_DELIMITER}[{prefix}]"
+
+
+def parse_adj_key(key: str) -> str | None:
+    """Return node name if `key` is an adj key, else None."""
+    if key.startswith(ADJ_DB_MARKER):
+        return key[len(ADJ_DB_MARKER):]
+    return None
+
+
+def parse_prefix_key(key: str) -> tuple[str, str, str] | None:
+    """Return (node, area, prefix) if `key` is a per-prefix key, else None."""
+    if not key.startswith(PREFIX_DB_MARKER):
+        return None
+    rest = key[len(PREFIX_DB_MARKER):]
+    try:
+        node, area, bracketed = rest.split(KEY_DELIMITER, 2)
+    except ValueError:
+        return None
+    if bracketed.startswith("[") and bracketed.endswith("]"):
+        return node, area, bracketed[1:-1]
+    return None

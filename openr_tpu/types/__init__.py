@@ -1,0 +1,42 @@
+"""Typed schemas — the equivalent of the reference's Thrift IDL layer.
+
+Mirrors (in spirit, not wire format) the upstream thrift files
+(reference: openr/if/Types.thrift †, KvStore.thrift †, Network.thrift †,
+OpenrCtrl.thrift †). All types are plain dataclasses with a canonical JSON
+wire codec (`to_wire` / `from_wire`) used by KvStore values, RPC, and the
+persistent store. Integer metrics end-to-end (never float) so that RIB
+equivalence with the oracle solver is exact.
+"""
+
+from openr_tpu.types.network import (  # noqa: F401
+    IpPrefix,
+    MplsAction,
+    MplsActionType,
+    MplsRoute,
+    NextHop,
+    UnicastRoute,
+)
+from openr_tpu.types.topology import (  # noqa: F401
+    Adjacency,
+    AdjacencyDatabase,
+    ForwardingAlgorithm,
+    ForwardingType,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+)
+from openr_tpu.types.kvstore import (  # noqa: F401
+    KeyDumpParams,
+    Publication,
+    Value,
+)
+from openr_tpu.types.routes import (  # noqa: F401
+    RibEntry,
+    RibMplsEntry,
+    RouteDatabase,
+    RouteUpdate,
+)
+from openr_tpu.types.serde import (  # noqa: F401
+    from_wire,
+    to_wire,
+)

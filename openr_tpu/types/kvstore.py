@@ -1,0 +1,81 @@
+"""KvStore wire types: versioned values and publications.
+
+Equivalent of the reference's KvStore.thrift (reference: openr/if/
+KvStore.thrift † — Value, Publication, KeyDumpParams, KvStorePeerSpec).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+# TTL sentinel: key never expires (reference: openr/common/Constants.h †
+# kTtlInfinity == INT32_MIN in some versions; we use -1).
+TTL_INFINITY = -1
+
+
+def value_hash(version: int, originator_id: str, value: bytes | None) -> int:
+    """Content hash used as the last conflict-resolution tiebreak and for
+    cheap full-sync comparison (reference: openr/kvstore/KvStore.cpp †
+    generateHash). 63-bit so it stays a non-negative int on any wire.
+    """
+    h = hashlib.blake2b(digest_size=8)
+    h.update(version.to_bytes(8, "big", signed=False))
+    oid = originator_id.encode()
+    h.update(len(oid).to_bytes(4, "big"))  # length prefix: no (id, value)
+    h.update(oid)                          # concatenation collisions
+    if value is not None:
+        h.update(value)
+    return int.from_bytes(h.digest(), "big") >> 1
+
+
+@dataclass
+class Value:
+    """A versioned KvStore value.
+
+    reference: openr/if/KvStore.thrift † Value. `value=None` means
+    "hash-only" (used in full-sync digests and ttl-refresh updates where the
+    payload is omitted). ttl is milliseconds remaining (TTL_INFINITY = never
+    expires); ttl_version increments on every originator refresh so refreshes
+    propagate without version bumps.
+    """
+
+    version: int
+    originator_id: str
+    value: bytes | None = None
+    ttl: int = TTL_INFINITY
+    ttl_version: int = 0
+    hash: int | None = None
+
+    def with_hash(self) -> "Value":
+        if self.hash is None:
+            self.hash = value_hash(self.version, self.originator_id, self.value)
+        return self
+
+
+@dataclass
+class Publication:
+    """A batch of key updates flooded between stores / to subscribers.
+
+    reference: openr/if/KvStore.thrift † Publication.
+    """
+
+    area: str = "0"
+    key_vals: dict[str, Value] = field(default_factory=dict)
+    expired_keys: list[str] = field(default_factory=list)
+    node_ids: list[str] = field(default_factory=list)  # flood loop guard
+    # set on full-sync responses: keys the responder wants from the requester
+    to_be_updated_keys: list[str] | None = None
+
+
+@dataclass
+class KeyDumpParams:
+    """Filter for dump/subscribe operations.
+
+    reference: openr/if/KvStore.thrift † KeyDumpParams.
+    """
+
+    prefix: str = ""  # key-prefix match ("" = all)
+    originator_ids: list[str] = field(default_factory=list)
+    keys: list[str] = field(default_factory=list)
+    ignore_ttl: bool = True

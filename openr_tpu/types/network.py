@@ -1,0 +1,124 @@
+"""Network-layer types: prefixes, nexthops, routes.
+
+Equivalent of the reference's Network.thrift (reference: openr/if/
+Network.thrift † — BinaryAddress, IpPrefix, NextHopThrift, UnicastRoute,
+MplsRoute, MplsAction). Addresses are kept as strings (parsed lazily via
+`ipaddress`) since the emulated dataplane is keyed by node/interface names;
+the netlink platform layer converts to packed binary at the kernel boundary.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+from dataclasses import dataclass
+from functools import cached_property, total_ordering
+
+
+class MplsActionType(enum.IntEnum):
+    """reference: openr/if/Network.thrift † MplsActionCode."""
+
+    PUSH = 0
+    SWAP = 1
+    PHP = 2  # penultimate hop pop
+    POP_AND_LOOKUP = 3
+
+
+@dataclass(frozen=True)
+class MplsAction:
+    action: MplsActionType
+    swap_label: int | None = None
+    push_labels: tuple[int, ...] = ()
+
+
+@total_ordering
+@dataclass(frozen=True)
+class IpPrefix:
+    """A v4/v6 prefix in canonical "net/len" form.
+
+    reference: openr/if/Network.thrift † IpPrefix (BinaryAddress + len).
+    """
+
+    prefix: str  # canonical, e.g. "10.0.0.0/24" or "2001:db8::/64"
+
+    @staticmethod
+    def make(s: str) -> "IpPrefix":
+        net = ipaddress.ip_network(s, strict=False)
+        return IpPrefix(prefix=str(net))
+
+    @cached_property
+    def network(self) -> ipaddress.IPv4Network | ipaddress.IPv6Network:
+        # cached_property writes to __dict__ directly, so it works on a
+        # frozen dataclass; parse happens once per instance, not per access.
+        return ipaddress.ip_network(self.prefix)
+
+    @property
+    def prefix_len(self) -> int:
+        return self.network.prefixlen
+
+    @property
+    def is_v4(self) -> bool:
+        return self.network.version == 4
+
+    def __str__(self) -> str:
+        return self.prefix
+
+    def __lt__(self, other: "IpPrefix") -> bool:
+        return self.prefix < other.prefix
+
+
+@total_ordering
+@dataclass(frozen=True)
+class NextHop:
+    """One nexthop of a route.
+
+    reference: openr/if/Network.thrift † NextHopThrift. In the emulator the
+    address is the neighbor node name; on a real dataplane it is the
+    link-local address of the neighbor on `if_name`. `weight` is the UCMP
+    weight (0 == ECMP, equal split). `mpls_action` carries SR-MPLS
+    push/swap/php for KSP2 and label routes. `area` records which area the
+    path goes through (for multi-area route redistribution).
+    """
+
+    address: str
+    if_name: str = ""
+    metric: int = 0
+    weight: int = 0
+    mpls_action: MplsAction | None = None
+    area: str = ""
+    neighbor_node: str = ""
+
+    def _key(self):
+        return (
+            self.address,
+            self.if_name,
+            self.metric,
+            self.weight,
+            str(self.mpls_action),
+            self.area,
+            self.neighbor_node,
+        )
+
+    def __lt__(self, other: "NextHop") -> bool:
+        return self._key() < other._key()
+
+
+@dataclass(frozen=True)
+class UnicastRoute:
+    """reference: openr/if/Network.thrift † UnicastRoute."""
+
+    dest: IpPrefix
+    nexthops: tuple[NextHop, ...]
+
+
+@dataclass(frozen=True)
+class MplsRoute:
+    """reference: openr/if/Network.thrift † MplsRoute."""
+
+    top_label: int
+    nexthops: tuple[NextHop, ...]
+
+
+def sorted_nexthops(nhs) -> tuple[NextHop, ...]:
+    """Canonical ordering so route equality is set-equality."""
+    return tuple(sorted(nhs))

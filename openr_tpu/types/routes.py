@@ -1,0 +1,105 @@
+"""RIB types: computed routes and route-update deltas.
+
+Equivalent of the reference's Decision output types
+(reference: openr/decision/RibEntry.h †, RouteUpdate.h † —
+RibUnicastEntry, RibMplsEntry, DecisionRouteUpdate).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from openr_tpu.types.network import IpPrefix, MplsRoute, NextHop, UnicastRoute
+from openr_tpu.types.topology import PrefixEntry
+
+
+@dataclass(frozen=True)
+class RibEntry:
+    """A computed unicast route with provenance.
+
+    reference: openr/decision/RibEntry.h † RibUnicastEntry: the winning
+    PrefixEntry (for policy/redistribution), the set of best-advertising
+    nodes, and the ECMP/UCMP nexthop set.
+    """
+
+    prefix: IpPrefix
+    nexthops: tuple[NextHop, ...]
+    best_node: str = ""
+    best_nodes: tuple[str, ...] = ()
+    best_entry: PrefixEntry | None = None
+    igp_cost: int = 0
+
+    def to_unicast_route(self) -> UnicastRoute:
+        return UnicastRoute(dest=self.prefix, nexthops=self.nexthops)
+
+
+@dataclass(frozen=True)
+class RibMplsEntry:
+    """reference: openr/decision/RibEntry.h † RibMplsEntry."""
+
+    label: int
+    nexthops: tuple[NextHop, ...]
+
+    def to_mpls_route(self) -> MplsRoute:
+        return MplsRoute(top_label=self.label, nexthops=self.nexthops)
+
+
+@dataclass
+class RouteDatabase:
+    """Full RIB snapshot (reference: openr/if/Types.thrift † RouteDatabase)."""
+
+    this_node_name: str = ""
+    unicast_routes: dict[IpPrefix, RibEntry] = field(default_factory=dict)
+    mpls_routes: dict[int, RibMplsEntry] = field(default_factory=dict)
+
+
+class RouteUpdateType(enum.IntEnum):
+    INCREMENTAL = 0
+    FULL_SYNC = 1
+
+
+@dataclass
+class RouteUpdate:
+    """Delta between successive RIBs — what Decision emits and Fib consumes.
+
+    reference: openr/decision/RouteUpdate.h † DecisionRouteUpdate
+    (unicastRoutesToUpdate / unicastRoutesToDelete / mplsRoutesToUpdate /
+    mplsRoutesToDelete, type).
+    """
+
+    type: RouteUpdateType = RouteUpdateType.INCREMENTAL
+    unicast_to_update: dict[IpPrefix, RibEntry] = field(default_factory=dict)
+    unicast_to_delete: list[IpPrefix] = field(default_factory=list)
+    mpls_to_update: dict[int, RibMplsEntry] = field(default_factory=dict)
+    mpls_to_delete: list[int] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (
+            self.unicast_to_update
+            or self.unicast_to_delete
+            or self.mpls_to_update
+            or self.mpls_to_delete
+        )
+
+
+def diff_route_dbs(old: RouteDatabase, new: RouteDatabase) -> RouteUpdate:
+    """Compute the delta update turning `old` into `new`.
+
+    reference: openr/decision/Decision.cpp † (Decision computes deltas on
+    rebuildRoutes; Fib re-diffs against programmed state).
+    """
+    upd = RouteUpdate()
+    for prefix, entry in new.unicast_routes.items():
+        if old.unicast_routes.get(prefix) != entry:
+            upd.unicast_to_update[prefix] = entry
+    for prefix in old.unicast_routes:
+        if prefix not in new.unicast_routes:
+            upd.unicast_to_delete.append(prefix)
+    for label, mentry in new.mpls_routes.items():
+        if old.mpls_routes.get(label) != mentry:
+            upd.mpls_to_update[label] = mentry
+    for label in old.mpls_routes:
+        if label not in new.mpls_routes:
+            upd.mpls_to_delete.append(label)
+    return upd

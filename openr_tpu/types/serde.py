@@ -1,0 +1,121 @@
+"""Canonical JSON wire codec for schema dataclasses.
+
+The reference uses fbthrift CompactProtocol for everything on the wire
+(reference: openr/if/ †). We use canonical JSON (sorted keys, no spaces)
+instead: the control plane is small-message gossip where codec speed is not
+the bottleneck, and canonical bytes give us a stable content hash for
+KvStore conflict resolution. The codec is schema-driven off dataclass type
+hints, supports nesting, lists, dicts, enums and Optionals, and is
+versioned by field name (unknown fields are ignored on decode — the same
+forward-compat posture thrift gives the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import types
+import typing
+from typing import Any, Type, TypeVar, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> dict[str, Any]:
+    h = _HINTS_CACHE.get(cls)
+    if h is None:
+        h = get_type_hints(cls)
+        _HINTS_CACHE[cls] = h
+    return h
+
+
+def _encode(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, bytes):
+        return {"__bytes__": obj.hex()}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _encode(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, (list, tuple)):
+        return [_encode(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): _encode(v) for k, v in obj.items()}
+    raise TypeError(f"cannot encode {type(obj)!r}")
+
+
+def _decode(raw: Any, hint: Any) -> Any:
+    if raw is None:
+        return None
+    origin = get_origin(hint)
+    if origin in (typing.Union, types.UnionType):  # Optional[X] and unions
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return _decode(raw, args[0])
+        return raw  # heterogeneous unions: pass through
+    if hint is bytes:
+        if isinstance(raw, dict) and "__bytes__" in raw:
+            return bytes.fromhex(raw["__bytes__"])
+        raise TypeError(f"expected bytes payload, got {raw!r}")
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        return hint(raw)
+    if dataclasses.is_dataclass(hint):
+        hints = _hints(hint)
+        kwargs = {}
+        for f in dataclasses.fields(hint):
+            if f.name in raw:
+                kwargs[f.name] = _decode(raw[f.name], hints[f.name])
+        return hint(**kwargs)
+    if origin in (list, tuple):
+        args = [a for a in get_args(hint) if a is not Ellipsis] or [Any]
+        if origin is tuple and len(args) > 1:  # heterogeneous tuple
+            return tuple(_decode(x, a) for x, a in zip(raw, args))
+        item_hint = args[0]
+        seq = [_decode(x, item_hint) for x in raw]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = get_args(hint)
+        key_hint, val_hint = args if args else (str, Any)
+        return {
+            _decode_key(k, key_hint): _decode(v, val_hint)
+            for k, v in raw.items()
+        }
+    return raw
+
+
+def _decode_key(k: str, hint: Any) -> Any:
+    if hint is int:
+        return int(k)
+    # Frozen single-str-field dataclasses (e.g. IpPrefix) encode as str(obj);
+    # reconstruct from that string so dataclass-keyed dicts round-trip.
+    if dataclasses.is_dataclass(hint):
+        flds = dataclasses.fields(hint)
+        if len(flds) == 1:
+            return hint(**{flds[0].name: k})
+        raise TypeError(f"cannot decode dict key {k!r} as {hint!r}")
+    return k
+
+
+def to_wire(obj: Any) -> bytes:
+    """Serialize a schema dataclass to canonical JSON bytes.
+
+    Canonical: sorted keys, compact separators — equal objects always
+    produce identical bytes, which KvStore hashes for conflict resolution
+    (reference: openr/kvstore/KvStore.cpp † mergeKeyValues hash tiebreak).
+    """
+    return json.dumps(
+        _encode(obj), sort_keys=True, separators=(",", ":")
+    ).encode()
+
+
+def from_wire(data: bytes | str, cls: Type[T]) -> T:
+    """Deserialize canonical JSON bytes into a schema dataclass."""
+    raw = json.loads(data)
+    return _decode(raw, cls)

@@ -1,0 +1,123 @@
+"""Link-state topology types: adjacencies and prefix advertisements.
+
+Equivalent of the reference's Types.thrift core structs
+(reference: openr/if/Types.thrift † — Adjacency, AdjacencyDatabase,
+PrefixEntry, PrefixMetrics, PrefixDatabase). These are the payloads of the
+`adj:<node>` and `prefix:<node>:<area>:<prefix>` KvStore keys and the sole
+inputs to Decision's LSDB.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from openr_tpu.types.network import IpPrefix
+
+
+class ForwardingType(enum.IntEnum):
+    """How packets to this prefix are forwarded.
+
+    reference: openr/if/Types.thrift † PrefixForwardingType.
+    """
+
+    IP = 0
+    SR_MPLS = 1
+
+
+class ForwardingAlgorithm(enum.IntEnum):
+    """Which path algorithm Decision uses for this prefix.
+
+    reference: openr/if/Types.thrift † PrefixForwardingAlgorithm.
+    """
+
+    SP_ECMP = 0
+    KSP2_ED_ECMP = 1  # 2 edge-disjoint shortest paths (SR-MPLS)
+
+
+@dataclass(frozen=True)
+class Adjacency:
+    """One directed adjacency (this node → other node over if_name).
+
+    reference: openr/if/Types.thrift † Adjacency. Integer metric (hop count
+    or RTT-derived) — never float, so path costs are exact. `weight` feeds
+    UCMP; `adj_label` is the SR adjacency segment.
+    """
+
+    other_node_name: str
+    if_name: str
+    metric: int = 1
+    adj_label: int = 0
+    is_overloaded: bool = False  # drain: don't transit this link
+    rtt_us: int = 0
+    weight: int = 1
+    other_if_name: str = ""
+
+
+@dataclass(frozen=True)
+class AdjacencyDatabase:
+    """All adjacencies of one node in one area — the `adj:<node>` value.
+
+    reference: openr/if/Types.thrift † AdjacencyDatabase.
+    """
+
+    this_node_name: str
+    adjacencies: tuple[Adjacency, ...] = ()
+    is_overloaded: bool = False  # node drain: never transit this node
+    node_label: int = 0  # SR node segment label
+    area: str = "0"
+
+
+# Default metric values mirror the reference's best-route preference space
+# (reference: openr/if/Types.thrift † PrefixMetrics defaults: pp=1000,
+# sp=100, distance additive per redistribution hop).
+DEFAULT_PATH_PREFERENCE = 1000
+DEFAULT_SOURCE_PREFERENCE = 100
+
+
+@dataclass(frozen=True)
+class PrefixMetrics:
+    """Best-route selection metrics, compared lexicographically:
+    higher path_preference wins, then higher source_preference, then lower
+    distance (reference: openr/decision/ † BestRouteSelection comment in
+    Types.thrift † PrefixMetrics).
+    """
+
+    path_preference: int = DEFAULT_PATH_PREFERENCE
+    source_preference: int = DEFAULT_SOURCE_PREFERENCE
+    distance: int = 0
+
+
+@dataclass(frozen=True)
+class PrefixEntry:
+    """One advertised prefix — element of the `prefix:` key value.
+
+    reference: openr/if/Types.thrift † PrefixEntry. `weight` is the node's
+    advertised UCMP bandwidth/weight for this prefix; `min_nexthop` drops
+    the route if fewer nexthops survive; `tags` feed policy.
+    """
+
+    prefix: IpPrefix
+    metrics: PrefixMetrics = PrefixMetrics()
+    forwarding_type: ForwardingType = ForwardingType.IP
+    forwarding_algorithm: ForwardingAlgorithm = ForwardingAlgorithm.SP_ECMP
+    tags: tuple[str, ...] = ()
+    area_stack: tuple[str, ...] = ()
+    weight: int = 0
+    min_nexthop: int = 0
+
+
+@dataclass(frozen=True)
+class PrefixDatabase:
+    """Prefixes advertised by one node in one area.
+
+    reference: openr/if/Types.thrift † PrefixDatabase. The reference moved
+    from one monolithic per-node prefix db to per-prefix keys
+    (`prefix:<node>:<area>:<prefix>`); we support both via this type holding
+    one-or-many entries.
+    """
+
+    this_node_name: str
+    prefix_entries: tuple[PrefixEntry, ...] = ()
+    area: str = "0"
+    delete_prefix: bool = False  # per-prefix-key withdrawal marker
