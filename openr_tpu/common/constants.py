@@ -69,9 +69,19 @@ def adj_key(node: str) -> str:
     return f"{ADJ_DB_MARKER}{node}"
 
 
+def validate_name(name: str, what: str = "name") -> str:
+    """Node/area names must not contain the key delimiter — the key format
+    would be ambiguous (the reference restricts node names the same way)."""
+    if KEY_DELIMITER in name or not name:
+        raise ValueError(f"invalid {what} {name!r}: empty or contains ':'")
+    return name
+
+
 def prefix_key(node: str, area: str, prefix: str) -> str:
     """Per-prefix key `prefix:<node>:<area>:[<prefix>]`
     (reference: openr/common/LsdbUtil † createPrefixKey)."""
+    validate_name(node, "node name")
+    validate_name(area, "area")
     return f"{PREFIX_DB_MARKER}{node}{KEY_DELIMITER}{area}{KEY_DELIMITER}[{prefix}]"
 
 

@@ -1,0 +1,12 @@
+"""Decision layer: LSDB state + route computation (reference: openr/decision/ †).
+
+The reference's Decision module holds `LinkState` (graph) and `PrefixState`
+(who advertises what), runs `SpfSolver` on change, and emits
+`DecisionRouteUpdate`. Here the same split exists, but the solver has two
+backends: a NumPy/heapq CPU **oracle** (`oracle.py`, byte-exact reference
+semantics, used for RIB-equivalence tests) and the **TPU** batched kernel
+(`openr_tpu.ops.spf`) operating on the padded CSR arrays produced by
+`LinkState.to_csr()`.
+"""
+
+from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState  # noqa: F401

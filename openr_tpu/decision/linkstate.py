@@ -1,0 +1,283 @@
+"""LSDB state: the graph (LinkState) and advertised prefixes (PrefixState).
+
+reference: openr/decision/LinkState.{h,cpp} † (adjacency graph, bidirectional
+adjacency check, overload semantics, SPF memoization) and
+openr/decision/PrefixState.{h,cpp} † (prefix → advertising nodes map).
+
+TPU-first design: `LinkState` maintains the host-side authoritative graph
+keyed by names, and lazily materializes a **padded CSR edge list**
+(`CsrGraph`) — fixed, bucketed array shapes so the jitted SPF kernel never
+recompiles as the topology churns. Node and edge capacities grow by
+power-of-two buckets; invalid slots are masked with `INF_METRIC`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+from openr_tpu.common.constants import DEFAULT_AREA, INT_MAX_METRIC
+from openr_tpu.types.topology import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+)
+from openr_tpu.types.network import IpPrefix
+
+# Metric sentinel for masked/invalid edges. i64 accumulation in kernels keeps
+# INF + INF from wrapping; comparisons treat >= INF_METRIC as unreachable.
+INF_METRIC = np.int64(1) << 40
+
+
+def pad_bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power-of-two bucket (>= minimum).
+
+    Keeps jit shapes stable under churn: capacity only changes when the
+    graph outgrows (or massively undershoots) its bucket.
+    """
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@dataclass
+class CsrGraph:
+    """Padded, device-ready edge-list view of the LSDB.
+
+    Edge arrays are sorted by destination node so that `segment_min` over
+    `edge_dst` (the relax step's scatter-min) is a contiguous segmented
+    reduction — the layout XLA lowers best on TPU.
+
+    Arrays (shapes fixed by buckets):
+      edge_src[Ep]      i32  source node id (0 for padding)
+      edge_dst[Ep]      i32  destination node id (num_nodes_padded-1 slot ok;
+                             padding edges point at a dead slot with INF metric)
+      edge_metric[Ep]   i64  directed metric; INF_METRIC for invalid/padding
+      node_overloaded[Vp] bool  node overload (no-transit) bits
+      node_mask[Vp]     bool  which node slots are live
+    """
+
+    num_nodes: int
+    num_edges: int
+    edge_src: np.ndarray
+    edge_dst: np.ndarray
+    edge_metric: np.ndarray
+    node_overloaded: np.ndarray
+    node_mask: np.ndarray
+    node_names: list[str]
+    # host-side maps for building NextHops from solver output:
+    # (src_id, dst_id) -> list[(if_name, metric, weight, adj_label, other_if)]
+    adj_details: dict[tuple[int, int], list[tuple[str, int, int, int, str]]]
+    name_to_id: dict[str, int]
+
+    @property
+    def padded_nodes(self) -> int:
+        return len(self.node_mask)
+
+    @property
+    def padded_edges(self) -> int:
+        return len(self.edge_src)
+
+
+class LinkState:
+    """The per-area adjacency graph (reference: openr/decision/LinkState †).
+
+    Semantics preserved from the reference:
+      * **Bidirectional check**: a directed edge u→v is usable only if v also
+        reports an adjacency back to u (otherwise a half-up link would
+        blackhole traffic). reference: LinkState topology construction †.
+      * **Link overload** (adjacency.is_overloaded / metric override): the
+        adjacency is excluded from SPF.
+      * **Node overload**: an overloaded node is never used for *transit*
+        (edges out of it are masked for every SPF root except itself);
+        it remains reachable as a destination. reference: SpfSolver
+        `nodeOverloaded` handling †.
+    """
+
+    def __init__(self, area: str = DEFAULT_AREA):
+        self.area = area
+        self._adj_dbs: dict[str, AdjacencyDatabase] = {}
+        self._csr: CsrGraph | None = None
+
+    # ---- mutation ---------------------------------------------------------
+
+    def update_adjacency_db(self, db: AdjacencyDatabase) -> bool:
+        """Insert/replace a node's adjacency database.
+
+        Returns True if the topology changed (triggers SPF recompute —
+        the reference returns a LinkStateChange bitset; we collapse to bool).
+        """
+        old = self._adj_dbs.get(db.this_node_name)
+        if old == db:
+            return False
+        self._adj_dbs[db.this_node_name] = db
+        self._csr = None
+        return True
+
+    def delete_adjacency_db(self, node: str) -> bool:
+        if node in self._adj_dbs:
+            del self._adj_dbs[node]
+            self._csr = None
+            return True
+        return False
+
+    # ---- queries ----------------------------------------------------------
+
+    @property
+    def nodes(self) -> list[str]:
+        return sorted(self._adj_dbs)
+
+    def adjacency_db(self, node: str) -> AdjacencyDatabase | None:
+        return self._adj_dbs.get(node)
+
+    def is_node_overloaded(self, node: str) -> bool:
+        db = self._adj_dbs.get(node)
+        return bool(db and db.is_overloaded)
+
+    def node_label(self, node: str) -> int:
+        db = self._adj_dbs.get(node)
+        return db.node_label if db else 0
+
+    # ---- CSR materialization ---------------------------------------------
+
+    def to_csr(self) -> CsrGraph:
+        """Build (or return cached) padded CSR arrays for the solver."""
+        if self._csr is None:
+            self._csr = self._build_csr()
+        return self._csr
+
+    def _build_csr(self) -> CsrGraph:
+        names = sorted(self._adj_dbs)  # deterministic interning
+        name_to_id = {n: i for i, n in enumerate(names)}
+        v = len(names)
+
+        # Directed adjacency index for the bidirectional check.
+        has_reverse: set[tuple[str, str]] = set()
+        for node, db in self._adj_dbs.items():
+            for adj in db.adjacencies:
+                has_reverse.add((node, adj.other_node_name))
+
+        srcs: list[int] = []
+        dsts: list[int] = []
+        metrics: list[int] = []
+        adj_details: dict[tuple[int, int], list] = {}
+        for node in names:
+            db = self._adj_dbs[node]
+            u = name_to_id[node]
+            for adj in db.adjacencies:
+                if adj.other_node_name not in name_to_id:
+                    continue  # neighbor's adj db not yet received
+                if (adj.other_node_name, node) not in has_reverse:
+                    continue  # bidirectional check failed
+                if adj.is_overloaded:
+                    continue  # hard-drained link
+                w = name_to_id[adj.other_node_name]
+                key = (u, w)
+                detail = (
+                    adj.if_name,
+                    int(adj.metric),
+                    int(adj.weight),
+                    int(adj.adj_label),
+                    adj.other_if_name,
+                )
+                # parallel links: SPF uses the min metric; all parallel
+                # interfaces at min metric become ECMP nexthops
+                adj_details.setdefault(key, []).append(detail)
+                srcs.append(u)
+                dsts.append(w)
+                metrics.append(int(adj.metric))
+
+        # Collapse parallel edges to min-metric (solver-side); details kept.
+        edge_best: dict[tuple[int, int], int] = {}
+        for s, d, m in zip(srcs, dsts, metrics):
+            key = (s, d)
+            if key not in edge_best or m < edge_best[key]:
+                edge_best[key] = m
+        e = len(edge_best)
+
+        vp = pad_bucket(max(v, 1) + 1)  # +1 dead slot for padding edges
+        ep = pad_bucket(max(e, 1), minimum=128)
+
+        edge_src = np.zeros(ep, dtype=np.int32)
+        edge_dst = np.full(ep, vp - 1, dtype=np.int32)  # dead slot
+        edge_metric = np.full(ep, INF_METRIC, dtype=np.int64)
+
+        # Sort by destination for contiguous segment reduction.
+        items = sorted(edge_best.items(), key=lambda kv: (kv[0][1], kv[0][0]))
+        for i, ((s, d), m) in enumerate(items):
+            edge_src[i] = s
+            edge_dst[i] = d
+            edge_metric[i] = m
+
+        node_overloaded = np.zeros(vp, dtype=bool)
+        node_mask = np.zeros(vp, dtype=bool)
+        for n, i in name_to_id.items():
+            node_mask[i] = True
+            node_overloaded[i] = self._adj_dbs[n].is_overloaded
+
+        return CsrGraph(
+            num_nodes=v,
+            num_edges=e,
+            edge_src=edge_src,
+            edge_dst=edge_dst,
+            edge_metric=edge_metric,
+            node_overloaded=node_overloaded,
+            node_mask=node_mask,
+            node_names=names,
+            adj_details=adj_details,
+            name_to_id=name_to_id,
+        )
+
+
+class PrefixState:
+    """prefix → {advertising node → PrefixEntry} for one area.
+
+    reference: openr/decision/PrefixState.{h,cpp} †.
+    """
+
+    def __init__(self, area: str = DEFAULT_AREA):
+        self.area = area
+        self._entries: dict[IpPrefix, dict[str, PrefixEntry]] = {}
+
+    def update_prefix_db(self, db: PrefixDatabase) -> set[IpPrefix]:
+        """Apply a node's prefix advertisement; returns changed prefixes."""
+        changed: set[IpPrefix] = set()
+        node = db.this_node_name
+        if db.delete_prefix:
+            for entry in db.prefix_entries:
+                if self.withdraw(node, entry.prefix):
+                    changed.add(entry.prefix)
+            return changed
+        for entry in db.prefix_entries:
+            per_node = self._entries.setdefault(entry.prefix, {})
+            if per_node.get(node) != entry:
+                per_node[node] = entry
+                changed.add(entry.prefix)
+        return changed
+
+    def withdraw(self, node: str, prefix: IpPrefix) -> bool:
+        per_node = self._entries.get(prefix)
+        if per_node and node in per_node:
+            del per_node[node]
+            if not per_node:
+                del self._entries[prefix]
+            return True
+        return False
+
+    def withdraw_node(self, node: str) -> set[IpPrefix]:
+        """Remove everything `node` advertises (node left the topology)."""
+        changed: set[IpPrefix] = set()
+        for prefix in list(self._entries):
+            if self.withdraw(node, prefix):
+                changed.add(prefix)
+        return changed
+
+    @property
+    def prefixes(self) -> dict[IpPrefix, dict[str, PrefixEntry]]:
+        return self._entries
+
+    def advertisers(self, prefix: IpPrefix) -> dict[str, PrefixEntry]:
+        return self._entries.get(prefix, {})

@@ -1,0 +1,268 @@
+"""CPU oracle solver: reference-semantics SPF + best-route selection.
+
+This is the ground truth for RIB equivalence — an independent, scalar
+implementation of the reference's Decision compute
+(reference: openr/decision/LinkState.cpp † runSpf — Dijkstra collecting ALL
+equal-cost predecessors; openr/decision/SpfSolver.cpp † selectBestRoutes /
+selectBestPathsSpf / createMplsRoutes). It deliberately does NOT share the
+CSR arrays with the TPU kernel: tests compare two code paths.
+
+Semantics implemented (all integer metrics, exact):
+  * Dijkstra per root over the bidirectional-checked graph.
+  * Link overload → edge excluded; node overload → no transit through it
+    (its outgoing edges are skipped unless it is the SPF root).
+  * ECMP: all equal-cost first-hops, via predecessor-DAG propagation.
+  * Best-route selection across advertising nodes: lexicographic on
+    (path_preference desc, source_preference desc, distance asc), then
+    among metric-best advertisers, min IGP distance; nexthops = union of
+    first-hops toward all min-IGP-distance best nodes (anycast ECMP).
+  * Local prefixes (this node among best advertisers) → no route.
+  * MPLS: node-segment label routes (SWAP, PHP at penultimate hop) and
+    adjacency label routes (PHP to the neighbor).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+
+from openr_tpu.common.constants import MPLS_LABEL_MIN
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.types.network import (
+    MplsAction,
+    MplsActionType,
+    NextHop,
+    sorted_nexthops,
+)
+from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
+from openr_tpu.types.topology import PrefixEntry
+
+INF = float("inf")
+
+
+@dataclass
+class SpfResult:
+    dist: dict[str, int]
+    # dest node -> set of first-hop neighbor node names (ECMP set)
+    first_hops: dict[str, set[str]]
+
+
+def build_adjacency(ls: LinkState) -> dict[str, dict[str, int]]:
+    """Directed min-metric adjacency with the bidirectional check applied."""
+    nodes = set(ls.nodes)
+    reported: set[tuple[str, str]] = set()
+    for u in nodes:
+        db = ls.adjacency_db(u)
+        for a in db.adjacencies:
+            reported.add((u, a.other_node_name))
+    adj: dict[str, dict[str, int]] = {u: {} for u in nodes}
+    for u in nodes:
+        db = ls.adjacency_db(u)
+        for a in db.adjacencies:
+            v = a.other_node_name
+            if v not in nodes or a.is_overloaded:
+                continue
+            if (v, u) not in reported:
+                continue
+            m = int(a.metric)
+            if v not in adj[u] or m < adj[u][v]:
+                adj[u][v] = m
+    return adj
+
+
+def run_spf(
+    ls: LinkState,
+    root: str,
+    adj: dict[str, dict[str, int]] | None = None,
+) -> SpfResult:
+    """Dijkstra from `root` with equal-cost first-hop sets.
+
+    reference: openr/decision/LinkState.cpp † runSpf (std::priority_queue,
+    collects all equal-cost predecessors for the ECMP DAG).
+    """
+    if adj is None:
+        adj = build_adjacency(ls)
+    dist: dict[str, int] = {root: 0}
+    preds: dict[str, set[str]] = {root: set()}
+    pq: list[tuple[int, str]] = [(0, root)]
+    done: set[str] = set()
+    order: list[str] = []
+    while pq:
+        d, u = heapq.heappop(pq)
+        if u in done:
+            continue
+        done.add(u)
+        order.append(u)
+        if u != root and ls.is_node_overloaded(u):
+            continue  # no transit through an overloaded node
+        for v, w in adj.get(u, {}).items():
+            nd = d + w
+            if v not in dist or nd < dist[v]:
+                dist[v] = nd
+                preds[v] = {u}
+                heapq.heappush(pq, (nd, v))
+            elif nd == dist[v]:
+                preds[v].add(u)
+
+    first_hops: dict[str, set[str]] = {root: set()}
+    for v in order:
+        if v == root:
+            continue
+        fh: set[str] = set()
+        for p in preds[v]:
+            if p == root:
+                fh.add(v)
+            else:
+                fh |= first_hops.get(p, set())
+        first_hops[v] = fh
+    return SpfResult(dist=dist, first_hops=first_hops)
+
+
+def metric_key(e: PrefixEntry) -> tuple[int, int, int]:
+    """Lexicographic best-route key — larger is better.
+
+    reference: openr/decision/SpfSolver.cpp † selectBestRoutes comparing
+    PrefixMetrics (path_preference desc, source_preference desc,
+    distance asc).
+    """
+    return (
+        e.metrics.path_preference,
+        e.metrics.source_preference,
+        -e.metrics.distance,
+    )
+
+
+def _nexthops_to_nodes(
+    ls: LinkState,
+    my_node: str,
+    spf: SpfResult,
+    targets: list[str],
+) -> tuple[NextHop, ...]:
+    """Union of ECMP first-hops toward `targets`, as NextHop objects.
+
+    Parallel links: every interface at the min metric toward the first-hop
+    neighbor becomes its own nexthop (reference keeps per-interface
+    nexthops †).
+    """
+    csr = ls.to_csr()
+    nhs: list[NextHop] = []
+    seen = set()
+    my_id = csr.name_to_id.get(my_node)
+    for tgt in targets:
+        igp = spf.dist[tgt]
+        for fh in spf.first_hops.get(tgt, ()):
+            fh_id = csr.name_to_id.get(fh)
+            details = csr.adj_details.get((my_id, fh_id), [])
+            best = min((d[1] for d in details), default=None)
+            for if_name, metric, _w, _lbl, _oif in details:
+                if metric != best or (fh, if_name) in seen:
+                    continue
+                seen.add((fh, if_name))
+                nhs.append(
+                    NextHop(
+                        address=fh,
+                        if_name=if_name,
+                        metric=igp,
+                        neighbor_node=fh,
+                        area=ls.area,
+                    )
+                )
+    return sorted_nexthops(nhs)
+
+
+def compute_routes(
+    ls: LinkState,
+    ps: PrefixState,
+    my_node: str,
+) -> RouteDatabase:
+    """Full RIB for `my_node` (reference: SpfSolver::buildRouteDb †)."""
+    rdb = RouteDatabase(this_node_name=my_node)
+    if my_node not in set(ls.nodes):
+        return rdb
+    adj = build_adjacency(ls)
+    spf = run_spf(ls, my_node, adj)
+
+    # ---- unicast ----------------------------------------------------------
+    for prefix, per_node in sorted(ps.prefixes.items()):
+        reachable = {
+            n: e
+            for n, e in per_node.items()
+            if n == my_node or (n in spf.dist and spf.first_hops.get(n))
+        }
+        if not reachable:
+            continue
+        best_key = max(metric_key(e) for e in reachable.values())
+        best_nodes = sorted(
+            n for n, e in reachable.items() if metric_key(e) == best_key
+        )
+        if my_node in best_nodes:
+            continue  # local prefix: not programmed via SPF
+        min_igp = min(spf.dist[n] for n in best_nodes)
+        chosen = [n for n in best_nodes if spf.dist[n] == min_igp]
+        nexthops = _nexthops_to_nodes(ls, my_node, spf, chosen)
+        if not nexthops:
+            continue
+        best_entry = reachable[chosen[0]]
+        if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
+            continue  # reference: drop route below min_nexthop †
+        rdb.unicast_routes[prefix] = RibEntry(
+            prefix=prefix,
+            nexthops=nexthops,
+            best_node=chosen[0],
+            best_nodes=tuple(best_nodes),
+            best_entry=best_entry,
+            igp_cost=min_igp,
+        )
+
+    # ---- MPLS node-segment routes ----------------------------------------
+    # reference: SpfSolver::createMplsRoutes † — for every remote node with a
+    # node label: SWAP to the same label, PHP when the nexthop IS the target.
+    for node in ls.nodes:
+        label = ls.node_label(node)
+        if label < MPLS_LABEL_MIN or node == my_node:
+            continue
+        if node not in spf.dist or not spf.first_hops.get(node):
+            continue
+        igp = spf.dist[node]
+        base = _nexthops_to_nodes(ls, my_node, spf, [node])
+        nhs = tuple(
+            NextHop(
+                address=nh.address,
+                if_name=nh.if_name,
+                metric=nh.metric,
+                neighbor_node=nh.neighbor_node,
+                area=nh.area,
+                mpls_action=(
+                    MplsAction(action=MplsActionType.PHP)
+                    if nh.neighbor_node == node
+                    else MplsAction(action=MplsActionType.SWAP, swap_label=label)
+                ),
+            )
+            for nh in base
+        )
+        if nhs:
+            rdb.mpls_routes[label] = RibMplsEntry(label=label, nexthops=nhs)
+
+    # ---- MPLS adjacency-label routes -------------------------------------
+    my_db = ls.adjacency_db(my_node)
+    csr = ls.to_csr()
+    if my_db:
+        for a in my_db.adjacencies:
+            if a.adj_label < MPLS_LABEL_MIN:
+                continue
+            if a.other_node_name not in csr.name_to_id or a.is_overloaded:
+                continue
+            rdb.mpls_routes[a.adj_label] = RibMplsEntry(
+                label=a.adj_label,
+                nexthops=(
+                    NextHop(
+                        address=a.other_node_name,
+                        if_name=a.if_name,
+                        metric=int(a.metric),
+                        neighbor_node=a.other_node_name,
+                        area=ls.area,
+                        mpls_action=MplsAction(action=MplsActionType.PHP),
+                    ),
+                ),
+            )
+    return rdb

@@ -9,6 +9,8 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field
 
+from openr_tpu.common.constants import DEFAULT_AREA
+
 # TTL sentinel: key never expires (reference: openr/common/Constants.h †
 # kTtlInfinity == INT32_MIN in some versions; we use -1).
 TTL_INFINITY = -1
@@ -24,6 +26,7 @@ def value_hash(version: int, originator_id: str, value: bytes | None) -> int:
     oid = originator_id.encode()
     h.update(len(oid).to_bytes(4, "big"))  # length prefix: no (id, value)
     h.update(oid)                          # concatenation collisions
+    h.update(b"\x01" if value is not None else b"\x00")  # None != b""
     if value is not None:
         h.update(value)
     return int.from_bytes(h.digest(), "big") >> 1
@@ -60,7 +63,7 @@ class Publication:
     reference: openr/if/KvStore.thrift † Publication.
     """
 
-    area: str = "0"
+    area: str = DEFAULT_AREA
     key_vals: dict[str, Value] = field(default_factory=dict)
     expired_keys: list[str] = field(default_factory=list)
     node_ids: list[str] = field(default_factory=list)  # flood loop guard

@@ -94,8 +94,12 @@ def _decode_key(k: str, hint: Any) -> Any:
     if hint is int:
         return int(k)
     # Frozen single-str-field dataclasses (e.g. IpPrefix) encode as str(obj);
-    # reconstruct from that string so dataclass-keyed dicts round-trip.
+    # reconstruct from that string so dataclass-keyed dicts round-trip. Use
+    # the type's canonicalizing `make` when it has one, so a non-canonical
+    # key from a peer can't create a second unequal key for the same object.
     if dataclasses.is_dataclass(hint):
+        if hasattr(hint, "make"):
+            return hint.make(k)
         flds = dataclasses.fields(hint)
         if len(flds) == 1:
             return hint(**{flds[0].name: k})

@@ -3,8 +3,8 @@
 Equivalent of the reference's Types.thrift core structs
 (reference: openr/if/Types.thrift † — Adjacency, AdjacencyDatabase,
 PrefixEntry, PrefixMetrics, PrefixDatabase). These are the payloads of the
-`adj:<node>` and `prefix:<node>:<area>:<prefix>` KvStore keys and the sole
-inputs to Decision's LSDB.
+`adj:<node>` and `prefix:<node>:<area>:[<prefix>]` KvStore keys (see
+constants.prefix_key) and the sole inputs to Decision's LSDB.
 """
 
 from __future__ import annotations
@@ -12,6 +12,7 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass
 
+from openr_tpu.common.constants import DEFAULT_AREA
 from openr_tpu.types.network import IpPrefix
 
 
@@ -65,7 +66,7 @@ class AdjacencyDatabase:
     adjacencies: tuple[Adjacency, ...] = ()
     is_overloaded: bool = False  # node drain: never transit this node
     node_label: int = 0  # SR node segment label
-    area: str = "0"
+    area: str = DEFAULT_AREA
 
 
 # Default metric values mirror the reference's best-route preference space
@@ -119,5 +120,5 @@ class PrefixDatabase:
 
     this_node_name: str
     prefix_entries: tuple[PrefixEntry, ...] = ()
-    area: str = "0"
+    area: str = DEFAULT_AREA
     delete_prefix: bool = False  # per-prefix-key withdrawal marker

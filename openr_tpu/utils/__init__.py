@@ -1,0 +1,1 @@
+"""Shared utilities (topology generators, id interning, misc)."""

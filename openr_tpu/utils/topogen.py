@@ -1,0 +1,171 @@
+"""Synthetic topology generators for tests, benchmarks, and the emulator.
+
+The reference builds these inline in its tests/benchmarks
+(reference: openr/decision/tests/DecisionTest.cpp † grid/ring helpers,
+openr/decision/tests/DecisionBenchmark.cpp † grid topologies,
+openr/tests/utils/Utils.cpp † createAdjDb/createPrefixDb). Centralized here
+because bench.py and the emulator share them.
+
+Every generator returns `(adj_dbs, prefix_dbs)`: one AdjacencyDatabase per
+node (bidirectional adjacencies, integer metrics) and one PrefixDatabase per
+node advertising that node's loopback prefix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.topology import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+)
+
+
+def node_name(i: int) -> str:
+    return f"node-{i}"
+
+
+def loopback(i: int) -> IpPrefix:
+    """Unique /32 per node out of 10.0.0.0/8 (supports ~16M nodes)."""
+    return IpPrefix.make(f"10.{(i >> 16) & 0xFF}.{(i >> 8) & 0xFF}.{i & 0xFF}/32")
+
+
+def _mk_dbs(
+    n: int,
+    edges: list[tuple[int, int, int]],
+    area: str = "0",
+) -> tuple[list[AdjacencyDatabase], list[PrefixDatabase]]:
+    """edges: directed (u, v, metric); callers emit both directions."""
+    adjs: dict[int, list[Adjacency]] = {i: [] for i in range(n)}
+    for u, v, m in edges:
+        adjs[u].append(
+            Adjacency(
+                other_node_name=node_name(v),
+                if_name=f"if_{u}_{v}",
+                other_if_name=f"if_{v}_{u}",
+                metric=m,
+            )
+        )
+    adj_dbs = [
+        AdjacencyDatabase(
+            this_node_name=node_name(i),
+            adjacencies=tuple(adjs[i]),
+            node_label=101 + i,
+            area=area,
+        )
+        for i in range(n)
+    ]
+    prefix_dbs = [
+        PrefixDatabase(
+            this_node_name=node_name(i),
+            prefix_entries=(PrefixEntry(prefix=loopback(i)),),
+            area=area,
+        )
+        for i in range(n)
+    ]
+    return adj_dbs, prefix_dbs
+
+
+def ring(n: int, metric: int = 1):
+    """Ring of n nodes (reference test analogue: DecisionTest ring cases †)."""
+    edges = []
+    for i in range(n):
+        j = (i + 1) % n
+        edges.append((i, j, metric))
+        edges.append((j, i, metric))
+    return _mk_dbs(n, edges)
+
+
+def grid(rows: int, cols: int, metric: int = 1):
+    """rows×cols grid (reference: DecisionBenchmark grid topologies †)."""
+    edges = []
+
+    def nid(r, c):
+        return r * cols + c
+
+    for r in range(rows):
+        for c in range(cols):
+            if c + 1 < cols:
+                a, b = nid(r, c), nid(r, c + 1)
+                edges += [(a, b, metric), (b, a, metric)]
+            if r + 1 < rows:
+                a, b = nid(r, c), nid(r + 1, c)
+                edges += [(a, b, metric), (b, a, metric)]
+    return _mk_dbs(rows * cols, edges)
+
+
+def full_mesh(n: int, metric: int = 1):
+    edges = []
+    for i in range(n):
+        for j in range(i + 1, n):
+            edges += [(i, j, metric), (j, i, metric)]
+    return _mk_dbs(n, edges)
+
+
+def fat_tree(k: int = 4, metric: int = 1):
+    """3-tier k-ary fat-tree (BASELINE config 1 uses ~100 nodes ⇒ k=8 is 208).
+
+    Layout: (k/2)^2 core switches; k pods, each with k/2 agg + k/2 tor.
+    Every tor connects to every agg in its pod; agg i in each pod connects to
+    core switches [i*(k/2), (i+1)*(k/2)).
+    """
+    assert k % 2 == 0
+    half = k // 2
+    n_core = half * half
+    n_agg = k * half
+    n_tor = k * half
+    n = n_core + n_agg + n_tor
+
+    def core_id(i):
+        return i
+
+    def agg_id(pod, i):
+        return n_core + pod * half + i
+
+    def tor_id(pod, i):
+        return n_core + n_agg + pod * half + i
+
+    edges = []
+    for pod in range(k):
+        for a in range(half):
+            for t in range(half):
+                u, v = agg_id(pod, a), tor_id(pod, t)
+                edges += [(u, v, metric), (v, u, metric)]
+            for c in range(half):
+                u, v = agg_id(pod, a), core_id(a * half + c)
+                edges += [(u, v, metric), (v, u, metric)]
+    return _mk_dbs(n, edges)
+
+
+def erdos_renyi(n: int, avg_degree: int = 10, seed: int = 0, max_metric: int = 16):
+    """Random graph with ~n*avg_degree/2 undirected edges (BASELINE config 3).
+
+    Guaranteed connected-ish via a Hamiltonian backbone ring plus random
+    chords; metrics uniform in [1, max_metric].
+    """
+    rng = np.random.default_rng(seed)
+    seen = set()
+    edges = []
+
+    def add(u, v, m):
+        if u == v or (u, v) in seen:
+            return
+        seen.add((u, v))
+        seen.add((v, u))
+        edges.append((u, v, m))
+        edges.append((v, u, m))
+
+    for i in range(n):  # backbone ring keeps it connected
+        add(i, (i + 1) % n, int(rng.integers(1, max_metric + 1)))
+    target = n * avg_degree // 2
+    us = rng.integers(0, n, size=3 * target)
+    vs = rng.integers(0, n, size=3 * target)
+    ms = rng.integers(1, max_metric + 1, size=3 * target)
+    for u, v, m in zip(us, vs, ms):
+        if len(seen) // 2 >= target:
+            break
+        add(int(u), int(v), int(m))
+    return _mk_dbs(n, edges)

@@ -1,0 +1,130 @@
+"""LinkState/CSR tests (reference analogue: LinkState parts of
+openr/decision/tests/DecisionTest.cpp † and LinkStateTest †)."""
+
+import numpy as np
+
+from openr_tpu.decision.linkstate import INF_METRIC, LinkState, pad_bucket
+from openr_tpu.types.topology import Adjacency, AdjacencyDatabase
+from openr_tpu.utils import topogen
+
+
+def _load(adj_dbs):
+    ls = LinkState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    return ls
+
+
+def test_pad_bucket():
+    assert pad_bucket(1) == 8
+    assert pad_bucket(8) == 8
+    assert pad_bucket(9) == 16
+    assert pad_bucket(100, minimum=128) == 128
+    assert pad_bucket(129, minimum=128) == 256
+
+
+def test_csr_ring():
+    adj_dbs, _ = topogen.ring(4)
+    csr = _load(adj_dbs).to_csr()
+    assert csr.num_nodes == 4
+    assert csr.num_edges == 8  # 4 undirected = 8 directed
+    assert csr.padded_nodes == 8  # 4+1 dead slot → bucket 8
+    assert csr.padded_edges == 128
+    # valid edges sorted by destination
+    valid = csr.edge_metric < INF_METRIC
+    assert valid.sum() == 8
+    dsts = csr.edge_dst[valid]
+    assert (np.diff(dsts) >= 0).all()
+    # padding edges point at the dead slot with INF metric
+    assert (csr.edge_dst[~valid] == csr.padded_nodes - 1).all()
+
+
+def test_bidirectional_check():
+    # node-0 reports adjacency to node-1, but node-1 doesn't reciprocate
+    ls = LinkState()
+    ls.update_adjacency_db(
+        AdjacencyDatabase(
+            this_node_name="node-0",
+            adjacencies=(Adjacency(other_node_name="node-1", if_name="e0"),),
+        )
+    )
+    ls.update_adjacency_db(AdjacencyDatabase(this_node_name="node-1"))
+    csr = ls.to_csr()
+    assert csr.num_edges == 0
+    # now node-1 reciprocates → both directions appear
+    ls.update_adjacency_db(
+        AdjacencyDatabase(
+            this_node_name="node-1",
+            adjacencies=(Adjacency(other_node_name="node-0", if_name="e0"),),
+        )
+    )
+    assert ls.to_csr().num_edges == 2
+
+
+def test_overloaded_link_excluded():
+    adj_dbs, _ = topogen.ring(4)
+    db0 = adj_dbs[0]
+    drained = AdjacencyDatabase(
+        this_node_name=db0.this_node_name,
+        adjacencies=tuple(
+            Adjacency(
+                other_node_name=a.other_node_name,
+                if_name=a.if_name,
+                other_if_name=a.other_if_name,
+                metric=a.metric,
+                is_overloaded=(a.other_node_name == "node-1"),
+            )
+            for a in db0.adjacencies
+        ),
+        node_label=db0.node_label,
+    )
+    ls = _load([drained] + adj_dbs[1:])
+    csr = ls.to_csr()
+    # node-0 → node-1 gone; reverse node-1 → node-0 stays (directed drain)
+    assert csr.num_edges == 7
+
+
+def test_update_is_idempotent_and_detects_change():
+    adj_dbs, _ = topogen.ring(4)
+    ls = LinkState()
+    assert ls.update_adjacency_db(adj_dbs[0]) is True
+    assert ls.update_adjacency_db(adj_dbs[0]) is False  # no change
+    assert ls.delete_adjacency_db("node-0") is True
+    assert ls.delete_adjacency_db("node-0") is False
+
+
+def test_shape_stability_within_bucket():
+    """Adding a node that fits the bucket must not change array shapes —
+    this is what keeps the jitted solver from recompiling under churn."""
+    adj_dbs, _ = topogen.ring(5)
+    ls = _load(adj_dbs[:4])  # only 4 nodes of the ring present
+    shape0 = (ls.to_csr().padded_nodes, ls.to_csr().padded_edges)
+    ls.update_adjacency_db(adj_dbs[4])
+    shape1 = (ls.to_csr().padded_nodes, ls.to_csr().padded_edges)
+    assert shape0 == shape1
+
+
+def test_parallel_links_min_metric():
+    mk = lambda other, ifn, m: Adjacency(  # noqa: E731
+        other_node_name=other, if_name=ifn, metric=m
+    )
+    ls = LinkState()
+    ls.update_adjacency_db(
+        AdjacencyDatabase(
+            this_node_name="a",
+            adjacencies=(mk("b", "e0", 10), mk("b", "e1", 5)),
+        )
+    )
+    ls.update_adjacency_db(
+        AdjacencyDatabase(
+            this_node_name="b",
+            adjacencies=(mk("a", "e0", 10), mk("a", "e1", 5)),
+        )
+    )
+    csr = ls.to_csr()
+    assert csr.num_edges == 2  # collapsed to one per direction
+    valid = csr.edge_metric < INF_METRIC
+    assert sorted(csr.edge_metric[valid].tolist()) == [5, 5]
+    # both interfaces retained in details for nexthop construction
+    a, b = csr.name_to_id["a"], csr.name_to_id["b"]
+    assert len(csr.adj_details[(a, b)]) == 2

@@ -1,0 +1,220 @@
+"""Oracle solver golden tests — ported scenarios from the reference's
+DecisionTest (reference: openr/decision/tests/DecisionTest.cpp † grid/ring
+ECMP, overload, best-route-selection cases). Hand-computed expectations."""
+
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.oracle import compute_routes, metric_key, run_spf
+from openr_tpu.types.network import IpPrefix, MplsActionType
+from openr_tpu.types.topology import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+)
+from openr_tpu.utils import topogen
+
+
+def _state(adj_dbs, prefix_dbs):
+    ls, ps = LinkState(), PrefixState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    for db in prefix_dbs:
+        ps.update_prefix_db(db)
+    return ls, ps
+
+
+def test_ring4_spf_ecmp():
+    adj_dbs, _ = topogen.ring(4)
+    ls, _ = _state(adj_dbs, [])
+    res = run_spf(ls, "node-0")
+    assert res.dist == {"node-0": 0, "node-1": 1, "node-2": 2, "node-3": 1}
+    assert res.first_hops["node-1"] == {"node-1"}
+    assert res.first_hops["node-3"] == {"node-3"}
+    # opposite corner: two equal-cost paths
+    assert res.first_hops["node-2"] == {"node-1", "node-3"}
+
+
+def test_ring5_no_ecmp():
+    adj_dbs, _ = topogen.ring(5)
+    ls, _ = _state(adj_dbs, [])
+    res = run_spf(ls, "node-0")
+    assert res.dist["node-2"] == 2
+    assert res.first_hops["node-2"] == {"node-1"}
+    assert res.first_hops["node-3"] == {"node-4"}
+
+
+def test_grid3x3_corner_ecmp():
+    adj_dbs, _ = topogen.grid(3, 3)
+    ls, _ = _state(adj_dbs, [])
+    res = run_spf(ls, "node-0")  # corner
+    # opposite corner node-8: dist 4, both neighbors are first hops
+    assert res.dist["node-8"] == 4
+    assert res.first_hops["node-8"] == {"node-1", "node-3"}
+
+
+def test_node_overload_no_transit():
+    # line: 0 - 1 - 2 plus detour 0 - 3 - 4 - 2 (metric heavier)
+    edges = [
+        (0, 1, 1), (1, 0, 1),
+        (1, 2, 1), (2, 1, 1),
+        (0, 3, 1), (3, 0, 1),
+        (3, 4, 1), (4, 3, 1),
+        (4, 2, 1), (2, 4, 1),
+    ]
+    adj_dbs, prefix_dbs = topogen._mk_dbs(5, edges)
+    # overload node-1: traffic 0→2 must detour via 3,4
+    db1 = adj_dbs[1]
+    adj_dbs[1] = AdjacencyDatabase(
+        this_node_name=db1.this_node_name,
+        adjacencies=db1.adjacencies,
+        is_overloaded=True,
+        node_label=db1.node_label,
+    )
+    ls, _ = _state(adj_dbs, [])
+    res = run_spf(ls, "node-0")
+    assert res.dist["node-1"] == 1  # still reachable as destination
+    assert res.dist["node-2"] == 3  # but not via transit: 0-3-4-2
+    assert res.first_hops["node-2"] == {"node-3"}
+
+
+def test_routes_ring4():
+    adj_dbs, prefix_dbs = topogen.ring(4)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    # routes to the other three loopbacks, none to self
+    assert set(rdb.unicast_routes) == {
+        topogen.loopback(1),
+        topogen.loopback(2),
+        topogen.loopback(3),
+    }
+    r2 = rdb.unicast_routes[topogen.loopback(2)]
+    assert r2.igp_cost == 2
+    assert {nh.neighbor_node for nh in r2.nexthops} == {"node-1", "node-3"}
+    assert all(nh.metric == 2 for nh in r2.nexthops)
+
+
+def test_best_route_selection_path_preference():
+    adj_dbs, _ = topogen.ring(4)
+    anycast = IpPrefix.make("192.168.0.0/24")
+    # node-1 advertises with higher path-preference than node-3
+    pdbs = [
+        PrefixDatabase(
+            this_node_name="node-1",
+            prefix_entries=(
+                PrefixEntry(
+                    prefix=anycast,
+                    metrics=PrefixMetrics(path_preference=2000),
+                ),
+            ),
+        ),
+        PrefixDatabase(
+            this_node_name="node-3",
+            prefix_entries=(
+                PrefixEntry(
+                    prefix=anycast,
+                    metrics=PrefixMetrics(path_preference=1000),
+                ),
+            ),
+        ),
+    ]
+    ls, ps = _state(adj_dbs, pdbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    r = rdb.unicast_routes[anycast]
+    assert r.best_nodes == ("node-1",)
+    assert {nh.neighbor_node for nh in r.nexthops} == {"node-1"}
+
+
+def test_anycast_equal_metrics_min_igp():
+    adj_dbs, _ = topogen.ring(5)
+    anycast = IpPrefix.make("192.168.0.0/24")
+    # node-1 (dist 1) and node-2 (dist 2) advertise identically
+    pdbs = [
+        PrefixDatabase(
+            this_node_name=n,
+            prefix_entries=(PrefixEntry(prefix=anycast),),
+        )
+        for n in ("node-1", "node-2")
+    ]
+    ls, ps = _state(adj_dbs, pdbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    r = rdb.unicast_routes[anycast]
+    assert r.best_nodes == ("node-1", "node-2")  # both metric-best
+    assert r.igp_cost == 1  # but only min-IGP node gets traffic
+    assert {nh.neighbor_node for nh in r.nexthops} == {"node-1"}
+
+
+def test_anycast_equal_igp_unions_nexthops():
+    adj_dbs, _ = topogen.ring(4)
+    anycast = IpPrefix.make("192.168.0.0/24")
+    pdbs = [
+        PrefixDatabase(
+            this_node_name=n,
+            prefix_entries=(PrefixEntry(prefix=anycast),),
+        )
+        for n in ("node-1", "node-3")  # both at dist 1 from node-0
+    ]
+    ls, ps = _state(adj_dbs, pdbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    r = rdb.unicast_routes[anycast]
+    assert {nh.neighbor_node for nh in r.nexthops} == {"node-1", "node-3"}
+
+
+def test_local_prefix_not_programmed():
+    adj_dbs, prefix_dbs = topogen.ring(4)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    assert topogen.loopback(0) not in rdb.unicast_routes
+
+
+def test_mpls_node_segment_routes():
+    adj_dbs, prefix_dbs = topogen.ring(4)  # node labels 101+i
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    # adjacent node-1 (label 102): PHP
+    r1 = rdb.mpls_routes[102]
+    assert all(
+        nh.mpls_action.action == MplsActionType.PHP for nh in r1.nexthops
+    )
+    # two-hop node-2 (label 103): SWAP to same label via both ECMP nexthops
+    r2 = rdb.mpls_routes[103]
+    assert {nh.neighbor_node for nh in r2.nexthops} == {"node-1", "node-3"}
+    assert all(
+        nh.mpls_action.action == MplsActionType.SWAP
+        and nh.mpls_action.swap_label == 103
+        for nh in r2.nexthops
+    )
+
+
+def test_metric_key_ordering():
+    hi = PrefixEntry(
+        prefix=IpPrefix.make("1.0.0.0/8"),
+        metrics=PrefixMetrics(path_preference=2000, source_preference=1, distance=9),
+    )
+    lo = PrefixEntry(
+        prefix=IpPrefix.make("1.0.0.0/8"),
+        metrics=PrefixMetrics(path_preference=1000, source_preference=9, distance=0),
+    )
+    assert metric_key(hi) > metric_key(lo)
+    near = PrefixEntry(
+        prefix=IpPrefix.make("1.0.0.0/8"),
+        metrics=PrefixMetrics(distance=1),
+    )
+    far = PrefixEntry(
+        prefix=IpPrefix.make("1.0.0.0/8"),
+        metrics=PrefixMetrics(distance=5),
+    )
+    assert metric_key(near) > metric_key(far)
+
+
+def test_disconnected_advertiser_unreachable():
+    adj_dbs, prefix_dbs = topogen.ring(4)
+    # an island node advertises a prefix but has no bidirectional adjacency
+    island_adj = AdjacencyDatabase(this_node_name="island")
+    island_pfx = PrefixDatabase(
+        this_node_name="island",
+        prefix_entries=(PrefixEntry(prefix=IpPrefix.make("172.16.0.0/12")),),
+    )
+    ls, ps = _state(adj_dbs + [island_adj], prefix_dbs + [island_pfx])
+    rdb = compute_routes(ls, ps, "node-0")
+    assert IpPrefix.make("172.16.0.0/12") not in rdb.unicast_routes

@@ -140,6 +140,30 @@ def test_route_db_roundtrip_with_dataclass_keys():
 def test_value_hash_no_concat_collision():
     # (id="ab", value=b"c") must differ from (id="a", value=b"bc")
     assert value_hash(1, "ab", b"c") != value_hash(1, "a", b"bc")
+    # hash-only (None) differs from genuinely-empty payload
+    assert value_hash(1, "a", None) != value_hash(1, "a", b"")
+
+
+def test_prefix_key_rejects_delimiter_in_names():
+    import pytest
+
+    with pytest.raises(ValueError):
+        C.prefix_key("rack1:n2", "0", "10.0.0.0/24")
+    with pytest.raises(ValueError):
+        C.prefix_key("n2", "a:b", "10.0.0.0/24")
+
+
+def test_dict_key_decode_canonicalizes():
+    from openr_tpu.types import RibEntry, RouteDatabase
+
+    raw = (
+        b'{"mpls_routes":{},"this_node_name":"n1","unicast_routes":'
+        b'{"10.0.0.5/24":{"best_entry":null,"best_node":"n2","best_nodes":[],'
+        b'"igp_cost":1,"nexthops":[],"prefix":{"prefix":"10.0.0.0/24"}}}}'
+    )
+    got = from_wire(raw, RouteDatabase)
+    # non-canonical key from a peer decodes to the canonical IpPrefix
+    assert IpPrefix.make("10.0.0.0/24") in got.unicast_routes
 
 
 def test_ip_prefix_canonicalizes():
