@@ -56,8 +56,12 @@ SR_LOCAL_RANGE = (50_000, 59_999)  # adjacency labels
 
 # ---- Misc ------------------------------------------------------------------
 DEFAULT_AREA = "0"
-OVERLOAD_METRIC = 1 << 30  # soft-drain path cost; fits i32 sums in i64 math
-INT_MAX_METRIC = (1 << 31) - 1
+
+# Solver numeric contract (shared by the CSR builder, the TPU kernel, and
+# the oracle): int32 distances, INF sentinel, metric clamp such that
+# INF + METRIC_MAX < 2^31 (no int32 overflow in the relax step).
+DIST_INF = 1 << 30
+METRIC_MAX = (1 << 20) - 1
 
 # ---- Watchdog (reference: openr/watchdog/Watchdog.cpp †) -------------------
 WATCHDOG_INTERVAL_S = 20

@@ -1,0 +1,16 @@
+"""Small shared helpers (reference: openr/common/Util.h †)."""
+
+from __future__ import annotations
+
+
+def pad_bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power-of-two bucket (>= minimum).
+
+    Used for every jit-facing capacity (node slots, edge slots, SPF-root
+    batches): shapes only change when a bucket is outgrown, so the XLA
+    compile cache stays warm under topology churn.
+    """
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap

@@ -17,7 +17,8 @@ from dataclasses import dataclass, field, replace
 
 import numpy as np
 
-from openr_tpu.common.constants import DEFAULT_AREA, INT_MAX_METRIC
+from openr_tpu.common.constants import DEFAULT_AREA, DIST_INF, METRIC_MAX
+from openr_tpu.common.util import pad_bucket  # noqa: F401  (re-export)
 from openr_tpu.types.topology import (
     Adjacency,
     AdjacencyDatabase,
@@ -26,21 +27,9 @@ from openr_tpu.types.topology import (
 )
 from openr_tpu.types.network import IpPrefix
 
-# Metric sentinel for masked/invalid edges. i64 accumulation in kernels keeps
-# INF + INF from wrapping; comparisons treat >= INF_METRIC as unreachable.
-INF_METRIC = np.int64(1) << 40
-
-
-def pad_bucket(n: int, minimum: int = 8) -> int:
-    """Round up to the next power-of-two bucket (>= minimum).
-
-    Keeps jit shapes stable under churn: capacity only changes when the
-    graph outgrows (or massively undershoots) its bucket.
-    """
-    cap = minimum
-    while cap < n:
-        cap <<= 1
-    return cap
+# Metric sentinel for masked/invalid edge slots. Valid metrics are clamped
+# to METRIC_MAX so the int32 relax step in ops/spf.py cannot overflow.
+INF_METRIC = DIST_INF
 
 
 @dataclass
@@ -55,7 +44,7 @@ class CsrGraph:
       edge_src[Ep]      i32  source node id (0 for padding)
       edge_dst[Ep]      i32  destination node id (num_nodes_padded-1 slot ok;
                              padding edges point at a dead slot with INF metric)
-      edge_metric[Ep]   i64  directed metric; INF_METRIC for invalid/padding
+      edge_metric[Ep]   i32  directed metric ≤ METRIC_MAX; INF_METRIC padding
       node_overloaded[Vp] bool  node overload (no-transit) bits
       node_mask[Vp]     bool  which node slots are live
     """
@@ -72,6 +61,7 @@ class CsrGraph:
     # (src_id, dst_id) -> list[(if_name, metric, weight, adj_label, other_if)]
     adj_details: dict[tuple[int, int], list[tuple[str, int, int, int, str]]]
     name_to_id: dict[str, int]
+    _dense: tuple[np.ndarray, np.ndarray] | None = None
 
     @property
     def padded_nodes(self) -> int:
@@ -80,6 +70,29 @@ class CsrGraph:
     @property
     def padded_edges(self) -> int:
         return len(self.edge_src)
+
+    def dense_width(self) -> int:
+        """D of the dense tables WITHOUT building them (O(E) bincount) —
+        used to decide dense-vs-edge-list before committing the memory."""
+        valid = self.edge_metric < DIST_INF
+        if not valid.any():
+            return 8
+        indeg = np.bincount(
+            self.edge_dst[valid].astype(np.int64),
+            minlength=self.padded_nodes,
+        )
+        return pad_bucket(int(indeg.max()), minimum=8)
+
+    def dense_tables(self) -> tuple[np.ndarray, np.ndarray]:
+        """Cached dense in-neighbor tables (see ops.spf.build_dense_tables)."""
+        if self._dense is None:
+            from openr_tpu.ops.spf import build_dense_tables
+
+            self._dense = build_dense_tables(
+                self.edge_src, self.edge_dst, self.edge_metric,
+                self.padded_nodes,
+            )
+        return self._dense
 
 
 class LinkState:
@@ -203,14 +216,14 @@ class LinkState:
 
         edge_src = np.zeros(ep, dtype=np.int32)
         edge_dst = np.full(ep, vp - 1, dtype=np.int32)  # dead slot
-        edge_metric = np.full(ep, INF_METRIC, dtype=np.int64)
+        edge_metric = np.full(ep, INF_METRIC, dtype=np.int32)
 
         # Sort by destination for contiguous segment reduction.
         items = sorted(edge_best.items(), key=lambda kv: (kv[0][1], kv[0][0]))
         for i, ((s, d), m) in enumerate(items):
             edge_src[i] = s
             edge_dst[i] = d
-            edge_metric[i] = m
+            edge_metric[i] = min(m, METRIC_MAX)
 
         node_overloaded = np.zeros(vp, dtype=bool)
         node_mask = np.zeros(vp, dtype=bool)

@@ -26,7 +26,7 @@ from __future__ import annotations
 import heapq
 from dataclasses import dataclass
 
-from openr_tpu.common.constants import MPLS_LABEL_MIN
+from openr_tpu.common.constants import METRIC_MAX, MPLS_LABEL_MIN
 from openr_tpu.decision.linkstate import LinkState, PrefixState
 from openr_tpu.types.network import (
     MplsAction,
@@ -64,7 +64,7 @@ def build_adjacency(ls: LinkState) -> dict[str, dict[str, int]]:
                 continue
             if (v, u) not in reported:
                 continue
-            m = int(a.metric)
+            m = min(int(a.metric), METRIC_MAX)  # same clamp as CSR builder
             if v not in adj[u] or m < adj[u][v]:
                 adj[u][v] = m
     return adj

@@ -1,0 +1,14 @@
+"""Device compute kernels (JAX/XLA/Pallas) — the TPU Decision hot path.
+
+The reference's equivalent is the scalar C++ SPF core
+(reference: openr/decision/LinkState.cpp † runSpf + SpfSolver †). Here it is
+a batched, masked, fixed-shape JAX program; see `spf.py`.
+"""
+
+from openr_tpu.ops.spf import (  # noqa: F401
+    INF_DIST,
+    batched_sssp,
+    batched_sssp_dense,
+    build_dense_tables,
+    first_hop_matrix,
+)

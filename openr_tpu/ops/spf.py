@@ -1,0 +1,254 @@
+"""Batched SSSP on TPU: the SpfSolver compute core.
+
+reference: openr/decision/LinkState.cpp † runSpf — a per-root scalar
+Dijkstra with a std::priority_queue. A priority queue is the wrong shape for
+a TPU: data-dependent control flow, scalar pops, pointer chasing. The
+TPU-native formulation is **batched edge-relaxation to fixpoint**
+(Bellman-Ford over the padded CSR edge list):
+
+    dist[v, b] = min(dist[v, b], min over edges (u→v): dist[u, b] + w(u,v))
+
+iterated under `lax.while_loop` until no distance changes (≤ hop-diameter
+iterations — 4 for a fat-tree, O(log V) for random graphs). Every step is a
+gather + elementwise add + segmented min over the dst-sorted edge list:
+static shapes, no host sync, fuses into a handful of XLA ops, and the batch
+dimension B (SPF roots) vectorizes for free. ECMP/LFA/nexthops then fall out
+of pure elementwise comparisons on the resulting distance matrix
+(`first_hop_matrix`) instead of predecessor bookkeeping inside the loop.
+
+Layout notes (TPU):
+  * node-major [Vp, B] / edge-major [Ep, B]: B is the minor (lane) dim;
+    pad B to a multiple of 8 — callers use `pad_batch`.
+  * distances are **int32** (exact integer metrics, like the reference's int
+    metrics). INF_DIST = 2^30; valid metrics are ≤ METRIC_MAX = 2^20-1
+    (enforced by the CSR builder), so `dist + metric` can never overflow
+    int32 (2^30 + 2^20 < 2^31). Padding/invalid edge slots carry
+    edge_metric == INF_DIST exactly.
+  * overload (no-transit) is a per-edge boolean `blocked`; the SPF root's
+    own out-edges are exempted at init (reference: SpfSolver † lets an
+    overloaded node source/sink traffic, never transit it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.common import constants as _C
+from openr_tpu.common.util import pad_bucket as pad_batch  # roots bucket
+
+# Single source of truth for the solver numeric contract lives in
+# common/constants.py (shared with the CSR builder and the oracle clamp).
+INF_DIST = np.int32(_C.DIST_INF)
+METRIC_MAX = np.int32(_C.METRIC_MAX)
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes",))
+def batched_sssp(
+    edge_src: jax.Array,  # [Ep] i32
+    edge_dst: jax.Array,  # [Ep] i32, ascending (padding → dead slot)
+    edge_metric: jax.Array,  # [Ep] i32; valid ≤ METRIC_MAX, padding == INF_DIST
+    edge_blocked: jax.Array,  # [Ep] bool: padding ∪ overloaded-src edges
+    roots: jax.Array,  # [B] i32 node id per batch column (may repeat)
+    num_nodes: int,  # static: padded node count Vp
+) -> jax.Array:
+    """Distances from each root: dist [Vp, B] int32 (INF_DIST = unreachable).
+
+    `edge_blocked` must already contain the overloaded-transit edges
+    (see `build_blocked`); the root exemption — an overloaded root may still
+    relax its own out-edges — happens here at init.
+    """
+    metric = edge_metric.astype(jnp.int32)
+
+    # Init: penalty-free relax of each root's own out-edges (padding slots
+    # have metric == INF_DIST so they contribute nothing), then dist=0 at
+    # the root itself. Blocked edges never relax after this point — which is
+    # exactly the "overloaded nodes don't transit" rule.
+    is_root_edge = edge_src[:, None] == roots[None, :]  # [Ep, B]
+    init_cand = jnp.where(is_root_edge, metric[:, None], INF_DIST)
+    dist = jax.ops.segment_min(
+        init_cand,
+        edge_dst,
+        num_segments=num_nodes,
+        indices_are_sorted=True,
+    )
+    dist = jnp.minimum(dist, INF_DIST)
+    dist = dist.at[roots, jnp.arange(roots.shape[0])].set(0)
+
+    usable = (~edge_blocked)[:, None]  # [Ep, 1]
+
+    def relax(state):
+        dist, _changed, it = state
+        d_src = dist[edge_src]  # [Ep, B] gather
+        cand = jnp.where(
+            usable & (d_src < INF_DIST),
+            d_src + metric[:, None],
+            INF_DIST,
+        )
+        new = jax.ops.segment_min(
+            cand,
+            edge_dst,
+            num_segments=num_nodes,
+            indices_are_sorted=True,
+        )
+        new = jnp.minimum(new, dist)
+        return new, jnp.any(new < dist), it + 1
+
+    def cond(state):
+        _dist, changed, it = state
+        return changed & (it < num_nodes)
+
+    dist, _, _ = jax.lax.while_loop(cond, relax, (dist, jnp.bool_(True), 0))
+    return dist
+
+
+@jax.jit
+def first_hop_matrix(
+    dist: jax.Array,  # [Vp, B]: col 0 = root, cols 1..N = its neighbors
+    neighbor_metric: jax.Array,  # [N] i32 metric(root → neighbor i)
+    neighbor_ids: jax.Array,  # [N] i32 node id of neighbor i
+    neighbor_overloaded: jax.Array,  # [N] bool
+) -> jax.Array:
+    """ECMP first-hop validity: valid[n, d] ⇔ neighbor n is a shortest-path
+    first hop from the root toward destination node d.
+
+    The identity: n is a valid first hop for d iff
+        metric(root→n) + dist_n(d) == dist_root(d).
+    No predecessor bookkeeping needed (the reference instead collects all
+    equal-cost parents inside Dijkstra: LinkState.cpp † runSpf); the same
+    ECMP DAG is recovered from the distance matrix by elementwise compare —
+    and the neighbor-rooted rows double as the LFA backup-path inputs.
+
+    Overloaded neighbors are excluded for every destination except
+    themselves (no-transit, destination still reachable).
+    """
+    d_root = dist[:, 0]  # [Vp]
+    d_nbr = dist[:, 1 : 1 + neighbor_ids.shape[0]]  # [Vp, N]
+    reach = (d_root < INF_DIST)[:, None] & (d_nbr < INF_DIST)
+    on_spt = reach & (neighbor_metric[None, :] + d_nbr == d_root[:, None])
+    dest_is_nbr = jnp.arange(dist.shape[0])[:, None] == neighbor_ids[None, :]
+    allowed = ~neighbor_overloaded[None, :] | dest_is_nbr
+    return (on_spt & allowed).T  # [N, Vp]
+
+
+def build_dense_tables(
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_metric: np.ndarray,
+    num_nodes_padded: int,
+    min_width: int = 8,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Dense in-neighbor tables: nbr[Vp, D] i32, wgt[Vp, D] i32 (INF pad).
+
+    TPU rationale: `segment_min` lowers to a scatter-min, which serializes
+    on TPU (~45 ms per relax over 2M edges measured on v5e). Rewriting the
+    relax as   dist_new[v] = min_d dist[nbr[v, d]] + wgt[v, d]   turns it
+    into a row gather + axis-min — no scatter at all — and measured ~2-4x
+    faster end-to-end, with the further upside that gather cost scales with
+    *rows gathered*, so degree-aware packing can shrink it again.
+
+    Requires edge arrays sorted by dst (the CsrGraph layout). D is the
+    next power of two ≥ max in-degree.
+    """
+    valid = edge_metric < int(INF_DIST)
+    src = edge_src[valid].astype(np.int64)
+    dst = edge_dst[valid].astype(np.int64)
+    met = edge_metric[valid]
+    e = src.shape[0]
+    indeg = np.bincount(dst, minlength=num_nodes_padded)
+    max_deg = int(indeg.max()) if e else 1
+    d_width = min_width
+    while d_width < max_deg:
+        d_width <<= 1
+    nbr = np.zeros((num_nodes_padded, d_width), dtype=np.int32)
+    wgt = np.full((num_nodes_padded, d_width), INF_DIST, dtype=np.int32)
+    if e:
+        # column slot for edge i = i - first_index_of(dst[i]) (dst-sorted)
+        row_start = np.zeros(num_nodes_padded + 1, dtype=np.int64)
+        np.add.at(row_start, dst + 1, 1)
+        row_start = np.cumsum(row_start)
+        col = np.arange(e, dtype=np.int64) - row_start[dst]
+        nbr[dst, col] = src.astype(np.int32)
+        wgt[dst, col] = met
+    return nbr, wgt
+
+
+@functools.partial(jax.jit, static_argnames=("has_overloads",))
+def batched_sssp_dense(
+    nbr: jax.Array,  # [Vp, D] i32 in-neighbor ids (0 + INF wgt for padding)
+    wgt: jax.Array,  # [Vp, D] i32 metric; INF_DIST padding
+    node_overloaded: jax.Array,  # [Vp] bool
+    roots: jax.Array,  # [B] i32
+    has_overloads: bool = True,
+) -> jax.Array:
+    """Dense-table batched SSSP → dist [Vp, B] int32 (see build_dense_tables).
+
+    The overloaded-transit rule is a fused per-element mask here — an edge
+    from an overloaded node relaxes only in the batch column whose root IS
+    that node — which also subsumes the root-exemption init of the edge-list
+    kernel (`has_overloads=False` drops the mask entirely: the common case).
+    """
+    num_nodes = nbr.shape[0]
+    b = roots.shape[0]
+    dist = jnp.full((num_nodes, b), INF_DIST, jnp.int32)
+    dist = dist.at[roots, jnp.arange(b)].set(0)
+
+    if has_overloads:
+        over_t = node_overloaded[nbr]  # [Vp, D] src-overloaded
+
+    def relax(state):
+        dist, _changed, it = state
+        d = dist[nbr]  # [Vp, D, B] row gather
+        cand = jnp.where(d < INF_DIST, d + wgt[:, :, None], INF_DIST)
+        if has_overloads:
+            blocked = over_t[:, :, None] & (
+                nbr[:, :, None] != roots[None, None, :]
+            )
+            cand = jnp.where(blocked, INF_DIST, cand)
+        new = jnp.minimum(cand.min(axis=1), dist)
+        return new, jnp.any(new < dist), it + 1
+
+    def cond(state):
+        _dist, changed, it = state
+        return changed & (it < num_nodes)
+
+    dist, _, _ = jax.lax.while_loop(cond, relax, (dist, jnp.bool_(True), 0))
+    return dist
+
+
+def build_blocked(
+    edge_metric: np.ndarray,
+    edge_src: np.ndarray,
+    node_overloaded: np.ndarray,
+) -> np.ndarray:
+    """Host-side: edges that can never carry transit traffic — padding /
+    invalid slots plus every edge leaving an overloaded node (the per-root
+    exemption happens inside the kernel init)."""
+    return (edge_metric >= int(INF_DIST)) | node_overloaded[edge_src]
+
+
+def all_sources_sssp(
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    edge_metric: jax.Array,
+    edge_blocked: jax.Array,
+    num_nodes: int,
+    chunk: int = 256,
+) -> np.ndarray:
+    """Distances from every node (BASELINE config 3), chunked over sources to
+    bound the [Ep, B] relax intermediate in HBM. Returns [V, V] (row = src).
+    """
+    rows = []
+    for start in range(0, num_nodes, chunk):
+        b = min(chunk, num_nodes - start)
+        roots = jnp.arange(start, start + b, dtype=jnp.int32)
+        if b < chunk:  # keep jit shapes stable on the tail chunk
+            roots = jnp.pad(roots, (0, chunk - b))
+        d = batched_sssp(
+            edge_src, edge_dst, edge_metric, edge_blocked, roots, num_nodes
+        )
+        rows.append(np.asarray(d[:, :b]).T)
+    return np.concatenate(rows, axis=0)

@@ -1,0 +1,34 @@
+"""Device mesh construction for the SPF shardings."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+SOURCES_AXIS = "sources"
+GRAPH_AXIS = "graph"
+
+
+def make_mesh(
+    n_sources: int | None = None,
+    n_graph: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """2D mesh (sources × graph) over the available devices.
+
+    Defaults put every device on the `sources` axis (pure batch
+    parallelism — no collectives on the hot path). `n_graph > 1` carves
+    devices for edge-partitioned SPF (pmin all-reduce per iteration); on
+    real hardware keep `graph` on the minor axis so the all-reduce rides
+    ICI neighbors.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if n_sources is None:
+        n_sources = len(devs) // n_graph
+    assert n_sources * n_graph <= len(devs), (
+        f"mesh {n_sources}x{n_graph} needs more than {len(devs)} devices"
+    )
+    arr = np.array(devs[: n_sources * n_graph]).reshape(n_sources, n_graph)
+    return Mesh(arr, (SOURCES_AXIS, GRAPH_AXIS))

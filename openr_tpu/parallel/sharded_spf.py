@@ -1,0 +1,94 @@
+"""Sharded batched SSSP: sources × graph partitioning under shard_map.
+
+The single-device kernel (`ops/spf.py`) already vectorizes over SPF roots;
+here the same relax-to-fixpoint runs SPMD:
+
+  * roots sharded over the ``sources`` mesh axis — each device solves its
+    slice of roots independently (no communication);
+  * the edge list sharded over the ``graph`` mesh axis — each device relaxes
+    its edge partition and the partial per-node minima are combined with an
+    ICI ``lax.pmin`` all-reduce every iteration (the frontier exchange; the
+    moral equivalent of the reference's KvStore flood is host-side — this is
+    purely the compute-plane collective).
+
+Distances stay replicated across the ``graph`` axis (Vp·B int32 — the edge
+arrays dominate HBM, which is exactly what the graph axis shards), so the
+fixpoint condition is computed identically on every shard: no extra
+convergence collective needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from openr_tpu.ops.spf import INF_DIST
+from openr_tpu.parallel.mesh import GRAPH_AXIS, SOURCES_AXIS
+
+
+def _local_sssp(edge_src, edge_dst, edge_metric, edge_blocked, roots, num_nodes):
+    """Per-device body: local edge shard, local root slice, pmin across the
+    graph axis after every segmented relax."""
+    metric = edge_metric.astype(jnp.int32)
+
+    is_root_edge = edge_src[:, None] == roots[None, :]
+    init_cand = jnp.where(is_root_edge, metric[:, None], INF_DIST)
+    dist = jax.ops.segment_min(
+        init_cand, edge_dst, num_segments=num_nodes, indices_are_sorted=True
+    )
+    dist = jax.lax.pmin(jnp.minimum(dist, INF_DIST), GRAPH_AXIS)
+    dist = dist.at[roots, jnp.arange(roots.shape[0])].set(0)
+
+    usable = (~edge_blocked)[:, None]
+
+    def relax(state):
+        dist, _changed, it = state
+        d_src = dist[edge_src]
+        cand = jnp.where(
+            usable & (d_src < INF_DIST), d_src + metric[:, None], INF_DIST
+        )
+        new = jax.ops.segment_min(
+            cand, edge_dst, num_segments=num_nodes, indices_are_sorted=True
+        )
+        new = jax.lax.pmin(new, GRAPH_AXIS)  # frontier exchange over ICI
+        new = jnp.minimum(new, dist)
+        return new, jnp.any(new < dist), it + 1
+
+    def cond(state):
+        _dist, changed, it = state
+        return changed & (it < num_nodes)
+
+    dist, _, _ = jax.lax.while_loop(cond, relax, (dist, jnp.bool_(True), 0))
+    return dist
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "num_nodes")
+)
+def sharded_sssp(
+    edge_src: jax.Array,  # [Ep] — Ep must divide by the graph axis size
+    edge_dst: jax.Array,
+    edge_metric: jax.Array,
+    edge_blocked: jax.Array,
+    roots: jax.Array,  # [B] — B must divide by the sources axis size
+    mesh: Mesh,
+    num_nodes: int,
+) -> jax.Array:
+    """Returns dist [Vp, B] (B sharded over `sources`, rows replicated)."""
+    fn = jax.shard_map(
+        functools.partial(_local_sssp, num_nodes=num_nodes),
+        mesh=mesh,
+        in_specs=(
+            P(GRAPH_AXIS),
+            P(GRAPH_AXIS),
+            P(GRAPH_AXIS),
+            P(GRAPH_AXIS),
+            P(SOURCES_AXIS),
+        ),
+        out_specs=P(None, SOURCES_AXIS),
+        check_vma=False,
+    )
+    return fn(edge_src, edge_dst, edge_metric, edge_blocked, roots)

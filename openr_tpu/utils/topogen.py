@@ -140,6 +140,53 @@ def fat_tree(k: int = 4, metric: int = 1):
     return _mk_dbs(n, edges)
 
 
+def erdos_renyi_csr(
+    n: int, avg_degree: int = 10, seed: int = 0, max_metric: int = 16
+):
+    """Large-scale variant that skips dataclasses entirely: returns padded
+    CSR arrays (edge_src, edge_dst, edge_metric, padded_nodes) directly.
+    Used by bench.py for the 100k-node/1M-edge BASELINE config, where
+    building millions of Adjacency objects would dominate the benchmark
+    setup. Same graph family as `erdos_renyi` (backbone ring + chords).
+    """
+    from openr_tpu.common.constants import DIST_INF
+    from openr_tpu.decision.linkstate import pad_bucket
+
+    rng = np.random.default_rng(seed)
+    target = n * avg_degree // 2
+    ring_u = np.arange(n, dtype=np.int64)
+    ring_v = (ring_u + 1) % n
+    us = rng.integers(0, n, size=int(2.2 * target))
+    vs = rng.integers(0, n, size=int(2.2 * target))
+    keep = us != vs
+    us, vs = us[keep], vs[keep]
+    u_all = np.concatenate([ring_u, us])
+    v_all = np.concatenate([ring_v, vs])
+    lo, hi = np.minimum(u_all, v_all), np.maximum(u_all, v_all)
+    key = lo * n + hi
+    _, first_idx = np.unique(key, return_index=True)
+    first_idx = np.sort(first_idx)[: target + n]
+    lo, hi = lo[first_idx], hi[first_idx]
+    metric = rng.integers(1, max_metric + 1, size=lo.shape[0])
+
+    src = np.concatenate([lo, hi]).astype(np.int32)
+    dst = np.concatenate([hi, lo]).astype(np.int32)
+    met = np.concatenate([metric, metric]).astype(np.int32)
+    order = np.argsort(dst, kind="stable")
+    src, dst, met = src[order], dst[order], met[order]
+
+    e = src.shape[0]
+    vp = pad_bucket(n + 1)
+    ep = pad_bucket(e, minimum=128)
+    edge_src = np.zeros(ep, dtype=np.int32)
+    edge_dst = np.full(ep, vp - 1, dtype=np.int32)
+    edge_metric = np.full(ep, DIST_INF, dtype=np.int32)
+    edge_src[:e] = src
+    edge_dst[:e] = dst
+    edge_metric[:e] = met
+    return edge_src, edge_dst, edge_metric, vp, n, e
+
+
 def erdos_renyi(n: int, avg_degree: int = 10, seed: int = 0, max_metric: int = 16):
     """Random graph with ~n*avg_degree/2 undirected edges (BASELINE config 3).
 

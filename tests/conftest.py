@@ -1,18 +1,31 @@
 """Test harness config.
 
-Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported
-anywhere, so sharding/pjit tests exercise real multi-device code paths
-without TPU hardware (the driver separately dry-runs the multi-chip path).
+Force JAX onto a virtual 8-device CPU platform so sharding/pjit tests
+exercise real multi-device code paths without TPU hardware (the driver
+separately dry-runs the multi-chip path, and bench.py uses the real chip).
+
+Note: the environment's axon boot (sitecustomize on PYTHONPATH) registers
+the TPU plugin at interpreter start and sets jax_platforms="axon,cpu", so
+setting the env var alone is not enough — we override the config explicitly
+before any backend initialization.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.devices()}"

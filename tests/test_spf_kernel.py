@@ -1,0 +1,205 @@
+"""TPU SPF kernel tests: the RIB-equivalence gate.
+
+The contract (SURVEY §7 step 3): `TpuSpfSolver.compute_routes` output must
+EQUAL the oracle's `compute_routes` — full RouteDatabase equality (nexthop
+sets, metrics, MPLS actions) — across golden and randomized topologies,
+including overload and unreachability scenarios. Runs on the CPU backend
+with 8 virtual devices (conftest); the same code path runs on TPU.
+"""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.oracle import compute_routes as oracle_routes
+from openr_tpu.decision.oracle import run_spf
+from openr_tpu.decision.spf_backend import TpuSpfSolver
+from openr_tpu.ops.spf import (
+    INF_DIST,
+    all_sources_sssp,
+    batched_sssp,
+    build_blocked,
+)
+from openr_tpu.types.topology import AdjacencyDatabase
+from openr_tpu.utils import topogen
+
+
+def _state(adj_dbs, prefix_dbs):
+    ls, ps = LinkState(), PrefixState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    for db in prefix_dbs:
+        ps.update_prefix_db(db)
+    return ls, ps
+
+
+def _overload(db: AdjacencyDatabase) -> AdjacencyDatabase:
+    return AdjacencyDatabase(
+        this_node_name=db.this_node_name,
+        adjacencies=db.adjacencies,
+        is_overloaded=True,
+        node_label=db.node_label,
+        area=db.area,
+    )
+
+
+def _assert_rib_equal(ls, ps, node):
+    want = oracle_routes(ls, ps, node)
+    # both kernel paths (dense in-neighbor table and edge-list segment-min)
+    # must match the oracle exactly
+    for use_dense in (True, False):
+        got = TpuSpfSolver(use_dense=use_dense).compute_routes(ls, ps, node)
+        assert got.unicast_routes == want.unicast_routes, (node, use_dense)
+        assert got.mpls_routes == want.mpls_routes, (node, use_dense)
+
+
+TOPOLOGIES = {
+    "ring4": lambda: topogen.ring(4),
+    "ring5": lambda: topogen.ring(5),
+    "grid4x4": lambda: topogen.grid(4, 4),
+    "fat_tree_k4": lambda: topogen.fat_tree(4),
+    "er60": lambda: topogen.erdos_renyi(60, avg_degree=5, seed=7),
+    "er40_weighted": lambda: topogen.erdos_renyi(40, avg_degree=4, seed=3, max_metric=1000),
+}
+
+
+@pytest.mark.parametrize("name", sorted(TOPOLOGIES))
+def test_rib_equivalence(name):
+    adj_dbs, prefix_dbs = TOPOLOGIES[name]()
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    # check several vantage points, not just node-0
+    nodes = ls.nodes
+    for node in {nodes[0], nodes[len(nodes) // 2], nodes[-1]}:
+        _assert_rib_equal(ls, ps, node)
+
+
+def test_rib_equivalence_with_overloaded_transit():
+    adj_dbs, prefix_dbs = topogen.grid(4, 4)
+    # overload two middle nodes — forces detours
+    for i in (5, 10):
+        adj_dbs[i] = _overload(adj_dbs[i])
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    for node in ("node-0", "node-5", "node-15"):
+        _assert_rib_equal(ls, ps, node)
+
+
+def test_rib_equivalence_overloaded_self():
+    adj_dbs, prefix_dbs = topogen.ring(6)
+    adj_dbs[0] = _overload(adj_dbs[0])
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    _assert_rib_equal(ls, ps, "node-0")  # overloaded root still routes out
+    _assert_rib_equal(ls, ps, "node-3")
+
+
+def test_rib_equivalence_partitioned():
+    # two disjoint rings in one LSDB: routes only within the partition
+    a_adj, a_pfx = topogen.ring(4)
+    edges = [(0, 1, 1), (1, 0, 1), (1, 2, 1), (2, 1, 1)]
+    b_adj, b_pfx = topogen._mk_dbs(3, edges)
+    renamed_adj, renamed_pfx = [], []
+    for db in b_adj:
+        renamed_adj.append(
+            AdjacencyDatabase(
+                this_node_name="x-" + db.this_node_name,
+                adjacencies=tuple(
+                    type(a)(
+                        other_node_name="x-" + a.other_node_name,
+                        if_name=a.if_name,
+                        other_if_name=a.other_if_name,
+                        metric=a.metric,
+                    )
+                    for a in db.adjacencies
+                ),
+                node_label=db.node_label + 500,
+            )
+        )
+    ls, ps = _state(a_adj + renamed_adj, a_pfx)
+    _assert_rib_equal(ls, ps, "node-0")
+    _assert_rib_equal(ls, ps, "x-node-0")
+
+
+def test_kernel_dist_matches_oracle_random():
+    """Raw distance matrix vs oracle Dijkstra on weighted random graphs,
+    including overloaded transit nodes."""
+    rng = np.random.default_rng(0)
+    for seed in range(3):
+        adj_dbs, _ = topogen.erdos_renyi(50, avg_degree=4, seed=seed, max_metric=64)
+        over = rng.choice(50, size=5, replace=False)
+        for i in over:
+            adj_dbs[i] = _overload(adj_dbs[i])
+        ls = LinkState()
+        for db in adj_dbs:
+            ls.update_adjacency_db(db)
+        csr = ls.to_csr()
+        blocked = build_blocked(csr.edge_metric, csr.edge_src, csr.node_overloaded)
+        dist = all_sources_sssp(
+            csr.edge_src, csr.edge_dst, csr.edge_metric, blocked,
+            csr.padded_nodes, chunk=64,
+        )
+        for root in ls.nodes[::7]:
+            res = run_spf(ls, root)
+            rid = csr.name_to_id[root]
+            for n, i in csr.name_to_id.items():
+                want = res.dist.get(n)
+                got = int(dist[rid, i])
+                if want is None:
+                    assert got >= INF_DIST, (root, n)
+                else:
+                    assert got == want, (root, n)
+
+
+def test_rib_equivalence_metric_above_clamp():
+    """Metrics above METRIC_MAX are clamped identically by the kernel path
+    and the oracle (regression: the first-hop identity must use the clamped
+    metric or routes silently vanish at the clamp boundary)."""
+    from openr_tpu.common.constants import METRIC_MAX
+
+    adj_dbs, prefix_dbs = topogen.ring(4, metric=METRIC_MAX + 5)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    _assert_rib_equal(ls, ps, "node-0")
+    want = oracle_routes(ls, ps, "node-0")
+    assert want.unicast_routes  # routes must actually exist
+
+
+def test_dense_selection_avoids_mega_hub_blowup():
+    """A star topology (one hub with huge degree) must auto-select the
+    edge-list kernel without materializing the V*D dense tables."""
+    n = 40
+    edges = []
+    for i in range(1, n):
+        edges += [(0, i, 1), (i, 0, 1)]
+    adj_dbs, prefix_dbs = topogen._mk_dbs(n, edges)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    csr = ls.to_csr()
+    solver = TpuSpfSolver(dense_waste_limit=1)  # force the size check to trip
+    assert csr.dense_width() >= 32
+    _ = solver.compute_routes(ls, ps, "node-1")
+    assert csr._dense is None  # tables were never built
+    _assert_rib_equal(ls, ps, "node-1")
+
+
+def test_kernel_repeated_roots_and_padding():
+    adj_dbs, _ = topogen.ring(4)
+    ls = LinkState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    csr = ls.to_csr()
+    import jax.numpy as jnp
+
+    blocked = build_blocked(csr.edge_metric, csr.edge_src, csr.node_overloaded)
+    roots = jnp.asarray(np.array([0, 0, 2, 2], dtype=np.int32))
+    dist = np.asarray(
+        batched_sssp(
+            jnp.asarray(csr.edge_src),
+            jnp.asarray(csr.edge_dst),
+            jnp.asarray(csr.edge_metric),
+            jnp.asarray(blocked),
+            roots,
+            csr.padded_nodes,
+        )
+    )
+    assert (dist[:, 0] == dist[:, 1]).all()
+    assert (dist[:, 2] == dist[:, 3]).all()
+    assert dist[0, 0] == 0 and dist[2, 0] == 2
+    # dead padding node slots stay unreachable
+    assert (dist[csr.num_nodes :, :] >= INF_DIST).all()
