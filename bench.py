@@ -55,8 +55,10 @@ def main() -> None:
     nbr, wgt = build_dense_tables(edge_src, edge_dst, edge_metric, vp)
 
     # SPF batch for one node's RIB rebuild: self + its neighbors
+    from openr_tpu.common.constants import DIST_INF
+
     me = 0
-    valid = edge_metric < (1 << 30)
+    valid = edge_metric < DIST_INF
     nbrs = np.unique(edge_dst[(edge_src == me) & valid])
     b = pad_batch(1 + len(nbrs))
     roots = np.full(b, me, dtype=np.int32)

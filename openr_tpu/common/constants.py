@@ -58,10 +58,16 @@ SR_LOCAL_RANGE = (50_000, 59_999)  # adjacency labels
 DEFAULT_AREA = "0"
 
 # Solver numeric contract (shared by the CSR builder, the TPU kernel, and
-# the oracle): int32 distances, INF sentinel, metric clamp such that
-# INF + METRIC_MAX < 2^31 (no int32 overflow in the relax step).
+# the oracle): int32 distances with INF sentinel 2^30. Valid metrics are
+# clamped to METRIC_MAX = 2^30-1 (covers the reference's practical metric
+# range incl. RTT-us); the relax step computes min(dist + metric, INF)
+# guarded by dist < INF, so the sum is at most (2^30-1) + 2^30 = 2^31-1 ==
+# INT32_MAX — no wraparound. (uint32 would allow one more bit but hangs
+# the axon TPU backend.) Path costs saturate at INF (treated as
+# unreachable); the oracle applies the identical clamp and saturation so
+# RIB equality is exact.
 DIST_INF = 1 << 30
-METRIC_MAX = (1 << 20) - 1
+METRIC_MAX = (1 << 30) - 1
 
 # ---- Watchdog (reference: openr/watchdog/Watchdog.cpp †) -------------------
 WATCHDOG_INTERVAL_S = 20

@@ -62,6 +62,7 @@ class CsrGraph:
     adj_details: dict[tuple[int, int], list[tuple[str, int, int, int, str]]]
     name_to_id: dict[str, int]
     _dense: tuple[np.ndarray, np.ndarray] | None = None
+    _dense_width: int | None = None
 
     @property
     def padded_nodes(self) -> int:
@@ -72,16 +73,21 @@ class CsrGraph:
         return len(self.edge_src)
 
     def dense_width(self) -> int:
-        """D of the dense tables WITHOUT building them (O(E) bincount) —
-        used to decide dense-vs-edge-list before committing the memory."""
-        valid = self.edge_metric < DIST_INF
-        if not valid.any():
-            return 8
-        indeg = np.bincount(
-            self.edge_dst[valid].astype(np.int64),
-            minlength=self.padded_nodes,
-        )
-        return pad_bucket(int(indeg.max()), minimum=8)
+        """D of the dense tables WITHOUT building them (cached O(E)
+        bincount) — used to decide dense-vs-edge-list before committing
+        the memory. Safe to cache: CsrGraph is immutable (LinkState drops
+        the whole object on any topology change)."""
+        if self._dense_width is None:
+            valid = self.edge_metric < DIST_INF
+            if not valid.any():
+                self._dense_width = 8
+            else:
+                indeg = np.bincount(
+                    self.edge_dst[valid].astype(np.int64),
+                    minlength=self.padded_nodes,
+                )
+                self._dense_width = pad_bucket(int(indeg.max()), minimum=8)
+        return self._dense_width
 
     def dense_tables(self) -> tuple[np.ndarray, np.ndarray]:
         """Cached dense in-neighbor tables (see ops.spf.build_dense_tables)."""

@@ -26,7 +26,7 @@ from __future__ import annotations
 import heapq
 from dataclasses import dataclass
 
-from openr_tpu.common.constants import METRIC_MAX, MPLS_LABEL_MIN
+from openr_tpu.common.constants import DIST_INF, METRIC_MAX, MPLS_LABEL_MIN
 from openr_tpu.decision.linkstate import LinkState, PrefixState
 from openr_tpu.types.network import (
     MplsAction,
@@ -97,6 +97,8 @@ def run_spf(
             continue  # no transit through an overloaded node
         for v, w in adj.get(u, {}).items():
             nd = d + w
+            if nd >= DIST_INF:
+                continue  # saturate: same unreachability cutoff as kernel
             if v not in dist or nd < dist[v]:
                 dist[v] = nd
                 preds[v] = {u}

@@ -19,11 +19,13 @@ of pure elementwise comparisons on the resulting distance matrix
 Layout notes (TPU):
   * node-major [Vp, B] / edge-major [Ep, B]: B is the minor (lane) dim;
     pad B to a multiple of 8 — callers use `pad_batch`.
-  * distances are **int32** (exact integer metrics, like the reference's int
-    metrics). INF_DIST = 2^30; valid metrics are ≤ METRIC_MAX = 2^20-1
-    (enforced by the CSR builder), so `dist + metric` can never overflow
-    int32 (2^30 + 2^20 < 2^31). Padding/invalid edge slots carry
-    edge_metric == INF_DIST exactly.
+  * distances are **int32** (exact integer metrics, like the reference's
+    int metrics). INF_DIST = 2^30; valid metrics ≤ METRIC_MAX = 2^30-1
+    (clamped by the CSR builder — covers the reference's practical metric
+    range), and the relax computes min(dist + metric, INF) guarded by
+    dist < INF, so the sum never exceeds INT32_MAX — no overflow. Path
+    costs saturate at INF (≥ INF ⇒ unreachable); the oracle saturates
+    identically. Padding slots carry edge_metric == INF_DIST exactly.
   * overload (no-transit) is a per-edge boolean `blocked`; the SPF root's
     own out-edges are exempted at init (reference: SpfSolver † lets an
     overloaded node source/sink traffic, never transit it).
@@ -44,6 +46,7 @@ from openr_tpu.common.util import pad_bucket as pad_batch  # roots bucket
 # common/constants.py (shared with the CSR builder and the oracle clamp).
 INF_DIST = np.int32(_C.DIST_INF)
 METRIC_MAX = np.int32(_C.METRIC_MAX)
+DIST_DTYPE = jnp.int32
 
 
 @functools.partial(jax.jit, static_argnames=("num_nodes",))
@@ -61,7 +64,7 @@ def batched_sssp(
     (see `build_blocked`); the root exemption — an overloaded root may still
     relax its own out-edges — happens here at init.
     """
-    metric = edge_metric.astype(jnp.int32)
+    metric = edge_metric.astype(DIST_DTYPE)
 
     # Init: penalty-free relax of each root's own out-edges (padding slots
     # have metric == INF_DIST so they contribute nothing), then dist=0 at
@@ -85,7 +88,7 @@ def batched_sssp(
         d_src = dist[edge_src]  # [Ep, B] gather
         cand = jnp.where(
             usable & (d_src < INF_DIST),
-            d_src + metric[:, None],
+            jnp.minimum(d_src + metric[:, None], INF_DIST),
             INF_DIST,
         )
         new = jax.ops.segment_min(
@@ -160,9 +163,7 @@ def build_dense_tables(
     e = src.shape[0]
     indeg = np.bincount(dst, minlength=num_nodes_padded)
     max_deg = int(indeg.max()) if e else 1
-    d_width = min_width
-    while d_width < max_deg:
-        d_width <<= 1
+    d_width = pad_batch(max_deg, minimum=min_width)  # shared pad_bucket
     nbr = np.zeros((num_nodes_padded, d_width), dtype=np.int32)
     wgt = np.full((num_nodes_padded, d_width), INF_DIST, dtype=np.int32)
     if e:
@@ -193,7 +194,7 @@ def batched_sssp_dense(
     """
     num_nodes = nbr.shape[0]
     b = roots.shape[0]
-    dist = jnp.full((num_nodes, b), INF_DIST, jnp.int32)
+    dist = jnp.full((num_nodes, b), INF_DIST, DIST_DTYPE)
     dist = dist.at[roots, jnp.arange(b)].set(0)
 
     if has_overloads:
@@ -202,7 +203,9 @@ def batched_sssp_dense(
     def relax(state):
         dist, _changed, it = state
         d = dist[nbr]  # [Vp, D, B] row gather
-        cand = jnp.where(d < INF_DIST, d + wgt[:, :, None], INF_DIST)
+        cand = jnp.where(
+            d < INF_DIST, jnp.minimum(d + wgt[:, :, None], INF_DIST), INF_DIST
+        )
         if has_overloads:
             blocked = over_t[:, :, None] & (
                 nbr[:, :, None] != roots[None, None, :]

@@ -48,7 +48,9 @@ def _local_sssp(edge_src, edge_dst, edge_metric, edge_blocked, roots, num_nodes)
         dist, _changed, it = state
         d_src = dist[edge_src]
         cand = jnp.where(
-            usable & (d_src < INF_DIST), d_src + metric[:, None], INF_DIST
+            usable & (d_src < INF_DIST),
+            jnp.minimum(d_src + metric[:, None], INF_DIST),
+            INF_DIST,
         )
         new = jax.ops.segment_min(
             cand, edge_dst, num_segments=num_nodes, indices_are_sorted=True

@@ -148,6 +148,32 @@ def test_kernel_dist_matches_oracle_random():
                     assert got == want, (root, n)
 
 
+def test_large_metrics_no_inversion():
+    """Metrics in the millions (RTT-us style) must not be clamped into
+    path-selection inversion (regression: old METRIC_MAX=2^20 clamp made a
+    2x2.0M path beat a 3x1.2M path).
+
+    Topology: 0→1→4 with metric 2,000,000 each (cost 4.0M) vs
+    0→2→3→4 with metric 1,200,000 each (cost 3.6M — correct winner)."""
+    edges = [
+        (0, 1, 2_000_000), (1, 0, 2_000_000),
+        (1, 4, 2_000_000), (4, 1, 2_000_000),
+        (0, 2, 1_200_000), (2, 0, 1_200_000),
+        (2, 3, 1_200_000), (3, 2, 1_200_000),
+        (3, 4, 1_200_000), (4, 3, 1_200_000),
+    ]
+    adj_dbs, prefix_dbs = topogen._mk_dbs(5, edges)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    for use_dense in (True, False):
+        got = TpuSpfSolver(use_dense=use_dense).compute_routes(
+            ls, ps, "node-0"
+        )
+        r = got.unicast_routes[topogen.loopback(4)]
+        assert r.igp_cost == 3_600_000, (use_dense, r.igp_cost)
+        assert {nh.neighbor_node for nh in r.nexthops} == {"node-2"}
+    _assert_rib_equal(ls, ps, "node-0")
+
+
 def test_rib_equivalence_metric_above_clamp():
     """Metrics above METRIC_MAX are clamped identically by the kernel path
     and the oracle (regression: the first-hop identity must use the clamped
