@@ -1,0 +1,43 @@
+"""Exponential backoff (reference: openr/common/ExponentialBackoff.{h,cpp} †).
+
+Used by LinkMonitor for link-flap damping and by Fib for programming
+retries — same double-on-error / reset-on-success contract as upstream.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ExponentialBackoff:
+    def __init__(self, initial_ms: float, max_ms: float):
+        assert 0 < initial_ms <= max_ms
+        self.initial_ms = initial_ms
+        self.max_ms = max_ms
+        self._current_ms = 0.0
+        self._last_error_at = 0.0
+
+    def report_error(self) -> None:
+        """Double the backoff (bounded by max)."""
+        self._current_ms = min(
+            self.max_ms, max(self.initial_ms, self._current_ms * 2)
+        )
+        self._last_error_at = time.monotonic()
+
+    def report_success(self) -> None:
+        self._current_ms = 0.0
+
+    @property
+    def has_error(self) -> bool:
+        return self._current_ms > 0
+
+    def time_remaining_s(self) -> float:
+        """Seconds until retry is allowed (0 = now)."""
+        if self._current_ms == 0:
+            return 0.0
+        elapsed = time.monotonic() - self._last_error_at
+        return max(0.0, self._current_ms / 1e3 - elapsed)
+
+    @property
+    def current_ms(self) -> float:
+        return self._current_ms

@@ -1,0 +1,132 @@
+"""Module runtime: the OpenrEventBase equivalent.
+
+reference: openr/common/OpenrEventBase.{h,cpp} † — every module is an
+event loop with timers and fibers, started/stopped by Main in dependency
+order, stamping a heartbeat the Watchdog checks. Here a module is a set of
+asyncio tasks on the process loop; the lifecycle (start → run fibers →
+stop cancels fibers in order) and the watchdog heartbeat survive the
+translation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable, Coroutine
+
+log = logging.getLogger(__name__)
+
+
+class OpenrModule:
+    """Base class for all control-plane modules.
+
+    Subclasses override `main()` (long-running fibers are spawned with
+    `self.spawn`) and `cleanup()`. `run_every` registers periodic timers
+    (reference: OpenrEventBase::scheduleTimeout loops †).
+    """
+
+    def __init__(self, name: str, counters=None):
+        self.name = name
+        self.counters = counters
+        self._tasks: dict[asyncio.Task, None] = {}  # insertion-ordered set
+        self._stopped = asyncio.Event()
+        self._started = False
+        self.last_heartbeat = time.monotonic()
+
+    # ---- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        assert not self._started, f"{self.name} started twice"
+        self._started = True
+        self.spawn(self._heartbeat_loop(), name=f"{self.name}.heartbeat")
+        await self.main()
+        log.debug("module %s started", self.name)
+
+    async def stop(self) -> None:
+        """Cancel all fibers and run cleanup (idempotent)."""
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        live = list(self._tasks)
+        for t in reversed(live):
+            t.cancel()
+        for t in live:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks.clear()
+        await self.cleanup()
+        log.debug("module %s stopped", self.name)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    # ---- overridables -----------------------------------------------------
+
+    async def main(self) -> None:
+        """Spawn long-running fibers; called once by start()."""
+
+    async def cleanup(self) -> None:
+        """Release sockets/files; called once by stop()."""
+
+    # ---- fibers & timers --------------------------------------------------
+
+    def spawn(
+        self, coro: Coroutine, name: str | None = None
+    ) -> asyncio.Task:
+        """Track a fiber; cancelled automatically on stop(). Exceptions are
+        logged, not swallowed silently (reference: folly fibers abort the
+        eventbase; we log + count)."""
+        task = asyncio.get_event_loop().create_task(
+            self._guard(coro), name=name or self.name
+        )
+        self._tasks[task] = None
+        task.add_done_callback(lambda t: self._tasks.pop(t, None))
+        return task
+
+    async def _guard(self, coro: Coroutine) -> None:
+        try:
+            await coro
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            log.exception("module %s fiber crashed", self.name)
+            if self.counters is not None:
+                self.counters.increment(f"{self.name}.fiber_crashes")
+
+    def run_every(
+        self,
+        interval_s: float,
+        fn: Callable[[], Awaitable | None],
+        jitter: bool = False,
+        name: str | None = None,
+    ) -> asyncio.Task:
+        """Periodic timer fiber."""
+
+        async def loop():
+            while not self.stopped:
+                await asyncio.sleep(interval_s)
+                try:
+                    res = fn()
+                    if asyncio.iscoroutine(res):
+                        await res
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — a transient failure must
+                    # not permanently kill a periodic timer (ttl scans,
+                    # anti-entropy); log, count, keep ticking
+                    log.exception("module %s timer %s failed", self.name, name)
+                    if self.counters is not None:
+                        self.counters.increment(f"{self.name}.timer_errors")
+
+        return self.spawn(loop(), name=name or f"{self.name}.timer")
+
+    async def _heartbeat_loop(self) -> None:
+        """Stamp liveness for the Watchdog (reference: OpenrEventBase
+        heartbeat in openr/watchdog/Watchdog.cpp † monitoring)."""
+        while not self.stopped:
+            self.last_heartbeat = time.monotonic()
+            await asyncio.sleep(1.0)

@@ -1,0 +1,77 @@
+"""Debounce/throttle for event coalescing.
+
+reference: openr/common/AsyncThrottle.h † and AsyncDebounce.h † — Decision
+coalesces KvStore publication bursts with a (min, max) debounce: fire
+`min` after the latest poke, but never later than `max` after the first
+pending poke (reference: Decision's pendingUpdates_ timers †).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+
+class AsyncDebounce:
+    """Coalesces bursts of operation() calls.
+
+    poke() schedules fn after min_ms; repeated pokes push it out, bounded
+    by max_ms since the first un-flushed poke.
+    """
+
+    def __init__(
+        self,
+        min_ms: float,
+        max_ms: float,
+        fn: Callable[[], Awaitable | None],
+    ):
+        assert 0 < min_ms <= max_ms
+        self.min_s = min_ms / 1e3
+        self.max_s = max_ms / 1e3
+        self.fn = fn
+        self._task: asyncio.Task | None = None
+        self._first_poke: float | None = None
+        self._latest_poke: float = 0.0
+        self.fires = 0
+        self.pokes = 0
+
+    def poke(self) -> None:
+        self.pokes += 1
+        now = time.monotonic()
+        self._latest_poke = now
+        if self._first_poke is None:
+            self._first_poke = now
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(self._wait())
+
+    async def _wait(self) -> None:
+        while True:
+            while True:
+                now = time.monotonic()
+                deadline = min(
+                    self._latest_poke + self.min_s,
+                    self._first_poke + self.max_s,
+                )
+                if now >= deadline:
+                    break
+                await asyncio.sleep(deadline - now)
+            self._first_poke = None
+            self.fires += 1
+            res = self.fn()
+            if asyncio.iscoroutine(res):
+                await res
+            # a poke that landed while fn was running re-set _first_poke;
+            # loop again so the burst's final event isn't silently dropped
+            if self._first_poke is None:
+                return
+
+    def cancel(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None
+        self._first_poke = None
+
+    @property
+    def pending(self) -> bool:
+        return self._task is not None and not self._task.done()

@@ -1,0 +1,22 @@
+"""Typed node configuration.
+
+reference: openr/if/OpenrConfig.thrift † + openr/config/Config.{h,cpp} † —
+one validated JSON document parsed into typed sub-configs
+(SparkConfig, KvstoreConfig, LinkMonitorConfig, DecisionConfig, …,
+per-area AreaConfig blocks), with accessors consumed by every module.
+"""
+
+from openr_tpu.config.config import (  # noqa: F401
+    AreaConfig,
+    Config,
+    ConfigError,
+    DecisionConfig,
+    FibConfig,
+    KvstoreConfig,
+    LinkMonitorConfig,
+    NodeConfig,
+    OriginatedPrefix,
+    SparkConfig,
+    SegmentRoutingConfig,
+    WatchdogConfig,
+)

@@ -1,0 +1,236 @@
+"""Config schema + validation (reference: openr/if/OpenrConfig.thrift †,
+openr/config/Config.cpp † populateInternalDb-style checks)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+
+from openr_tpu.common import constants as C
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.serde import from_wire
+from openr_tpu.types.topology import (
+    ForwardingAlgorithm,
+    ForwardingType,
+    PrefixMetrics,
+)
+
+
+class ConfigError(ValueError):
+    """Invalid configuration (reference: Config.cpp throws std::invalid_argument †)."""
+
+
+@dataclass
+class SparkConfig:
+    """reference: OpenrConfig.thrift † SparkConfig."""
+
+    hello_time_ms: int = C.SPARK_HELLO_INTERVAL_MS
+    fastinit_hello_time_ms: int = C.SPARK_FASTINIT_HELLO_INTERVAL_MS
+    handshake_time_ms: int = C.SPARK_HANDSHAKE_INTERVAL_MS
+    keepalive_time_ms: int = C.SPARK_HEARTBEAT_INTERVAL_MS
+    hold_time_ms: int = C.SPARK_HOLD_TIME_MS
+    graceful_restart_time_ms: int = C.SPARK_GR_HOLD_TIME_MS
+
+
+@dataclass
+class KvstoreConfig:
+    """reference: OpenrConfig.thrift † KvstoreConfig."""
+
+    key_ttl_ms: int = C.KVSTORE_DEFAULT_TTL_MS
+    sync_interval_s: int = C.KVSTORE_SYNC_INTERVAL_S
+    flood_rate_msgs_per_sec: int = C.KVSTORE_FLOOD_RATE_MSGS_PER_SEC
+    flood_rate_burst_size: int = C.KVSTORE_FLOOD_RATE_BURST
+    enable_flood_optimization: bool = False
+    # grace before declaring KVSTORE_SYNCED with zero peers (covers the
+    # window before LinkMonitor delivers the first PeerEvent)
+    initial_sync_grace_s: float = 2.0
+
+
+@dataclass
+class LinkMonitorConfig:
+    """reference: OpenrConfig.thrift † LinkMonitorConfig."""
+
+    linkflap_initial_backoff_ms: int = C.LINK_FLAP_INITIAL_BACKOFF_MS
+    linkflap_max_backoff_ms: int = C.LINK_FLAP_MAX_BACKOFF_MS
+    use_rtt_metric: bool = False
+    include_interface_regexes: tuple[str, ...] = ()
+    exclude_interface_regexes: tuple[str, ...] = ()
+
+
+@dataclass
+class DecisionConfig:
+    """reference: OpenrConfig.thrift † DecisionConfig."""
+
+    debounce_min_ms: int = C.DECISION_DEBOUNCE_MIN_MS
+    debounce_max_ms: int = C.DECISION_DEBOUNCE_MAX_MS
+    # TPU solver knobs (rebuild-specific)
+    use_dense_kernel: bool | None = None  # None = auto
+    enable_lfa: bool = False
+
+
+@dataclass
+class FibConfig:
+    """reference: OpenrConfig.thrift † (fib port etc.)."""
+
+    initial_retry_ms: int = C.FIB_INITIAL_RETRY_MS
+    max_retry_ms: int = C.FIB_MAX_RETRY_MS
+    sync_interval_s: int = C.FIB_SYNC_INTERVAL_S
+    dry_run: bool = False
+
+
+@dataclass
+class SegmentRoutingConfig:
+    """reference: OpenrConfig.thrift † SegmentRoutingConfig (sr_enable,
+    label ranges)."""
+
+    enable: bool = False
+    node_segment_label: int = 0  # 0 = auto-allocate from range
+    sr_global_range: tuple[int, int] = C.SR_GLOBAL_RANGE
+    sr_local_range: tuple[int, int] = C.SR_LOCAL_RANGE
+
+
+@dataclass
+class WatchdogConfig:
+    """reference: OpenrConfig.thrift † WatchdogConfig."""
+
+    enable: bool = True
+    interval_s: int = C.WATCHDOG_INTERVAL_S
+    thread_timeout_s: int = C.WATCHDOG_THREAD_TIMEOUT_S
+
+
+@dataclass
+class AreaConfig:
+    """reference: OpenrConfig.thrift † AreaConfig (area id + interface /
+    neighbor membership regexes)."""
+
+    area_id: str = C.DEFAULT_AREA
+    include_interface_regexes: tuple[str, ...] = (".*",)
+    neighbor_regexes: tuple[str, ...] = (".*",)
+
+
+@dataclass
+class OriginatedPrefix:
+    """reference: OpenrConfig.thrift † OriginatedPrefix."""
+
+    prefix: str = ""
+    forwarding_type: ForwardingType = ForwardingType.IP
+    forwarding_algorithm: ForwardingAlgorithm = ForwardingAlgorithm.SP_ECMP
+    path_preference: int = 1000
+    source_preference: int = 100
+    minimum_supporting_routes: int = 0
+    install_to_fib: bool = False
+    tags: tuple[str, ...] = ()
+
+
+@dataclass
+class NodeConfig:
+    """Root config document (reference: OpenrConfig.thrift † OpenrConfig)."""
+
+    node_name: str = ""
+    areas: tuple[AreaConfig, ...] = (AreaConfig(),)
+    spark: SparkConfig = field(default_factory=SparkConfig)
+    kvstore: KvstoreConfig = field(default_factory=KvstoreConfig)
+    link_monitor: LinkMonitorConfig = field(default_factory=LinkMonitorConfig)
+    decision: DecisionConfig = field(default_factory=DecisionConfig)
+    fib: FibConfig = field(default_factory=FibConfig)
+    segment_routing: SegmentRoutingConfig = field(
+        default_factory=SegmentRoutingConfig
+    )
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    originated_prefixes: tuple[OriginatedPrefix, ...] = ()
+    enable_v4: bool = True
+    enable_best_route_selection: bool = True
+    # ports (0 = ephemeral, for in-process multi-node tests)
+    ctrl_port: int = C.CTRL_PORT
+    kvstore_port: int = C.KVSTORE_PORT
+    dry_run: bool = False
+
+
+class Config:
+    """Validated accessor wrapper (reference: openr/config/Config †)."""
+
+    def __init__(self, node: NodeConfig):
+        self.node = node
+        self._validate()
+
+    # ---- construction -----------------------------------------------------
+
+    @staticmethod
+    def from_json(text: str | bytes) -> "Config":
+        return Config(from_wire(text, NodeConfig))
+
+    @staticmethod
+    def from_file(path: str) -> "Config":
+        with open(path, "rb") as f:
+            return Config.from_json(f.read())
+
+    @staticmethod
+    def default(node_name: str, **overrides) -> "Config":
+        return Config(replace(NodeConfig(node_name=node_name), **overrides))
+
+    def to_json(self) -> str:
+        from openr_tpu.types.serde import to_wire
+
+        return json.dumps(json.loads(to_wire(self.node)), indent=2)
+
+    # ---- validation (reference: Config::populateInternalDb checks †) ------
+
+    def _validate(self) -> None:
+        n = self.node
+        try:
+            C.validate_name(n.node_name, "node_name")
+        except ValueError as e:
+            raise ConfigError(str(e)) from e
+        if not n.areas:
+            raise ConfigError("at least one area required")
+        seen = set()
+        for a in n.areas:
+            try:
+                C.validate_name(a.area_id, "area_id")
+            except ValueError as e:
+                raise ConfigError(str(e)) from e
+            if a.area_id in seen:
+                raise ConfigError(f"duplicate area {a.area_id!r}")
+            seen.add(a.area_id)
+        s = n.spark
+        if not (
+            0 < s.fastinit_hello_time_ms <= s.hello_time_ms
+        ):
+            raise ConfigError("spark: fastinit must be <= hello interval")
+        if s.hold_time_ms < 3 * s.keepalive_time_ms:
+            raise ConfigError(
+                "spark: hold_time must be >= 3x keepalive "
+                "(reference: Config.cpp † hold/keepalive check)"
+            )
+        d = n.decision
+        if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
+            raise ConfigError("decision: debounce min must be <= max")
+        k = n.kvstore
+        if k.key_ttl_ms <= 0:
+            raise ConfigError("kvstore: key_ttl_ms must be positive")
+        f = n.fib
+        if not (0 < f.initial_retry_ms <= f.max_retry_ms):
+            raise ConfigError("fib: retry bounds invalid")
+        sr = n.segment_routing
+        if sr.enable:
+            lo, hi = sr.sr_global_range
+            if not (C.MPLS_LABEL_MIN <= lo <= hi <= C.MPLS_LABEL_MAX):
+                raise ConfigError("segment_routing: bad global label range")
+        for p in n.originated_prefixes:
+            try:
+                IpPrefix.make(p.prefix)
+            except ValueError as e:
+                raise ConfigError(f"bad originated prefix {p.prefix!r}") from e
+
+    # ---- accessors --------------------------------------------------------
+
+    @property
+    def node_name(self) -> str:
+        return self.node.node_name
+
+    @property
+    def areas(self) -> tuple[AreaConfig, ...]:
+        return self.node.areas
+
+    def area_ids(self) -> list[str]:
+        return [a.area_id for a in self.node.areas]

@@ -1,0 +1,15 @@
+"""KvStore: eventually-consistent replicated key-value store.
+
+reference: openr/kvstore/ † — the communication backbone of the whole
+platform. Versioned values conflict-resolved by (version, originatorId,
+hash), anti-entropy full sync on peer-up, incremental flooding with split
+horizon, TTL expiry with originator refresh, per-area instances.
+"""
+
+from openr_tpu.kvstore.store import KvStoreDb, merge_key_values  # noqa: F401
+from openr_tpu.kvstore.kvstore import KvStore  # noqa: F401
+from openr_tpu.kvstore.client import KvStoreClient  # noqa: F401
+from openr_tpu.kvstore.transport import (  # noqa: F401
+    InProcKvTransport,
+    TcpKvTransport,
+)

@@ -1,0 +1,385 @@
+"""The KvStore module: peering, flooding, sync, TTL.
+
+reference: openr/kvstore/KvStore.cpp † — KvStore owns one KvStoreDb per
+area; peers arrive via PeerEvents from LinkMonitor; each peer gets a
+FULL_SYNC on add and incremental floods afterward (split horizon via the
+publication's node_ids loop guard). Local subscribers (Decision, clients)
+receive every accepted update on the publications queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.constants import DEFAULT_AREA
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.config import Config
+from openr_tpu.kvstore.store import KvStoreDb
+from openr_tpu.kvstore.transport import pub_from_json, pub_to_json
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.types.kvstore import KeyDumpParams, Publication, Value
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class PeerSpec:
+    """reference: KvStore.thrift † PeerSpec (peer addr for sync sessions)."""
+
+    node_name: str
+    endpoint: Any = None  # transport-specific (None for in-proc)
+    area: str = DEFAULT_AREA
+
+
+@dataclass
+class PeerEvent:
+    """LinkMonitor → KvStore peer changes (reference: PeerEvent †)."""
+
+    peers_to_add: list[PeerSpec] = field(default_factory=list)
+    peers_to_del: list[str] = field(default_factory=list)
+    area: str = DEFAULT_AREA
+
+
+class _Peer:
+    def __init__(self, spec: PeerSpec):
+        self.spec = spec
+        self.session = None
+        self.synced = False
+        self.backoff = ExponentialBackoff(100, 30_000)
+        self.flood_failures = 0
+        self.sync_task: "asyncio.Task | None" = None
+
+
+class KvStore(OpenrModule):
+    """One node's KvStore across all configured areas."""
+
+    def __init__(
+        self,
+        config: Config,
+        transport,
+        publications_queue: ReplicateQueue,
+        peer_events_reader=None,
+        counters=None,
+    ):
+        super().__init__(f"{config.node_name}.kvstore", counters=counters)
+        self.config = config
+        self.node_name = config.node_name
+        self.transport = transport
+        self.pub_queue = publications_queue
+        self.peer_events_reader = peer_events_reader
+        self.dbs: dict[str, KvStoreDb] = {
+            a: KvStoreDb(a, counters=counters) for a in config.area_ids()
+        }
+        self.peers: dict[tuple[str, str], _Peer] = {}  # (area, node) -> peer
+        self.initial_sync_done = asyncio.Event()
+
+    # ------------------------------------------------------------------ run
+
+    async def main(self) -> None:
+        if self.peer_events_reader is not None:
+            self.spawn(self._peer_event_loop(), name=f"{self.name}.peers")
+        self.run_every(1.0, self._ttl_tick, name=f"{self.name}.ttl")
+        sync_s = self.config.node.kvstore.sync_interval_s
+        self.run_every(sync_s, self._anti_entropy, name=f"{self.name}.sync")
+        self.spawn(self._initial_sync_grace(), name=f"{self.name}.grace")
+
+    async def _initial_sync_grace(self) -> None:
+        """KVSTORE_SYNCED signal for the no-peer case: peers arrive via
+        spawned event loops AFTER main() returns, so an immediate
+        `not self.peers` check would always fire. Wait a grace period; if
+        no peer has shown up by then, this node is alone and the store is
+        trivially synced (reference: initialization 'KVSTORE_SYNCED' gate
+        waits for initial peers learned from LinkMonitor †)."""
+        await asyncio.sleep(self.config.node.kvstore.initial_sync_grace_s)
+        if not self.peers:
+            self.initial_sync_done.set()
+
+    async def cleanup(self) -> None:
+        for peer in self.peers.values():
+            if peer.session is not None:
+                try:
+                    await peer.session.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        self.peers.clear()
+
+    async def _peer_event_loop(self) -> None:
+        from openr_tpu.messaging import QueueClosedError
+
+        while True:
+            try:
+                ev: PeerEvent = await self.peer_events_reader.get()
+            except QueueClosedError:
+                return
+            for name in ev.peers_to_del:
+                await self._del_peer(ev.area, name)
+            for spec in ev.peers_to_add:
+                await self._add_peer(spec)
+
+    # ---------------------------------------------------------------- peers
+
+    async def _add_peer(self, spec: PeerSpec) -> None:
+        key = (spec.area, spec.node_name)
+        if key in self.peers:
+            return
+        peer = _Peer(spec)
+        self.peers[key] = peer
+        if self.counters is not None:
+            self.counters.increment("kvstore.peers_added")
+        self._spawn_sync(peer)
+
+    def _spawn_sync(self, peer: _Peer) -> None:
+        """One sync task per peer at a time (a down peer's retry loop must
+        not accumulate duplicates across anti-entropy ticks)."""
+        if peer.sync_task is not None and not peer.sync_task.done():
+            return
+        peer.sync_task = self.spawn(
+            self._sync_with_peer(peer),
+            name=f"{self.name}.sync.{peer.spec.node_name}",
+        )
+
+    async def _del_peer(self, area: str, node_name: str) -> None:
+        peer = self.peers.pop((area, node_name), None)
+        if peer and peer.session is not None:
+            try:
+                await peer.session.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.counters is not None:
+            self.counters.increment("kvstore.peers_removed")
+
+    def add_peer_sync(self, spec: PeerSpec) -> None:
+        """Test/emulator convenience: schedule a peer add."""
+        self.spawn(self._add_peer(spec))
+
+    # ----------------------------------------------------------- full sync
+
+    async def _sync_with_peer(self, peer: _Peer) -> None:
+        """FULL_SYNC state machine with backoff (reference: KvStoreDb
+        requestThriftPeerSync † / processThriftSuccess/Failure †)."""
+        area = peer.spec.area
+        db = self.dbs[area]
+        while not self.stopped and (area, peer.spec.node_name) in self.peers:
+            wait = peer.backoff.time_remaining_s()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            try:
+                if peer.session is None:
+                    peer.session = await self.transport.connect(
+                        peer.spec.node_name, peer.spec.endpoint
+                    )
+                digest = {
+                    k: pub_to_json_value(v) for k, v in db.digest().items()
+                }
+                pub = await peer.session.full_sync(
+                    area, self.node_name, digest
+                )
+                self._apply(area, pub, from_peer=peer.spec.node_name)
+                # send back what the peer asked for (3-way sync)
+                if pub.to_be_updated_keys:
+                    want = db.dump(
+                        KeyDumpParams(keys=list(pub.to_be_updated_keys))
+                    )
+                    if want:
+                        await peer.session.flood(
+                            Publication(
+                                area=area,
+                                key_vals=want,
+                                node_ids=[self.node_name],
+                            )
+                        )
+                peer.synced = True
+                peer.backoff.report_success()
+                if self.counters is not None:
+                    self.counters.increment("kvstore.full_syncs")
+                self._maybe_initial_sync_done()
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                log.debug("%s: sync with %s failed: %s", self.name, peer.spec.node_name, e)
+                peer.backoff.report_error()
+                peer.session = None
+                if self.counters is not None:
+                    self.counters.increment("kvstore.full_sync_failures")
+
+    def _maybe_initial_sync_done(self) -> None:
+        if all(p.synced for p in self.peers.values()):
+            self.initial_sync_done.set()
+
+    async def _anti_entropy(self) -> None:
+        """Periodic re-sync with all peers (reference: KvStore periodic
+        full sync †, the anti-entropy repair path)."""
+        for peer in list(self.peers.values()):
+            if peer.sync_task is not None and not peer.sync_task.done():
+                continue  # previous sync still running/retrying
+            peer.synced = False
+            self._spawn_sync(peer)
+
+    # ------------------------------------------------------------- flooding
+
+    def _apply(
+        self, area: str, pub: Publication, from_peer: str | None
+    ) -> dict[str, Value]:
+        db = self.dbs.get(area)
+        if db is None:
+            return {}
+        accepted, _stale = db.merge(pub.key_vals)
+        if accepted or pub.expired_keys:
+            out = Publication(
+                area=area,
+                key_vals=accepted,
+                expired_keys=list(pub.expired_keys),
+                node_ids=list(pub.node_ids),
+            )
+            if self.node_name not in out.node_ids:
+                out.node_ids.append(self.node_name)
+            self.pub_queue.push(out)
+            self._flood(area, out, exclude=from_peer)
+        return accepted
+
+    def _flood(
+        self, area: str, pub: Publication, exclude: str | None
+    ) -> None:
+        """Split-horizon flood to synced peers (reference: KvStoreDb
+        floodPublication †: skip the sender and anyone in node_ids)."""
+        for (parea, pname), peer in self.peers.items():
+            if parea != area or pname == exclude:
+                continue
+            if pname in pub.node_ids or peer.session is None:
+                continue
+            self.spawn(self._flood_one(peer, pub))
+
+    async def _flood_one(self, peer: _Peer, pub: Publication) -> None:
+        try:
+            await peer.session.flood(pub)
+            if self.counters is not None:
+                self.counters.increment("kvstore.floods_sent")
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            peer.flood_failures += 1
+            peer.synced = False
+            peer.session = None
+            # trigger re-sync (flood gap may have lost updates)
+            self._spawn_sync(peer)
+
+    # ---------------------------------------------------- transport handlers
+
+    async def handle_full_sync(self, params: dict) -> dict:
+        """Respond to a peer's FULL_SYNC request (reference: KvStoreDb
+        processThriftRequest KEY_DUMP w/ keyValHashes †)."""
+        area = params["area"]
+        digest_raw = params.get("digest") or {}
+        db = self.dbs.get(area)
+        if db is None:
+            return pub_to_json(Publication(area=area))
+        theirs = {
+            k: value_from_json(v) for k, v in digest_raw.items()
+        }
+        to_send: dict[str, Value] = {}
+        they_need: list[str] = []
+        ours = db.kv
+        for k, v in db.dump().items():
+            t = theirs.get(k)
+            if t is None:
+                to_send[k] = v
+                continue
+            have = (ours[k].version, ours[k].originator_id, ours[k].with_hash().hash)
+            their = (t.version, t.originator_id, t.hash)
+            if have > their:
+                to_send[k] = v
+        for k, t in theirs.items():
+            cur = ours.get(k)
+            if cur is None:
+                they_need.append(k)
+            else:
+                have = (cur.version, cur.originator_id, cur.with_hash().hash)
+                if (t.version, t.originator_id, t.hash) > have:
+                    they_need.append(k)
+        pub = Publication(
+            area=area,
+            key_vals=to_send,
+            node_ids=[self.node_name],
+            to_be_updated_keys=they_need,
+        )
+        if self.counters is not None:
+            self.counters.increment("kvstore.full_syncs_served")
+        return pub_to_json(pub)
+
+    async def handle_flood(self, params: dict) -> None:
+        pub = pub_from_json(params["pub"])
+        sender = pub.node_ids[-1] if pub.node_ids else None
+        if self.counters is not None:
+            self.counters.increment("kvstore.floods_received")
+        self._apply(pub.area, pub, from_peer=sender)
+
+    def register_rpc(self, server) -> None:
+        """Attach transport handlers to this node's RpcServer."""
+
+        async def full_sync(params):
+            return await self.handle_full_sync(params)
+
+        async def flood(params):
+            await self.handle_flood(params)
+            return None
+
+        server.register("kv.fullSync", full_sync)
+        server.register("kv.flood", flood)
+
+    # ------------------------------------------------------------ local API
+
+    def set_key(
+        self,
+        area: str,
+        key: str,
+        value: Value,
+    ) -> bool:
+        """Local write (client API). Returns True if accepted."""
+        accepted = self._apply(
+            area, Publication(area=area, key_vals={key: value}), from_peer=None
+        )
+        return key in accepted
+
+    def get_key(self, area: str, key: str) -> Value | None:
+        db = self.dbs.get(area)
+        return db.kv.get(key) if db else None
+
+    def dump(self, area: str, params: KeyDumpParams | None = None) -> dict[str, Value]:
+        db = self.dbs.get(area)
+        return db.dump(params) if db else {}
+
+    # ------------------------------------------------------------------ TTL
+
+    def _ttl_tick(self) -> None:
+        for area, db in self.dbs.items():
+            dead = db.expire_keys()
+            if dead:
+                pub = Publication(
+                    area=area,
+                    expired_keys=dead,
+                    node_ids=[self.node_name],
+                )
+                self.pub_queue.push(pub)
+                # expiry is local-clock-driven on every store; no flood
+                # (reference: ttl countdown is per-store †)
+
+
+def pub_to_json_value(v: Value) -> dict:
+    import json
+
+    from openr_tpu.types.serde import to_wire
+
+    return json.loads(to_wire(v))
+
+
+def value_from_json(raw: dict) -> Value:
+    import json
+
+    from openr_tpu.types.serde import from_wire
+
+    return from_wire(json.dumps(raw), Value)

@@ -1,0 +1,179 @@
+"""Store state + the conflict-resolution core.
+
+reference: openr/kvstore/KvStore.cpp † mergeKeyValues — the single most
+load-bearing function in the platform: every store applies it to every
+incoming batch, and its total order over (version, originatorId, hash,
+ttlVersion) is what makes flooding converge to one winner everywhere.
+"""
+
+from __future__ import annotations
+
+import time
+
+from openr_tpu.types.kvstore import TTL_INFINITY, KeyDumpParams, Value
+
+
+def _with_hash(v: Value) -> Value:
+    if v.hash is None:
+        v.with_hash()
+    return v
+
+
+def merge_key_values(
+    store: dict[str, Value],
+    incoming: dict[str, Value],
+) -> tuple[dict[str, Value], list[str]]:
+    """Merge `incoming` into `store` (mutates store).
+
+    Returns (accepted, sender_stale_keys):
+      accepted — the updates applied (to flood onward / publish locally);
+      sender_stale_keys — keys where OUR copy is strictly newer (the
+      full-sync responder uses this as to_be_updated_keys so the initiator
+      sends its values back — reference: KvStore full-sync 3-way †).
+
+    Ordering per key (reference: mergeKeyValues †):
+      1. higher version wins
+      2. tie → lexicographically larger originator_id wins
+      3. tie → larger value hash wins (canonical bytes ⇒ deterministic)
+      4. same writer (version+originator equal, hash equal or no payload):
+         higher ttl_version refreshes TTL only (not re-flooded as data)
+    """
+    accepted: dict[str, Value] = {}
+    stale: list[str] = []
+    for key, inc in incoming.items():
+        inc = _with_hash(inc)
+        cur = store.get(key)
+        if cur is None:
+            if inc.value is None:
+                continue  # hash-only ad for a key we don't have: ignore
+            store[key] = inc
+            accepted[key] = inc
+            continue
+        _with_hash(cur)
+        win = (inc.version, inc.originator_id, inc.hash)
+        have = (cur.version, cur.originator_id, cur.hash)
+        if win[:2] == have[:2]:
+            # same writer generation: ttl refresh path
+            newer_ttl = inc.ttl_version > cur.ttl_version
+            if inc.value is None or inc.hash == cur.hash:
+                if newer_ttl:
+                    cur.ttl = inc.ttl
+                    cur.ttl_version = inc.ttl_version
+                    accepted[key] = Value(
+                        version=cur.version,
+                        originator_id=cur.originator_id,
+                        value=None,
+                        ttl=cur.ttl,
+                        ttl_version=cur.ttl_version,
+                        hash=cur.hash,
+                    )
+                elif inc.ttl_version < cur.ttl_version:
+                    stale.append(key)
+                continue
+            # same (version, originator) but different payload: hash breaks
+        if win > have and inc.value is not None:
+            store[key] = inc
+            accepted[key] = inc
+        elif win < have:
+            stale.append(key)
+        elif win > have:  # inc wins but carried no payload (hash-only)
+            stale.append(key)  # ask sender for the payload via full sync
+    return accepted, stale
+
+
+class KvStoreDb:
+    """One area's key-value database with TTL bookkeeping.
+
+    reference: openr/kvstore/KvStore.cpp † KvStoreDb (per-area instance).
+    """
+
+    def __init__(self, area: str, counters=None):
+        self.area = area
+        self.counters = counters
+        self.kv: dict[str, Value] = {}
+        self._expiry: dict[str, float] = {}  # key -> monotonic deadline
+
+    # ---- merge/apply ------------------------------------------------------
+
+    def merge(self, key_vals: dict[str, Value]) -> tuple[dict[str, Value], list[str]]:
+        accepted, stale = merge_key_values(self.kv, key_vals)
+        now = time.monotonic()
+        for key, v in accepted.items():
+            cur = self.kv.get(key)
+            if cur is None:
+                continue
+            if cur.ttl == TTL_INFINITY:
+                self._expiry.pop(key, None)
+            else:
+                self._expiry[key] = now + cur.ttl / 1e3
+        if self.counters is not None:
+            self.counters.increment("kvstore.merged_updates", len(accepted))
+        return accepted, stale
+
+    # ---- TTL --------------------------------------------------------------
+
+    def expire_keys(self) -> list[str]:
+        """Drop keys past deadline; returns expired key names.
+
+        reference: KvStore ttl countdown timer † (it decrements ttl and
+        erases at zero; we keep absolute deadlines instead).
+        """
+        now = time.monotonic()
+        dead = [k for k, dl in self._expiry.items() if dl <= now]
+        for k in dead:
+            self._expiry.pop(k, None)
+            self.kv.pop(k, None)
+        if dead and self.counters is not None:
+            self.counters.increment("kvstore.expired_keys", len(dead))
+        return dead
+
+    def remaining_ttl_ms(self, key: str) -> int:
+        """Current TTL for flooding (decremented; reference floods
+        ttl - 1ms minimum decrement †)."""
+        v = self.kv.get(key)
+        if v is None:
+            return 0
+        if v.ttl == TTL_INFINITY:
+            return TTL_INFINITY
+        rem = (self._expiry.get(key, 0) - time.monotonic()) * 1e3
+        return max(0, int(rem) - 1)
+
+    # ---- dumps ------------------------------------------------------------
+
+    def dump(self, params: KeyDumpParams | None = None) -> dict[str, Value]:
+        """Filtered copy of the store with flooding-ready TTLs."""
+        params = params or KeyDumpParams()
+        out: dict[str, Value] = {}
+        for key, v in self.kv.items():
+            if params.prefix and not key.startswith(params.prefix):
+                continue
+            if params.keys and key not in params.keys:
+                continue
+            if (
+                params.originator_ids
+                and v.originator_id not in params.originator_ids
+            ):
+                continue
+            out[key] = Value(
+                version=v.version,
+                originator_id=v.originator_id,
+                value=v.value,
+                ttl=self.remaining_ttl_ms(key),
+                ttl_version=v.ttl_version,
+                hash=v.hash,
+            )
+        return out
+
+    def digest(self) -> dict[str, Value]:
+        """Hash-only dump for full-sync requests (no payloads)."""
+        return {
+            k: Value(
+                version=v.version,
+                originator_id=v.originator_id,
+                value=None,
+                ttl=v.ttl,
+                ttl_version=v.ttl_version,
+                hash=_with_hash(v).hash,
+            )
+            for k, v in self.kv.items()
+        }

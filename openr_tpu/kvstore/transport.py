@@ -1,0 +1,118 @@
+"""Peer transport seam: in-process for tests/emulator, TCP for real.
+
+reference: the KvStore peering is thrift-client sessions in the reference
+(KvStorePeer with FBThrift client †); tests wire N stores in one process
+(KvStoreWrapper †). The seam here makes both cases one interface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Protocol
+
+from openr_tpu.rpc import RpcClient, RpcError
+from openr_tpu.types.kvstore import Publication
+from openr_tpu.types.serde import from_wire, to_wire
+
+
+class KvPeerSession(Protocol):
+    async def full_sync(
+        self, area: str, sender_id: str, digest: dict
+    ) -> Publication: ...
+
+    async def flood(self, pub: Publication) -> None: ...
+
+    async def close(self) -> None: ...
+
+
+def pub_to_json(pub: Publication) -> dict:
+    import json
+
+    return json.loads(to_wire(pub))
+
+
+def pub_from_json(raw: dict) -> Publication:
+    import json
+
+    return from_wire(json.dumps(raw), Publication)
+
+
+class InProcKvTransport:
+    """Registry-based direct delivery for multi-store-per-process tests
+    (reference pattern: KvStoreWrapper wiring N stores in one binary †)."""
+
+    def __init__(self):
+        self._stores: dict[str, Any] = {}  # node_name -> KvStore
+
+    def register(self, node_name: str, store: Any) -> None:
+        self._stores[node_name] = store
+
+    def unregister(self, node_name: str) -> None:
+        self._stores.pop(node_name, None)
+
+    async def connect(self, peer_id: str, endpoint: Any) -> "_InProcSession":
+        if peer_id not in self._stores:
+            raise ConnectionError(f"no in-proc store {peer_id!r}")
+        return _InProcSession(self, peer_id)
+
+
+class _InProcSession:
+    def __init__(self, transport: InProcKvTransport, peer_id: str):
+        self._t = transport
+        self.peer_id = peer_id
+
+    def _peer(self):
+        store = self._t._stores.get(self.peer_id)
+        if store is None:
+            raise ConnectionError(f"in-proc store {self.peer_id!r} gone")
+        return store
+
+    async def full_sync(
+        self, area: str, sender_id: str, digest: dict
+    ) -> Publication:
+        raw = await self._peer().handle_full_sync(
+            {"area": area, "sender": sender_id, "digest": digest}
+        )
+        return pub_from_json(raw)
+
+    async def flood(self, pub: Publication) -> None:
+        # yield to the loop: keeps the async network boundary observable
+        # in tests even without real sockets
+        await asyncio.sleep(0)
+        await self._peer().handle_flood({"pub": pub_to_json(pub)})
+
+    async def close(self) -> None:
+        pass
+
+
+class TcpKvTransport:
+    """RPC-over-TCP sessions to peers' KvStore servers."""
+
+    async def connect(self, peer_id: str, endpoint: tuple[str, int]):
+        host, port = endpoint
+        client = RpcClient(host, port)
+        await client.connect()
+        return _TcpSession(client, peer_id)
+
+
+class _TcpSession:
+    def __init__(self, client: RpcClient, peer_id: str):
+        self._c = client
+        self.peer_id = peer_id
+
+    async def full_sync(
+        self, area: str, sender_id: str, digest: dict
+    ) -> Publication:
+        raw = await self._c.call(
+            "kv.fullSync", {"area": area, "sender": sender_id, "digest": digest}
+        )
+        return pub_from_json(raw)
+
+    async def flood(self, pub: Publication) -> None:
+        try:
+            await self._c.notify("kv.flood", {"pub": pub_to_json(pub)})
+        except (ConnectionError, RpcError) as e:
+            raise ConnectionError(str(e)) from e
+
+    async def close(self) -> None:
+        await self._c.close()

@@ -1,0 +1,110 @@
+"""Typed in-process queues: the module interconnect.
+
+reference: openr/messaging/ReplicateQueue.h † / Queue.h † — single-writer
+multi-reader replicated queue; every module-to-module arrow in the
+dataflow graph is one of these. The reference runs each module on its own
+folly::EventBase thread; here modules are asyncio tasks on one loop, and
+the queues are the only coupling between them (same shared-nothing
+design, reference: SURVEY §2 "thread-per-module concurrency").
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class QueueClosedError(Exception):
+    """Raised by RQueue.get() once the queue is closed and drained
+    (reference: messaging/Queue.h † QueueClosedError)."""
+
+
+class RQueue(Generic[T]):
+    """Reader endpoint of a ReplicateQueue (reference: RQueue<T> †)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+
+    async def get(self) -> T:
+        """Await the next item; QueueClosedError after close+drain."""
+        if self._closed and self._q.empty():
+            raise QueueClosedError(self.name)
+        item = await self._q.get()
+        if item is _CLOSE:
+            self._closed = True
+            raise QueueClosedError(self.name)
+        return item
+
+    def try_get(self) -> T | None:
+        """Non-blocking get; None if empty (or closed)."""
+        while not self._q.empty():
+            item = self._q.get_nowait()
+            if item is _CLOSE:
+                self._closed = True
+                return None
+            return item
+        return None
+
+    def size(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class _Close:
+    pass
+
+
+_CLOSE = _Close()
+
+
+class ReplicateQueue(Generic[T]):
+    """Single-writer multi-reader queue: push() replicates to every reader.
+
+    reference: messaging/ReplicateQueue.h † — getReader(), push(),
+    close(); per-reader buffering so a slow consumer can't drop another
+    consumer's messages.
+    """
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._readers: list[RQueue[T]] = []
+        self._closed = False
+        self._writes = 0
+
+    def get_reader(self, name: str = "") -> RQueue[T]:
+        if self._closed:
+            raise QueueClosedError(self.name)
+        r: RQueue[T] = RQueue(name or f"{self.name}.r{len(self._readers)}")
+        self._readers.append(r)
+        return r
+
+    def push(self, item: T) -> int:
+        """Replicate to all readers; returns replication count."""
+        if self._closed:
+            raise QueueClosedError(self.name)
+        self._writes += 1
+        for r in self._readers:
+            r._q.put_nowait(item)
+        return len(self._readers)
+
+    def close(self) -> None:
+        """Signal end-of-stream; readers drain then see QueueClosedError."""
+        if not self._closed:
+            self._closed = True
+            for r in self._readers:
+                r._q.put_nowait(_CLOSE)
+
+    @property
+    def num_readers(self) -> int:
+        return len(self._readers)
+
+    @property
+    def num_writes(self) -> int:
+        return self._writes

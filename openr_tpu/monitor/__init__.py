@@ -1,0 +1,7 @@
+"""Observability: counters, event-log samples, the Monitor module.
+
+reference: openr/monitor/ † + the fb303 counter surface every module uses
+(`fb303::fbData->setCounter/addStatValue` †).
+"""
+
+from openr_tpu.monitor.counters import Counters  # noqa: F401

@@ -1,0 +1,68 @@
+"""fb303-style counters.
+
+reference: fb303::fbData — a process-global stats registry in the
+reference; here one `Counters` instance per emulated node (N nodes share a
+process in tests/emulator, so it must not be a module-level singleton).
+setCounter ≙ set, addStatValue ≙ add_value (keeps sum/count/min/max/last
+like the reference's timeseries export, without the windowing).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Stat:
+    sum: float = 0.0
+    count: int = 0
+    min: float = float("inf")
+    max: float = float("-inf")
+    last: float = 0.0
+
+    def add(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        self.last = v
+
+    @property
+    def avg(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+@dataclass
+class Counters:
+    counters: dict[str, float] = field(default_factory=dict)
+    stats: dict[str, _Stat] = field(default_factory=dict)
+
+    def set(self, key: str, value: float) -> None:
+        self.counters[key] = value
+
+    def increment(self, key: str, delta: float = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + delta
+
+    def get(self, key: str, default: float = 0) -> float:
+        return self.counters.get(key, default)
+
+    def add_value(self, key: str, value: float) -> None:
+        self.stats.setdefault(key, _Stat()).add(value)
+
+    def touch(self, key: str) -> None:
+        """Timestamp counter (reference pattern: `<event>.time` counters)."""
+        self.counters[key] = time.time()
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat export (reference: getCounters() thrift API shape —
+        stats expand to .sum/.count/.avg/.min/.max suffixes)."""
+        out = dict(self.counters)
+        for k, s in self.stats.items():
+            out[f"{k}.sum"] = s.sum
+            out[f"{k}.count"] = s.count
+            out[f"{k}.avg"] = s.avg
+            if s.count:
+                out[f"{k}.min"] = s.min
+                out[f"{k}.max"] = s.max
+        return out

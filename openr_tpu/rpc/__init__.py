@@ -1,0 +1,24 @@
+"""Async RPC over newline-delimited JSON — the thrift-RPC equivalent.
+
+reference: the control plane of openr is fbthrift services everywhere
+(OpenrCtrl.thrift †, Platform.thrift † FibService, KvStore thrift peering
+†). This rebuild uses one small asyncio RPC core with the same roles:
+request/response calls, fire-and-forget notifications, and server-push
+streams (≙ thrift server-streaming used by subscribeKvStoreFilter /
+subscribeFib †). Payloads are the canonical-JSON wire codec from
+openr_tpu.types.serde, so every schema dataclass travels as-is.
+
+Wire format (one JSON object per line):
+  request:      {"id": 1, "method": "m", "params": {...}}
+  response:     {"id": 1, "result": {...}} | {"id": 1, "error": "..."}
+  notification: {"method": "m", "params": {...}}            (no id)
+  stream item:  {"id": 1, "item": {...}}                    (until "end")
+  stream end:   {"id": 1, "end": true}
+"""
+
+from openr_tpu.rpc.core import (  # noqa: F401
+    RpcClient,
+    RpcError,
+    RpcServer,
+    StreamWriter,
+)

@@ -1,0 +1,298 @@
+"""RPC core: see package docstring for the wire format."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+log = logging.getLogger(__name__)
+
+MAX_LINE = 64 * 1024 * 1024  # LSDB dumps can be large
+
+
+class RpcError(Exception):
+    """Remote handler raised / transport failed."""
+
+
+class StreamWriter:
+    """Handed to streaming handlers to push items to the subscriber."""
+
+    def __init__(self, writer: asyncio.StreamWriter, req_id: int):
+        self._writer = writer
+        self._id = req_id
+        self.closed = False
+
+    async def send(self, item: Any) -> None:
+        if self.closed:
+            raise RpcError("stream closed")
+        try:
+            self._writer.write(_dumps({"id": self._id, "item": item}))
+            await self._writer.drain()
+        except (ConnectionError, RuntimeError) as e:
+            self.closed = True
+            raise RpcError(f"stream write failed: {e}") from e
+
+    async def end(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                self._writer.write(_dumps({"id": self._id, "end": True}))
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+
+
+def _dumps(obj: dict) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode() + b"\n"
+
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+class RpcServer:
+    """Dispatches methods on incoming connections.
+
+    register(name, fn): async fn(params_dict) -> jsonable result.
+    register_stream(name, fn): async fn(params_dict, stream: StreamWriter);
+    the stream stays open until fn returns or the client disconnects.
+    """
+
+    def __init__(self, name: str = "rpc"):
+        self.name = name
+        self._methods: dict[str, Handler] = {}
+        self._streams: dict[str, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self.port: int | None = None
+
+    def register(self, method: str, fn: Handler) -> None:
+        self._methods[method] = fn
+
+    def register_stream(self, method: str, fn: Handler) -> None:
+        self._streams[method] = fn
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Bind and serve; returns the bound port (0 → ephemeral)."""
+        self._server = await asyncio.start_server(
+            self._on_conn, host, port, limit=MAX_LINE
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        # cancel handlers BEFORE wait_closed(): since py3.12 wait_closed
+        # blocks until every connection handler returns
+        for t in list(self._conn_tasks):
+            t.cancel()
+        for t in list(self._conn_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._conn_tasks.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task:
+            self._conn_tasks.add(task)
+        stream_tasks: list[asyncio.Task] = []
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("%s: bad json from peer", self.name)
+                    continue
+                method = msg.get("method")
+                req_id = msg.get("id")
+                params = msg.get("params") or {}
+                if method in self._streams and req_id is not None:
+                    sw = StreamWriter(writer, req_id)
+
+                    async def run_stream(fn=self._streams[method], p=params, s=sw):
+                        try:
+                            await fn(p, s)
+                        except RpcError:
+                            pass
+                        except Exception:  # noqa: BLE001
+                            log.exception("%s: stream handler failed", self.name)
+                        finally:
+                            await s.end()
+
+                    stream_tasks.append(asyncio.ensure_future(run_stream()))
+                elif method in self._methods:
+                    try:
+                        result = await self._methods[method](params)
+                        reply = {"id": req_id, "result": result}
+                    except Exception as e:  # noqa: BLE001
+                        log.exception("%s: handler %s failed", self.name, method)
+                        reply = {"id": req_id, "error": f"{type(e).__name__}: {e}"}
+                    if req_id is not None:
+                        writer.write(_dumps(reply))
+                        await writer.drain()
+                elif req_id is not None:
+                    writer.write(
+                        _dumps({"id": req_id, "error": f"no method {method!r}"})
+                    )
+                    await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        finally:
+            for t in stream_tasks:
+                t.cancel()
+            writer.close()
+            if task:
+                self._conn_tasks.discard(task)
+
+
+class RpcClient:
+    """One connection; concurrent calls multiplexed by request id."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._next_id = 1
+        self._pending: dict[int, asyncio.Future] = {}
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._rx_task: asyncio.Task | None = None
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    async def connect(self, timeout: float = 5.0) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port, limit=MAX_LINE),
+            timeout,
+        )
+        self._rx_task = asyncio.ensure_future(self._rx_loop())
+
+    async def close(self) -> None:
+        if self._rx_task:
+            self._rx_task.cancel()
+            try:
+                await self._rx_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._rx_task = None
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+        self._fail_all(RpcError("client closed"))
+
+    def _fail_all(self, err: Exception) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+        for q in self._streams.values():
+            q.put_nowait(_STREAM_ERR)
+        self._streams.clear()
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                msg = json.loads(line)
+                req_id = msg.get("id")
+                if "item" in msg and req_id in self._streams:
+                    self._streams[req_id].put_nowait(msg["item"])
+                elif msg.get("end") and req_id in self._streams:
+                    self._streams.pop(req_id).put_nowait(_STREAM_END)
+                elif req_id in self._streams and (
+                    "error" in msg or "result" in msg
+                ):
+                    # server treated the subscription as a plain call (bad
+                    # method / non-stream handler): fail the stream instead
+                    # of hanging the subscriber forever
+                    self._streams.pop(req_id).put_nowait(_STREAM_ERR)
+                elif req_id in self._pending:
+                    fut = self._pending.pop(req_id)
+                    if not fut.done():
+                        if "error" in msg:
+                            fut.set_exception(RpcError(msg["error"]))
+                        else:
+                            fut.set_result(msg.get("result"))
+        except (ConnectionError, json.JSONDecodeError, asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self._fail_all(RpcError("connection lost"))
+
+    async def call(
+        self, method: str, params: Any = None, timeout: float = 30.0
+    ) -> Any:
+        if self._writer is None:
+            raise RpcError("not connected")
+        req_id = self._next_id
+        self._next_id += 1
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        self._writer.write(
+            _dumps({"id": req_id, "method": method, "params": params or {}})
+        )
+        await self._writer.drain()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError as e:
+            self._pending.pop(req_id, None)  # don't leak the slot
+            raise RpcError(f"call {method!r} timed out after {timeout}s") from e
+
+    async def notify(self, method: str, params: Any = None) -> None:
+        if self._writer is None:
+            raise RpcError("not connected")
+        self._writer.write(_dumps({"method": method, "params": params or {}}))
+        await self._writer.drain()
+
+    async def subscribe(
+        self, method: str, params: Any = None
+    ) -> AsyncIterator[Any]:
+        """Server-push stream; iterate until the server ends it."""
+        if self._writer is None:
+            raise RpcError("not connected")
+        req_id = self._next_id
+        self._next_id += 1
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[req_id] = q
+        self._writer.write(
+            _dumps({"id": req_id, "method": method, "params": params or {}})
+        )
+        await self._writer.drain()
+
+        async def gen():
+            while True:
+                item = await q.get()
+                if item is _STREAM_END:
+                    return
+                if item is _STREAM_ERR:
+                    raise RpcError("stream broken")
+                yield item
+
+        return gen()
+
+
+class _Sentinel:
+    pass
+
+
+_STREAM_END = _Sentinel()
+_STREAM_ERR = _Sentinel()

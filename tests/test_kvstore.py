@@ -1,0 +1,304 @@
+"""KvStore tests (reference analogue: openr/kvstore/tests/KvStoreTest.cpp †
+— the KvStoreWrapper pattern: N real stores wired in one process, testing
+merge properties, flooding, full sync, TTL expiry, conflict resolution)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.config import Config
+from openr_tpu.kvstore import (
+    InProcKvTransport,
+    KvStore,
+    KvStoreClient,
+    merge_key_values,
+)
+from openr_tpu.kvstore.kvstore import PeerSpec
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.kvstore import TTL_INFINITY, Publication, Value
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def V(version, orig, value, ttl=TTL_INFINITY, ttl_version=0):
+    return Value(
+        version=version,
+        originator_id=orig,
+        value=value,
+        ttl=ttl,
+        ttl_version=ttl_version,
+    ).with_hash()
+
+
+# ---- merge properties (reference: mergeKeyValues semantics †) -------------
+
+
+def test_merge_higher_version_wins():
+    store = {"k": V(1, "a", b"old")}
+    acc, stale = merge_key_values(store, {"k": V(2, "z", b"new")})
+    assert "k" in acc and store["k"].value == b"new"
+    assert not stale
+
+
+def test_merge_lower_version_reports_stale():
+    store = {"k": V(5, "a", b"cur")}
+    acc, stale = merge_key_values(store, {"k": V(3, "z", b"old")})
+    assert not acc and stale == ["k"]
+    assert store["k"].value == b"cur"
+
+
+def test_merge_tie_originator_then_hash():
+    store = {"k": V(2, "a", b"x")}
+    acc, _ = merge_key_values(store, {"k": V(2, "b", b"y")})
+    assert "k" in acc and store["k"].originator_id == "b"
+    # same version+originator, different payload → larger hash wins
+    v1, v2 = V(2, "b", b"p1"), V(2, "b", b"p2")
+    lo, hi = sorted([v1, v2], key=lambda v: v.hash)
+    store2 = {"k": lo}
+    acc2, _ = merge_key_values(store2, {"k": hi})
+    assert "k" in acc2 and store2["k"].hash == hi.hash
+    # and the loser direction is rejected
+    store3 = {"k": hi}
+    acc3, stale3 = merge_key_values(store3, {"k": lo})
+    assert not acc3 and stale3 == ["k"]
+
+
+def test_merge_ttl_refresh_same_writer():
+    store = {"k": V(2, "a", b"x", ttl=1000, ttl_version=1)}
+    refresh = V(2, "a", None, ttl=5000, ttl_version=2)
+    acc, _ = merge_key_values(store, {"k": refresh})
+    assert "k" in acc
+    assert store["k"].value == b"x"  # payload untouched
+    assert store["k"].ttl == 5000 and store["k"].ttl_version == 2
+    # stale ttl_version rejected
+    acc2, stale2 = merge_key_values(store, {"k": V(2, "a", None, ttl=9000, ttl_version=0)})
+    assert not acc2 and stale2 == ["k"]
+
+
+def test_merge_is_idempotent_and_commutative():
+    """Convergence property: any order of the same updates → same store."""
+    import itertools
+
+    updates = [
+        {"k": V(1, "a", b"1")},
+        {"k": V(2, "a", b"2")},
+        {"k": V(2, "b", b"3")},
+        {"j": V(1, "c", b"4")},
+    ]
+    finals = set()
+    for perm in itertools.permutations(updates):
+        store = {}
+        for u in perm:
+            merge_key_values(store, {k: V(v.version, v.originator_id, v.value) for k, v in u.items()})
+        finals.add(tuple(sorted((k, v.version, v.originator_id, v.value) for k, v in store.items())))
+    assert len(finals) == 1
+
+
+# ---- multi-store wiring (KvStoreWrapper pattern) --------------------------
+
+
+class Wrapper:
+    """N in-process stores (reference: KvStoreWrapper †)."""
+
+    def __init__(self, transport, name):
+        self.q = ReplicateQueue(name=f"{name}.pubs")
+        self.counters = Counters()
+        self.config = Config.default(name)
+        self.store = KvStore(
+            self.config, transport, self.q, counters=self.counters
+        )
+        transport.register(name, self.store)
+        self.reader = self.q.get_reader()
+
+    async def start(self):
+        await self.store.start()
+
+    async def stop(self):
+        await self.store.stop()
+
+
+async def _mk_stores(transport, names):
+    ws = {n: Wrapper(transport, n) for n in names}
+    for w in ws.values():
+        await w.start()
+    return ws
+
+
+async def _settle(cond, timeout=3.0, interval=0.01):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            return False
+        await asyncio.sleep(interval)
+    return True
+
+
+def test_flooding_line_topology():
+    """a—b—c: a's write reaches c through b (split-horizon flood)."""
+
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a", "b", "c"])
+        # peer the line (both directions)
+        ws["a"].store.add_peer_sync(PeerSpec(node_name="b"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="a"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="c"))
+        ws["c"].store.add_peer_sync(PeerSpec(node_name="b"))
+        await asyncio.sleep(0.05)
+        ws["a"].store.set_key("0", "k1", V(1, "a", b"hello"))
+        ok = await _settle(
+            lambda: ws["c"].store.get_key("0", "k1") is not None
+        )
+        assert ok, "flood a→b→c failed"
+        assert ws["c"].store.get_key("0", "k1").value == b"hello"
+        # loop guard: a's pub must not boomerang as a new merge on a
+        assert ws["a"].store.get_key("0", "k1").version == 1
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
+def test_full_sync_on_peer_add():
+    """Stores with divergent pre-existing state converge on peering:
+    newer versions win in both directions (3-way sync)."""
+
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a", "b"])
+        ws["a"].store.set_key("0", "ka", V(1, "a", b"from-a"))
+        ws["a"].store.set_key("0", "shared", V(3, "a", b"a-newer"))
+        ws["b"].store.set_key("0", "kb", V(1, "b", b"from-b"))
+        ws["b"].store.set_key("0", "shared", V(2, "b", b"b-older"))
+        ws["a"].store.add_peer_sync(PeerSpec(node_name="b"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="a"))
+        ok = await _settle(
+            lambda: ws["a"].store.get_key("0", "kb") is not None
+            and ws["b"].store.get_key("0", "ka") is not None
+            and ws["b"].store.get_key("0", "shared") is not None
+            and ws["b"].store.get_key("0", "shared").value == b"a-newer"
+        )
+        assert ok
+        assert ws["a"].store.get_key("0", "shared").value == b"a-newer"
+        assert ws["a"].store.initial_sync_done.is_set()
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
+def test_ttl_expiry_publishes():
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a"])
+        ws["a"].store.set_key("0", "ephemeral", V(1, "a", b"x", ttl=300))
+        assert ws["a"].store.get_key("0", "ephemeral") is not None
+        ok = await _settle(
+            lambda: ws["a"].store.get_key("0", "ephemeral") is None,
+            timeout=3.0,
+        )
+        assert ok, "key did not expire"
+        # expiry publication reached subscribers
+        expired = []
+        while (item := ws["a"].reader.try_get()) is not None:
+            expired += item.expired_keys
+        assert "ephemeral" in expired
+        await ws["a"].stop()
+
+    run(main())
+
+
+def test_client_persist_key_defends_against_overwrite():
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a", "b"])
+        ws["a"].store.add_peer_sync(PeerSpec(node_name="b"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="a"))
+        client = KvStoreClient(
+            ws["a"].store, "a", ws["a"].q.get_reader(), counters=ws["a"].counters
+        )
+        await client.start()
+        client.persist_key("0", "adj:a", b"my-adjacencies")
+        await asyncio.sleep(0.05)
+        # another node overwrites with a higher version
+        ws["b"].store.set_key("0", "adj:a", V(5, "b", b"imposter"))
+        ok = await _settle(
+            lambda: (v := ws["a"].store.get_key("0", "adj:a")) is not None
+            and v.originator_id == "a"
+            and v.value == b"my-adjacencies"
+            and v.version > 5
+        )
+        assert ok, "client did not win back its key"
+        # and b converges to a's re-advertisement
+        ok2 = await _settle(
+            lambda: (v := ws["b"].store.get_key("0", "adj:a")) is not None
+            and v.originator_id == "a"
+        )
+        assert ok2
+        await client.stop()
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
+def test_client_ttl_refresh_keeps_key_alive():
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a"])
+        client = KvStoreClient(
+            ws["a"].store, "a", ws["a"].q.get_reader(), counters=ws["a"].counters
+        )
+        await client.start()
+        client.persist_key("0", "k", b"v", ttl_ms=1500)
+        await asyncio.sleep(2.5)  # > ttl: refresh must have kept it alive
+        v = ws["a"].store.get_key("0", "k")
+        assert v is not None and v.ttl_version > 0
+        client.unset_key("0", "k")
+        ok = await _settle(
+            lambda: ws["a"].store.get_key("0", "k") is None, timeout=4.0
+        )
+        assert ok, "key did not die after unset"
+        await client.stop()
+        await ws["a"].stop()
+
+    run(main())
+
+
+def test_grid_convergence_16_stores():
+    """4x4 grid of stores: one write floods everywhere (the multi-node-
+    without-a-cluster pattern, reference: KvStoreTest grid cases †)."""
+
+    async def main():
+        t = InProcKvTransport()
+        names = [f"s{i}" for i in range(16)]
+        ws = await _mk_stores(t, names)
+
+        def nid(r, c):
+            return f"s{r * 4 + c}"
+
+        for r in range(4):
+            for c in range(4):
+                me = nid(r, c)
+                for rr, cc in ((r + 1, c), (r, c + 1)):
+                    if rr < 4 and cc < 4:
+                        other = nid(rr, cc)
+                        ws[me].store.add_peer_sync(PeerSpec(node_name=other))
+                        ws[other].store.add_peer_sync(PeerSpec(node_name=me))
+        await asyncio.sleep(0.1)
+        ws["s0"].store.set_key("0", "corner", V(1, "s0", b"flood-me"))
+        ok = await _settle(
+            lambda: all(
+                w.store.get_key("0", "corner") is not None
+                for w in ws.values()
+            ),
+            timeout=5.0,
+        )
+        assert ok, "grid did not converge"
+        for w in ws.values():
+            await w.stop()
+
+    run(main())

@@ -1,0 +1,120 @@
+"""RPC core + TCP KvStore peering tests (the real-socket path of the
+transport seam; reference analogue: thrift-based peering in KvStoreTest †)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.config import Config
+from openr_tpu.kvstore import KvStore, TcpKvTransport
+from openr_tpu.kvstore.kvstore import PeerSpec
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.rpc import RpcClient, RpcError, RpcServer
+from openr_tpu.types.kvstore import Value
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_rpc_call_notify_stream():
+    async def main():
+        server = RpcServer("test")
+        got_notes = []
+
+        async def echo(params):
+            return {"you_sent": params}
+
+        async def boom(params):
+            raise ValueError("nope")
+
+        async def note(params):
+            got_notes.append(params)
+            return None
+
+        async def counter_stream(params, stream):
+            for i in range(int(params["n"])):
+                await stream.send({"i": i})
+
+        server.register("echo", echo)
+        server.register("boom", boom)
+        server.register("note", note)
+        server.register_stream("count", counter_stream)
+        port = await server.start()
+
+        c = RpcClient("127.0.0.1", port)
+        await c.connect()
+        assert await c.call("echo", {"x": 1}) == {"you_sent": {"x": 1}}
+        with pytest.raises(RpcError, match="ValueError"):
+            await c.call("boom")
+        with pytest.raises(RpcError, match="no method"):
+            await c.call("missing")
+        await c.notify("note", {"fire": "forget"})
+        items = [x async for x in await c.subscribe("count", {"n": 3})]
+        assert items == [{"i": 0}, {"i": 1}, {"i": 2}]
+        await asyncio.sleep(0.01)
+        assert got_notes == [{"fire": "forget"}]
+        # concurrent calls multiplex correctly
+        rs = await asyncio.gather(*(c.call("echo", {"i": i}) for i in range(10)))
+        assert [r["you_sent"]["i"] for r in rs] == list(range(10))
+        # subscribing to a non-stream / unknown method fails instead of
+        # hanging forever (regression)
+        with pytest.raises(RpcError):
+            _ = [x async for x in await c.subscribe("echo", {})]
+        with pytest.raises(RpcError):
+            _ = [x async for x in await c.subscribe("nope", {})]
+        await c.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_kvstore_peering_over_tcp():
+    """Two stores on real sockets: full sync + flood both ways."""
+
+    async def main():
+        stores = {}
+        servers = {}
+        qs = {}
+        ports = {}
+        for name in ("a", "b"):
+            qs[name] = ReplicateQueue(name=name)
+            stores[name] = KvStore(
+                Config.default(name), TcpKvTransport(), qs[name]
+            )
+            servers[name] = RpcServer(name)
+            stores[name].register_rpc(servers[name])
+            ports[name] = await servers[name].start()
+            await stores[name].start()
+
+        stores["a"].set_key("0", "from-a", Value(1, "a", b"A").with_hash())
+        stores["b"].set_key("0", "from-b", Value(1, "b", b"B").with_hash())
+        stores["a"].add_peer_sync(
+            PeerSpec(node_name="b", endpoint=("127.0.0.1", ports["b"]))
+        )
+        stores["b"].add_peer_sync(
+            PeerSpec(node_name="a", endpoint=("127.0.0.1", ports["a"]))
+        )
+
+        async def settle(cond, timeout=3.0):
+            t0 = asyncio.get_event_loop().time()
+            while not cond():
+                if asyncio.get_event_loop().time() - t0 > timeout:
+                    return False
+                await asyncio.sleep(0.01)
+            return True
+
+        ok = await settle(
+            lambda: stores["a"].get_key("0", "from-b") is not None
+            and stores["b"].get_key("0", "from-a") is not None
+        )
+        assert ok, "TCP full-sync failed"
+        # incremental flood after sync
+        stores["a"].set_key("0", "late", Value(1, "a", b"L").with_hash())
+        ok = await settle(lambda: stores["b"].get_key("0", "late") is not None)
+        assert ok, "TCP flood failed"
+        for name in ("a", "b"):
+            await stores[name].stop()
+            await servers[name].stop()
+
+    run(main())

@@ -1,0 +1,246 @@
+"""Runtime substrate tests: queues, module lifecycle, backoff, debounce,
+config (reference analogues: openr/messaging/tests †,
+openr/common/tests †, openr/config/tests †)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.common.throttle import AsyncDebounce
+from openr_tpu.config import Config, ConfigError, NodeConfig
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue
+from openr_tpu.monitor import Counters
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ---- messaging -------------------------------------------------------------
+
+
+def test_replicate_queue_fanout():
+    async def main():
+        q = ReplicateQueue(name="test")
+        r1, r2 = q.get_reader(), q.get_reader()
+        assert q.push("a") == 2
+        q.push("b")
+        assert await r1.get() == "a"
+        assert await r2.get() == "a"
+        assert await r1.get() == "b"
+        assert r2.size() == 1
+        assert q.num_writes == 2
+
+    run(main())
+
+
+def test_queue_close_drains_then_raises():
+    async def main():
+        q = ReplicateQueue()
+        r = q.get_reader()
+        q.push(1)
+        q.close()
+        assert await r.get() == 1  # drains buffered items first
+        with pytest.raises(QueueClosedError):
+            await r.get()
+        with pytest.raises(QueueClosedError):
+            q.push(2)
+
+    run(main())
+
+
+def test_late_reader_misses_earlier_items():
+    async def main():
+        q = ReplicateQueue()
+        q.get_reader()
+        q.push(1)
+        late = q.get_reader()
+        q.push(2)
+        assert late.try_get() == 2  # replication starts at subscription
+
+    run(main())
+
+
+# ---- module lifecycle ------------------------------------------------------
+
+
+class TickerModule(OpenrModule):
+    def __init__(self):
+        super().__init__("ticker", counters=Counters())
+        self.ticks = 0
+        self.cleaned = False
+
+    async def main(self):
+        self.run_every(0.01, self._tick)
+
+    def _tick(self):
+        self.ticks += 1
+
+    async def cleanup(self):
+        self.cleaned = True
+
+
+def test_module_lifecycle():
+    async def main():
+        m = TickerModule()
+        await m.start()
+        await asyncio.sleep(0.06)
+        await m.stop()
+        assert m.ticks >= 3
+        assert m.cleaned
+        ticks = m.ticks
+        await asyncio.sleep(0.03)
+        assert m.ticks == ticks  # timers dead after stop
+        await m.stop()  # idempotent
+
+    run(main())
+
+
+def test_module_fiber_crash_is_counted():
+    async def main():
+        m = TickerModule()
+
+        async def boom():
+            raise RuntimeError("boom")
+
+        await m.start()
+        m.spawn(boom())
+        await asyncio.sleep(0.02)
+        assert m.counters.get("ticker.fiber_crashes") == 1
+        await m.stop()
+
+    run(main())
+
+
+# ---- backoff / debounce ----------------------------------------------------
+
+
+def test_exponential_backoff():
+    b = ExponentialBackoff(8, 64)
+    assert b.time_remaining_s() == 0
+    b.report_error()
+    assert b.current_ms == 8
+    b.report_error()
+    b.report_error()
+    assert b.current_ms == 32
+    b.report_error()
+    b.report_error()
+    assert b.current_ms == 64  # capped
+    assert b.time_remaining_s() > 0
+    b.report_success()
+    assert b.current_ms == 0
+    assert not b.has_error
+
+
+def test_debounce_coalesces_and_honors_max():
+    async def main():
+        fired = []
+        d = AsyncDebounce(min_ms=30, max_ms=100, fn=lambda: fired.append(1))
+        # burst of pokes: one fire ~min after the last poke
+        for _ in range(5):
+            d.poke()
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.06)
+        assert len(fired) == 1
+        # continuous poking: max bound forces a fire anyway
+        async def poker():
+            for _ in range(30):
+                d.poke()
+                await asyncio.sleep(0.01)
+
+        await asyncio.gather(poker())
+        await asyncio.sleep(0.05)
+        assert 2 <= len(fired) <= 5  # ~300ms of poking / 100ms max bound
+        assert d.pokes == 35
+
+    run(main())
+
+
+def test_debounce_poke_during_fn_refires():
+    """A poke landing while fn() is executing must schedule another fire
+    (regression: the burst's final event was silently dropped)."""
+
+    async def main():
+        fired = []
+        d = None
+
+        async def slow_fn():
+            fired.append(1)
+            if len(fired) == 1:
+                d.poke()  # poke DURING execution
+                await asyncio.sleep(0.02)
+
+        d = AsyncDebounce(min_ms=10, max_ms=50, fn=slow_fn)
+        d.poke()
+        await asyncio.sleep(0.2)
+        assert len(fired) == 2
+
+    run(main())
+
+
+# ---- config ----------------------------------------------------------------
+
+
+def test_config_defaults_valid():
+    cfg = Config.default("node-1")
+    assert cfg.node_name == "node-1"
+    assert cfg.area_ids() == ["0"]
+
+
+def test_config_json_roundtrip():
+    cfg = Config.default("node-1")
+    again = Config.from_json(cfg.to_json())
+    assert again.node == cfg.node
+
+
+def test_config_rejects_bad():
+    import dataclasses
+
+    with pytest.raises(ConfigError):
+        Config(NodeConfig(node_name=""))  # empty name
+    with pytest.raises(ConfigError):
+        Config(NodeConfig(node_name="a:b"))  # delimiter in name
+    from openr_tpu.config import SparkConfig
+
+    with pytest.raises(ConfigError):
+        Config(
+            NodeConfig(
+                node_name="n",
+                spark=SparkConfig(hold_time_ms=100, keepalive_time_ms=50),
+            )
+        )
+    from openr_tpu.config import AreaConfig
+
+    with pytest.raises(ConfigError):
+        Config(
+            NodeConfig(
+                node_name="n",
+                areas=(AreaConfig(area_id="0"), AreaConfig(area_id="0")),
+            )
+        )
+    with pytest.raises(ConfigError):
+        from openr_tpu.config import OriginatedPrefix
+
+        Config(
+            NodeConfig(
+                node_name="n",
+                originated_prefixes=(OriginatedPrefix(prefix="nonsense"),),
+            )
+        )
+
+
+def test_counters():
+    c = Counters()
+    c.increment("x")
+    c.increment("x", 2)
+    c.set("y", 7)
+    c.add_value("spf_ms", 5)
+    c.add_value("spf_ms", 15)
+    snap = c.snapshot()
+    assert snap["x"] == 3
+    assert snap["y"] == 7
+    assert snap["spf_ms.avg"] == 10
+    assert snap["spf_ms.count"] == 2
+    assert snap["spf_ms.max"] == 15
