@@ -126,6 +126,16 @@ class KvStore(OpenrModule):
         key = (spec.area, spec.node_name)
         if key in self.peers:
             return
+        if spec.area not in self.dbs:
+            # area mismatch between neighbors: reject instead of letting the
+            # sync fiber crash-loop on a missing KvStoreDb
+            log.warning(
+                "%s: peer %s in unconfigured area %r ignored",
+                self.name, spec.node_name, spec.area,
+            )
+            if self.counters is not None:
+                self.counters.increment("kvstore.peers_rejected_bad_area")
+            return
         peer = _Peer(spec)
         self.peers[key] = peer
         if self.counters is not None:
@@ -144,13 +154,19 @@ class KvStore(OpenrModule):
 
     async def _del_peer(self, area: str, node_name: str) -> None:
         peer = self.peers.pop((area, node_name), None)
-        if peer and peer.session is not None:
+        if peer is None:
+            return
+        if peer.sync_task is not None and not peer.sync_task.done():
+            peer.sync_task.cancel()  # no orphaned retry loops/sessions
+        if peer.session is not None:
             try:
                 await peer.session.close()
             except Exception:  # noqa: BLE001
                 pass
         if self.counters is not None:
             self.counters.increment("kvstore.peers_removed")
+        # the departed peer may have been the last unsynced one
+        self._maybe_initial_sync_done()
 
     def add_peer_sync(self, spec: PeerSpec) -> None:
         """Test/emulator convenience: schedule a peer add."""
@@ -163,7 +179,10 @@ class KvStore(OpenrModule):
         requestThriftPeerSync † / processThriftSuccess/Failure †)."""
         area = peer.spec.area
         db = self.dbs[area]
-        while not self.stopped and (area, peer.spec.node_name) in self.peers:
+        key = (area, peer.spec.node_name)
+        # identity check (not just membership): a peer flap replaces the
+        # _Peer under the same key; the stale task must exit
+        while not self.stopped and self.peers.get(key) is peer:
             wait = peer.backoff.time_remaining_s()
             if wait > 0:
                 await asyncio.sleep(wait)
@@ -208,6 +227,7 @@ class KvStore(OpenrModule):
                     self.counters.increment("kvstore.full_sync_failures")
 
     def _maybe_initial_sync_done(self) -> None:
+        # true also for the peers-all-deleted case (vacuous all())
         if all(p.synced for p in self.peers.values()):
             self.initial_sync_done.set()
 
@@ -370,16 +390,12 @@ class KvStore(OpenrModule):
 
 
 def pub_to_json_value(v: Value) -> dict:
-    import json
+    from openr_tpu.types.serde import to_jsonable
 
-    from openr_tpu.types.serde import to_wire
-
-    return json.loads(to_wire(v))
+    return to_jsonable(v)
 
 
 def value_from_json(raw: dict) -> Value:
-    import json
+    from openr_tpu.types.serde import from_jsonable
 
-    from openr_tpu.types.serde import from_wire
-
-    return from_wire(json.dumps(raw), Value)
+    return from_jsonable(raw, Value)

@@ -13,12 +13,6 @@ import time
 from openr_tpu.types.kvstore import TTL_INFINITY, KeyDumpParams, Value
 
 
-def _with_hash(v: Value) -> Value:
-    if v.hash is None:
-        v.with_hash()
-    return v
-
-
 def merge_key_values(
     store: dict[str, Value],
     incoming: dict[str, Value],
@@ -41,7 +35,7 @@ def merge_key_values(
     accepted: dict[str, Value] = {}
     stale: list[str] = []
     for key, inc in incoming.items():
-        inc = _with_hash(inc)
+        inc = inc.with_hash()
         cur = store.get(key)
         if cur is None:
             if inc.value is None:
@@ -49,7 +43,7 @@ def merge_key_values(
             store[key] = inc
             accepted[key] = inc
             continue
-        _with_hash(cur)
+        cur.with_hash()
         win = (inc.version, inc.originator_id, inc.hash)
         have = (cur.version, cur.originator_id, cur.hash)
         if win[:2] == have[:2]:
@@ -173,7 +167,7 @@ class KvStoreDb:
                 value=None,
                 ttl=v.ttl,
                 ttl_version=v.ttl_version,
-                hash=_with_hash(v).hash,
+                hash=v.with_hash().hash,
             )
             for k, v in self.kv.items()
         }

@@ -12,7 +12,7 @@ from typing import Any, Protocol
 
 from openr_tpu.rpc import RpcClient, RpcError
 from openr_tpu.types.kvstore import Publication
-from openr_tpu.types.serde import from_wire, to_wire
+from openr_tpu.types.serde import from_jsonable, to_jsonable
 
 
 class KvPeerSession(Protocol):
@@ -26,15 +26,11 @@ class KvPeerSession(Protocol):
 
 
 def pub_to_json(pub: Publication) -> dict:
-    import json
-
-    return json.loads(to_wire(pub))
+    return to_jsonable(pub)
 
 
 def pub_from_json(raw: dict) -> Publication:
-    import json
-
-    return from_wire(json.dumps(raw), Publication)
+    return from_jsonable(raw, Publication)
 
 
 class InProcKvTransport:

@@ -1,0 +1,3 @@
+"""LinkMonitor (reference: openr/link-monitor/ †)."""
+
+from openr_tpu.linkmonitor.linkmonitor import LinkMonitor  # noqa: F401

@@ -1,0 +1,289 @@
+"""LinkMonitor: interfaces → Spark; neighbors → adjacencies → KvStore.
+
+reference: openr/link-monitor/LinkMonitor.{h,cpp} † —
+  * consumes InterfaceEvents (netlink in the reference; the platform/
+    emulator seam here), applies include/exclude regexes, link-flap
+    exponential backoff damping, and tells Spark which interfaces to run
+    discovery on;
+  * consumes Spark NeighborEvents, maintains the adjacency set, assigns
+    adjacency labels (SR), computes metrics (hop or RTT-based);
+  * advertises `adj:<node>` via KvStoreClient.persist_key (throttled);
+  * emits PeerEvents so KvStore opens/closes peer sync sessions;
+  * node overload + per-link metric override API (breeze lm set-*).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.constants import SR_LOCAL_RANGE, adj_key
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.common.throttle import AsyncDebounce
+from openr_tpu.config import Config
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.kvstore import PeerEvent, PeerSpec
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
+from openr_tpu.types.events import (
+    InterfaceInfo,
+    NeighborEvent,
+    NeighborEventType,
+    NeighborInfo,
+)
+from openr_tpu.types.serde import to_wire
+from openr_tpu.types.topology import Adjacency, AdjacencyDatabase
+
+log = logging.getLogger(__name__)
+
+
+class LinkMonitor(OpenrModule):
+    def __init__(
+        self,
+        config: Config,
+        spark,  # Spark (for add/remove_interface)
+        kv_client: KvStoreClient,
+        neighbor_events_reader: RQueue,
+        peer_events_queue: ReplicateQueue,
+        interface_events_reader: RQueue | None = None,
+        counters=None,
+    ):
+        super().__init__(f"{config.node_name}.linkmonitor", counters=counters)
+        self.config = config
+        self.node_name = config.node_name
+        self.spark = spark
+        self.kv_client = kv_client
+        self.nbr_reader = neighbor_events_reader
+        self.peer_queue = peer_events_queue
+        self.if_reader = interface_events_reader
+
+        self.interfaces: dict[str, InterfaceInfo] = {}
+        self._if_backoff: dict[str, ExponentialBackoff] = {}
+        # (area, neighbor, local_if) -> (NeighborInfo, adj_label)
+        self.adjacencies: dict[tuple[str, str, str], tuple[NeighborInfo, int]] = {}
+        self.node_overloaded = False
+        self._metric_override: dict[str, int] = {}  # if_name -> metric
+        self._next_adj_label = SR_LOCAL_RANGE[0]
+        self._advertise_debounce = AsyncDebounce(
+            min_ms=10,
+            max_ms=self.config.node.link_monitor.linkflap_initial_backoff_ms
+            + 1000,
+            fn=self.advertise_adjacencies,
+        )
+
+    # ----------------------------------------------------------------- main
+
+    async def main(self) -> None:
+        self.spawn(self._neighbor_loop(), name=f"{self.name}.nbr")
+        if self.if_reader is not None:
+            self.spawn(self._interface_loop(), name=f"{self.name}.if")
+
+    # ----------------------------------------------------------- interfaces
+
+    def _if_allowed(self, name: str) -> bool:
+        lm = self.config.node.link_monitor
+        if lm.include_interface_regexes:
+            if not any(
+                re.fullmatch(p, name) for p in lm.include_interface_regexes
+            ):
+                return False
+        if any(re.fullmatch(p, name) for p in lm.exclude_interface_regexes):
+            return False
+        return True
+
+    async def _interface_loop(self) -> None:
+        while True:
+            try:
+                ev = await self.if_reader.get()
+            except QueueClosedError:
+                return
+            for info in ev.interfaces:
+                self.update_interface(info)
+
+    def update_interface(self, info: InterfaceInfo) -> None:
+        """Apply one interface state change with flap damping.
+
+        reference: LinkMonitor interface backoff (linkflap_*_backoff_ms †):
+        a flapping interface waits out an exponential hold-down before
+        Spark restarts discovery on it."""
+        if not self._if_allowed(info.name):
+            return
+        lm = self.config.node.link_monitor
+        prev = self.interfaces.get(info.name)
+        self.interfaces[info.name] = info
+        backoff = self._if_backoff.setdefault(
+            info.name,
+            ExponentialBackoff(
+                lm.linkflap_initial_backoff_ms, lm.linkflap_max_backoff_ms
+            ),
+        )
+        if info.is_up:
+            if prev is not None and not prev.is_up:
+                backoff.report_error()  # flap: down→up counts against it
+            wait = backoff.time_remaining_s()
+            if wait > 0:
+                if self.counters is not None:
+                    self.counters.increment("linkmonitor.flap_damped")
+                self.spawn(self._delayed_if_up(info.name, wait))
+            else:
+                self.spark.add_interface(info.name)
+        else:
+            self.spark.remove_interface(info.name)
+
+    async def _delayed_if_up(self, if_name: str, wait: float) -> None:
+        import asyncio
+
+        await asyncio.sleep(wait)
+        info = self.interfaces.get(if_name)
+        if info is not None and info.is_up and not self.stopped:
+            self.spark.add_interface(if_name)
+
+    # ------------------------------------------------------------ neighbors
+
+    async def _neighbor_loop(self) -> None:
+        while True:
+            try:
+                ev: NeighborEvent = await self.nbr_reader.get()
+            except QueueClosedError:
+                return
+            self._process_neighbor_event(ev)
+
+    def _process_neighbor_event(self, ev: NeighborEvent) -> None:
+        info = ev.info
+        key = (info.area, info.node_name, info.local_if)
+        if ev.type in (
+            NeighborEventType.NEIGHBOR_UP,
+            NeighborEventType.NEIGHBOR_RESTARTED,
+        ):
+            label = (
+                self.adjacencies[key][1]
+                if key in self.adjacencies
+                else self._alloc_adj_label()
+            )
+            self.adjacencies[key] = (info, label)
+            self.peer_queue.push(
+                PeerEvent(
+                    area=info.area,
+                    peers_to_add=[
+                        PeerSpec(
+                            node_name=info.node_name,
+                            endpoint=self._peer_endpoint(info),
+                            area=info.area,
+                        )
+                    ],
+                )
+            )
+            if self.counters is not None:
+                self.counters.increment("linkmonitor.neighbor_up")
+        elif ev.type == NeighborEventType.NEIGHBOR_DOWN:
+            self.adjacencies.pop(key, None)
+            # only drop the kvstore peer when no adjacency to that node
+            # remains on any interface (parallel links)
+            if not any(
+                k[0] == info.area and k[1] == info.node_name
+                for k in self.adjacencies
+            ):
+                self.peer_queue.push(
+                    PeerEvent(
+                        area=info.area, peers_to_del=[info.node_name]
+                    )
+                )
+            if self.counters is not None:
+                self.counters.increment("linkmonitor.neighbor_down")
+        elif ev.type == NeighborEventType.NEIGHBOR_RESTARTING:
+            # graceful restart: hold the adjacency, don't re-advertise
+            # (reference: GR keeps forwarding state while control restarts †)
+            return
+        elif ev.type == NeighborEventType.NEIGHBOR_RTT_CHANGE:
+            if key in self.adjacencies:
+                label = self.adjacencies[key][1]
+                self.adjacencies[key] = (info, label)
+            if not self.config.node.link_monitor.use_rtt_metric:
+                return
+        self._advertise_debounce.poke()
+
+    def _peer_endpoint(self, info: NeighborInfo):
+        """In-proc transports key peers by node name (endpoint None);
+        TCP transports get (host, port)."""
+        if info.kvstore_port:
+            return (info.endpoint_host or "127.0.0.1", info.kvstore_port)
+        return None
+
+    def _alloc_adj_label(self) -> int:
+        label = self._next_adj_label
+        self._next_adj_label += 1
+        if self._next_adj_label > SR_LOCAL_RANGE[1]:
+            self._next_adj_label = SR_LOCAL_RANGE[0]
+        return label
+
+    # ---------------------------------------------------------- advertising
+
+    def _metric_for(self, info: NeighborInfo) -> int:
+        if info.local_if in self._metric_override:
+            return self._metric_override[info.local_if]
+        if self.config.node.link_monitor.use_rtt_metric and info.rtt_us:
+            return max(1, info.rtt_us // 100)  # reference: rtt-based metric †
+        return 1  # hop count
+
+    def build_adjacency_db(self, area: str) -> AdjacencyDatabase:
+        adjs = []
+        sr = self.config.node.segment_routing
+        for (a, node, local_if), (info, label) in sorted(
+            self.adjacencies.items()
+        ):
+            if a != area:
+                continue
+            adjs.append(
+                Adjacency(
+                    other_node_name=node,
+                    if_name=local_if,
+                    other_if_name=info.remote_if,
+                    metric=self._metric_for(info),
+                    adj_label=label if sr.enable else 0,
+                    rtt_us=info.rtt_us,
+                )
+            )
+        return AdjacencyDatabase(
+            this_node_name=self.node_name,
+            adjacencies=tuple(adjs),
+            is_overloaded=self.node_overloaded,
+            node_label=self._node_label(),
+            area=area,
+        )
+
+    def _node_label(self) -> int:
+        sr = self.config.node.segment_routing
+        if not sr.enable:
+            return 0
+        if sr.node_segment_label:
+            return sr.node_segment_label
+        # deterministic auto-allocation refined by RangeAllocator later
+        lo, hi = sr.sr_global_range
+        return lo + (hash(self.node_name) % (hi - lo))
+
+    def advertise_adjacencies(self) -> None:
+        """Persist adj:<node> into every area's KvStore.
+
+        reference: LinkMonitor::advertiseAdjacencies † via
+        KvStoreClientInternal::persistKey."""
+        for area in self.config.area_ids():
+            db = self.build_adjacency_db(area)
+            self.kv_client.persist_key(area, adj_key(self.node_name), to_wire(db))
+        if self.counters is not None:
+            self.counters.increment("linkmonitor.adj_advertised")
+
+    # ------------------------------------------------------------- operator
+
+    def set_node_overload(self, overloaded: bool) -> None:
+        """reference: OpenrCtrl setNodeOverload → LinkMonitor †."""
+        if self.node_overloaded != overloaded:
+            self.node_overloaded = overloaded
+            self._advertise_debounce.poke()
+
+    def set_link_metric(self, if_name: str, metric: int | None) -> None:
+        """reference: setInterfaceMetric †."""
+        if metric is None:
+            self._metric_override.pop(if_name, None)
+        else:
+            self._metric_override[if_name] = metric
+        self._advertise_debounce.poke()

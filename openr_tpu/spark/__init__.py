@@ -1,0 +1,10 @@
+"""Spark: neighbor discovery over link-local packet I/O.
+
+reference: openr/spark/ † — hello/handshake/heartbeat FSM per
+(interface, neighbor), hold-timer liveness, RTT measurement, graceful
+restart, with the IoProvider seam making packet I/O mockable
+(reference: openr/spark/IoProvider.h † + tests/MockIoProvider †).
+"""
+
+from openr_tpu.spark.io import IoProvider, MockIoHub, UdpIoProvider  # noqa: F401
+from openr_tpu.spark.spark import Spark, SparkNeighborState  # noqa: F401

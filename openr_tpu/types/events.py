@@ -1,0 +1,59 @@
+"""Inter-module event types (reference: openr/if/Types.thrift † neighbor/
+interface event structs + openr/spark/Spark.h † NeighborEvent)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class NeighborEventType(enum.IntEnum):
+    """reference: NeighborEventType in Types.thrift †."""
+
+    NEIGHBOR_UP = 0
+    NEIGHBOR_DOWN = 1
+    NEIGHBOR_RESTARTING = 2
+    NEIGHBOR_RESTARTED = 3
+    NEIGHBOR_RTT_CHANGE = 4
+
+
+@dataclass(frozen=True)
+class NeighborInfo:
+    """Everything LinkMonitor needs to build an adjacency + KvStore peer.
+
+    reference: SparkNeighbor fields surfaced in NeighborEvent †."""
+
+    node_name: str
+    local_if: str
+    remote_if: str = ""
+    area: str = "0"
+    kvstore_port: int = 0
+    ctrl_port: int = 0
+    hold_time_ms: int = 0
+    gr_time_ms: int = 0
+    rtt_us: int = 0
+    label: int = 0
+    # transport endpoint for kvstore peering (host for TCP; node name for
+    # in-proc transports)
+    endpoint_host: str = ""
+
+
+@dataclass(frozen=True)
+class NeighborEvent:
+    type: NeighborEventType
+    info: NeighborInfo
+
+
+@dataclass(frozen=True)
+class InterfaceInfo:
+    """reference: InterfaceEntry / netlink link state †."""
+
+    name: str
+    is_up: bool = True
+    ifindex: int = 0
+    addrs: tuple[str, ...] = ()
+
+
+@dataclass
+class InterfaceEvent:
+    interfaces: list[InterfaceInfo] = field(default_factory=list)

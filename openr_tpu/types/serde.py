@@ -107,6 +107,21 @@ def _decode_key(k: str, hint: Any) -> Any:
     return k
 
 
+def to_jsonable(obj: Any) -> Any:
+    """Dataclass → plain JSON-ready dict/list tree (no string encoding).
+
+    Use this when embedding a schema object inside a larger RPC message —
+    the transport serializes once at the socket boundary instead of
+    round-tripping every nested object through its own JSON string.
+    """
+    return _encode(obj)
+
+
+def from_jsonable(raw: Any, cls: Type[T]) -> T:
+    """Inverse of to_jsonable."""
+    return _decode(raw, cls)
+
+
 def to_wire(obj: Any) -> bytes:
     """Serialize a schema dataclass to canonical JSON bytes.
 

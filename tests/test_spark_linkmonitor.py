@@ -1,0 +1,332 @@
+"""Spark + LinkMonitor tests.
+
+reference analogues: openr/spark/tests/SparkTest.cpp † (MockIoProvider
+wiring N Spark instances with latency/partitions; FSM, hold timers, GR)
+and openr/link-monitor/tests/LinkMonitorTest.cpp † (adjacency
+advertisement, flap damping, overload)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.common.constants import adj_key
+from openr_tpu.config import Config, NodeConfig, SparkConfig
+from openr_tpu.kvstore import InProcKvTransport, KvStore, KvStoreClient
+from openr_tpu.linkmonitor import LinkMonitor
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.spark import MockIoHub, Spark, SparkNeighborState
+from openr_tpu.types.events import (
+    InterfaceEvent,
+    InterfaceInfo,
+    NeighborEventType,
+)
+from openr_tpu.types.serde import from_wire
+from openr_tpu.types.topology import AdjacencyDatabase
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+FAST = SparkConfig(
+    hello_time_ms=60,
+    fastinit_hello_time_ms=20,
+    handshake_time_ms=20,
+    keepalive_time_ms=40,
+    hold_time_ms=200,
+    graceful_restart_time_ms=600,
+)
+
+
+def mk_spark(hub, name, kvstore_port=0):
+    cfg = Config(NodeConfig(node_name=name, spark=FAST))
+    q = ReplicateQueue(name=f"{name}.nbr")
+    sp = Spark(
+        cfg,
+        hub.io_for(name),
+        q,
+        kvstore_port=kvstore_port,
+        counters=Counters(),
+    )
+    return sp, q
+
+
+async def settle(cond, timeout=3.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            return False
+        await asyncio.sleep(0.01)
+    return True
+
+
+def test_two_node_discovery_and_hold_timer():
+    async def main():
+        hub = MockIoHub()
+        sa, qa = mk_spark(hub, "a", kvstore_port=1111)
+        sb, qb = mk_spark(hub, "b", kvstore_port=2222)
+        ra, rb = qa.get_reader(), qb.get_reader()
+        hub.link("a", "if-ab", "b", "if-ba", latency_ms=1)
+        await sa.start()
+        await sb.start()
+        sa.add_interface("if-ab")
+        sb.add_interface("if-ba")
+
+        ok = await settle(
+            lambda: sa.neighbors.get(("if-ab", "b")) is not None
+            and sa.neighbors[("if-ab", "b")].state
+            == SparkNeighborState.ESTABLISHED
+            and sb.neighbors.get(("if-ba", "a")) is not None
+            and sb.neighbors[("if-ba", "a")].state
+            == SparkNeighborState.ESTABLISHED
+        )
+        assert ok, "neighbors did not establish"
+        ev = ra.try_get()
+        assert ev is not None and ev.type == NeighborEventType.NEIGHBOR_UP
+        assert ev.info.node_name == "b"
+        assert ev.info.kvstore_port == 2222  # handshake carried endpoint
+        assert ev.info.remote_if == "if-ba"
+
+        # partition → hold timer → NEIGHBOR_DOWN on both sides
+        hub.set_link("a", "if-ab", up=False)
+        ok = await settle(
+            lambda: ("if-ab", "b") not in sa.neighbors
+            and ("if-ba", "a") not in sb.neighbors,
+            timeout=3.0,
+        )
+        assert ok, "hold timer did not fire"
+        downs = []
+        while (e := ra.try_get()) is not None:
+            downs.append(e.type)
+        assert NeighborEventType.NEIGHBOR_DOWN in downs
+
+        # heal → re-establish
+        hub.set_link("a", "if-ab", up=True)
+        sa.add_interface("if-ab")  # re-fastinit
+        ok = await settle(
+            lambda: sa.neighbors.get(("if-ab", "b")) is not None
+            and sa.neighbors[("if-ab", "b")].state
+            == SparkNeighborState.ESTABLISHED
+        )
+        assert ok, "did not re-establish after heal"
+        await sa.stop()
+        await sb.stop()
+
+    run(main())
+
+
+def test_three_node_star():
+    """Hub node sees both leaves on separate interfaces."""
+
+    async def main():
+        hub = MockIoHub()
+        sh, qh = mk_spark(hub, "hub")
+        s1, _ = mk_spark(hub, "leaf1")
+        s2, _ = mk_spark(hub, "leaf2")
+        hub.link("hub", "if-1", "leaf1", "if-h")
+        hub.link("hub", "if-2", "leaf2", "if-h")
+        for s in (sh, s1, s2):
+            await s.start()
+        sh.add_interface("if-1")
+        sh.add_interface("if-2")
+        s1.add_interface("if-h")
+        s2.add_interface("if-h")
+        ok = await settle(
+            lambda: len(
+                [
+                    n
+                    for n in sh.neighbors.values()
+                    if n.state == SparkNeighborState.ESTABLISHED
+                ]
+            )
+            == 2
+        )
+        assert ok, "star did not form"
+        for s in (sh, s1, s2):
+            await s.stop()
+
+    run(main())
+
+
+def test_area_negotiation():
+    from openr_tpu.config import AreaConfig
+
+    async def main():
+        hub = MockIoHub()
+        cfg_a = Config(
+            NodeConfig(
+                node_name="a",
+                spark=FAST,
+                areas=(
+                    AreaConfig(area_id="spine", neighbor_regexes=("b.*",)),
+                    AreaConfig(area_id="0", neighbor_regexes=(".*",)),
+                ),
+            )
+        )
+        qa = ReplicateQueue()
+        ra = qa.get_reader()
+        sa = Spark(cfg_a, hub.io_for("a"), qa, counters=Counters())
+        sb, _ = mk_spark(hub, "b1")
+        hub.link("a", "if-ab", "b1", "if-ba")
+        await sa.start()
+        await sb.start()
+        sa.add_interface("if-ab")
+        sb.add_interface("if-ba")
+        ok = await settle(lambda: ra.try_get() is not None or len(sa.neighbors) > 0)
+        assert ok
+        ok = await settle(
+            lambda: sa.neighbors.get(("if-ab", "b1")) is not None
+            and sa.neighbors[("if-ab", "b1")].state
+            == SparkNeighborState.ESTABLISHED
+        )
+        assert ok
+        # a matched "b.*" → offered area "spine"
+        assert sa._negotiate_area("b1") == "spine"
+        await sa.stop()
+        await sb.stop()
+
+    run(main())
+
+
+def _mk_node(hub, transport, name):
+    """Full discovery stack for one node: Spark + KvStore + LinkMonitor."""
+    from openr_tpu.config import LinkMonitorConfig
+
+    cfg = Config(NodeConfig(node_name=name, spark=FAST))
+    counters = Counters()
+    pubq = ReplicateQueue(name=f"{name}.pub")
+    nbrq = ReplicateQueue(name=f"{name}.nbr")
+    peerq = ReplicateQueue(name=f"{name}.peer")
+    ifq = ReplicateQueue(name=f"{name}.if")
+    store = KvStore(
+        cfg, transport, pubq, peer_events_reader=peerq.get_reader(),
+        counters=counters,
+    )
+    transport.register(name, store)
+    client = KvStoreClient(store, name, pubq.get_reader(), counters=counters)
+    spark = Spark(cfg, hub.io_for(name), nbrq, counters=counters)
+    lm = LinkMonitor(
+        cfg,
+        spark,
+        client,
+        nbrq.get_reader(),
+        peerq,
+        interface_events_reader=ifq.get_reader(),
+        counters=counters,
+    )
+    return dict(
+        cfg=cfg, store=store, client=client, spark=spark, lm=lm,
+        pubq=pubq, ifq=ifq, counters=counters,
+    )
+
+
+def test_end_to_end_discovery_to_kvstore():
+    """The §3.2 call stack: link up → Spark discovery → LinkMonitor
+    adjacency → adj: key in KvStore → flooded to the peer."""
+
+    async def main():
+        hub = MockIoHub()
+        transport = InProcKvTransport()
+        a = _mk_node(hub, transport, "a")
+        b = _mk_node(hub, transport, "b")
+        hub.link("a", "if-ab", "b", "if-ba")
+        for n in (a, b):
+            for mod in ("store", "client", "spark", "lm"):
+                await n[mod].start()
+        a["ifq"].push(InterfaceEvent(interfaces=[InterfaceInfo(name="if-ab")]))
+        b["ifq"].push(InterfaceEvent(interfaces=[InterfaceInfo(name="if-ba")]))
+
+        # both adj: keys present in BOTH stores (advertised + flooded)
+        def converged():
+            for st in (a["store"], b["store"]):
+                for node in ("a", "b"):
+                    v = st.get_key("0", adj_key(node))
+                    if v is None:
+                        return False
+                    db = from_wire(v.value, AdjacencyDatabase)
+                    if len(db.adjacencies) != 1:
+                        return False
+            return True
+
+        ok = await settle(converged, timeout=5.0)
+        assert ok, "discovery → adj → kvstore flood did not converge"
+        db = from_wire(
+            a["store"].get_key("0", adj_key("b")).value, AdjacencyDatabase
+        )
+        assert db.adjacencies[0].other_node_name == "a"
+        assert db.adjacencies[0].if_name == "if-ba"
+        assert db.adjacencies[0].other_if_name == "if-ab"
+
+        # kill the link: adjacency withdrawn everywhere
+        hub.set_link("a", "if-ab", up=False)
+
+        def withdrawn():
+            va = a["store"].get_key("0", adj_key("a"))
+            vb = b["store"].get_key("0", adj_key("b"))
+            if va is None or vb is None:
+                return False
+            return (
+                len(from_wire(va.value, AdjacencyDatabase).adjacencies) == 0
+                and len(from_wire(vb.value, AdjacencyDatabase).adjacencies) == 0
+            )
+
+        ok = await settle(withdrawn, timeout=5.0)
+        assert ok, "adjacency was not withdrawn after link down"
+        for n in (a, b):
+            for mod in ("lm", "spark", "client", "store"):
+                await n[mod].stop()
+
+    run(main())
+
+
+def test_linkmonitor_flap_damping():
+    async def main():
+        hub = MockIoHub()
+        transport = InProcKvTransport()
+        n = _mk_node(hub, transport, "a")
+        await n["store"].start()
+        await n["client"].start()
+        await n["spark"].start()
+        await n["lm"].start()
+        lm = n["lm"]
+        # flap the interface rapidly
+        for _ in range(4):
+            lm.update_interface(InterfaceInfo(name="if-x", is_up=True))
+            lm.update_interface(InterfaceInfo(name="if-x", is_up=False))
+        lm.update_interface(InterfaceInfo(name="if-x", is_up=True))
+        # damped: interface NOT immediately handed to spark
+        assert "if-x" not in n["spark"].interfaces
+        assert n["counters"].get("linkmonitor.flap_damped") > 0
+        for mod in ("lm", "spark", "client", "store"):
+            await n[mod].stop()
+
+    run(main())
+
+
+def test_node_overload_advertised():
+    async def main():
+        hub = MockIoHub()
+        transport = InProcKvTransport()
+        n = _mk_node(hub, transport, "a")
+        for mod in ("store", "client", "spark", "lm"):
+            await n[mod].start()
+        n["lm"].set_node_overload(True)
+        ok = await settle(
+            lambda: (v := n["store"].get_key("0", adj_key("a"))) is not None
+            and from_wire(v.value, AdjacencyDatabase).is_overloaded,
+            timeout=3.0,
+        )
+        assert ok
+        n["lm"].set_node_overload(False)
+        ok = await settle(
+            lambda: not from_wire(
+                n["store"].get_key("0", adj_key("a")).value, AdjacencyDatabase
+            ).is_overloaded,
+            timeout=3.0,
+        )
+        assert ok
+        for mod in ("lm", "spark", "client", "store"):
+            await n[mod].stop()
+
+    run(main())
