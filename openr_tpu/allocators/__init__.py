@@ -1,0 +1,4 @@
+"""Distributed allocators (reference: openr/allocators/ †)."""
+
+from openr_tpu.allocators.range_allocator import RangeAllocator  # noqa: F401
+from openr_tpu.allocators.prefix_allocator import PrefixAllocator  # noqa: F401

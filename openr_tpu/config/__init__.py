@@ -16,6 +16,7 @@ from openr_tpu.config.config import (  # noqa: F401
     LinkMonitorConfig,
     NodeConfig,
     OriginatedPrefix,
+    PrefixAllocationConfig,
     SparkConfig,
     SegmentRoutingConfig,
     WatchdogConfig,

@@ -64,6 +64,7 @@ class DecisionConfig:
     debounce_min_ms: int = C.DECISION_DEBOUNCE_MIN_MS
     debounce_max_ms: int = C.DECISION_DEBOUNCE_MAX_MS
     # TPU solver knobs (rebuild-specific)
+    use_tpu_solver: bool = True  # False → CPU oracle path (tests/tiny nodes)
     use_dense_kernel: bool | None = None  # None = auto
     enable_lfa: bool = False
 
@@ -123,6 +124,19 @@ class OriginatedPrefix:
 
 
 @dataclass
+class PrefixAllocationConfig:
+    """reference: OpenrConfig.thrift † PrefixAllocationConfig — carve
+    `seed_prefix` into /alloc_prefix_len blocks; each node elects a
+    collision-free block index through KvStore write conflicts."""
+
+    seed_prefix: str = ""
+    alloc_prefix_len: int = 0
+    # STATIC mode pins the index instead of electing (reference:
+    # prefix_allocation_mode †)
+    static_index: int | None = None
+
+
+@dataclass
 class NodeConfig:
     """Root config document (reference: OpenrConfig.thrift † OpenrConfig)."""
 
@@ -138,6 +152,7 @@ class NodeConfig:
     )
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     originated_prefixes: tuple[OriginatedPrefix, ...] = ()
+    prefix_allocation: PrefixAllocationConfig | None = None
     enable_v4: bool = True
     enable_best_route_selection: bool = True
     # ports (0 = ephemeral, for in-process multi-node tests)
@@ -221,6 +236,17 @@ class Config:
                 IpPrefix.make(p.prefix)
             except ValueError as e:
                 raise ConfigError(f"bad originated prefix {p.prefix!r}") from e
+        pa = n.prefix_allocation
+        if pa is not None:
+            try:
+                seed = IpPrefix.make(pa.seed_prefix)
+            except ValueError as e:
+                raise ConfigError(f"bad seed prefix {pa.seed_prefix!r}") from e
+            if not (seed.prefix_len < pa.alloc_prefix_len <= (32 if seed.is_v4 else 128)):
+                raise ConfigError(
+                    "prefix_allocation: alloc_prefix_len must be within "
+                    f"({seed.prefix_len}, {32 if seed.is_v4 else 128}]"
+                )
 
     # ---- accessors --------------------------------------------------------
 

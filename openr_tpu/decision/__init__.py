@@ -9,4 +9,5 @@ semantics, used for RIB-equivalence tests) and the **TPU** batched kernel
 `LinkState.to_csr()`.
 """
 
+from openr_tpu.decision.decision import Decision, merge_area_ribs  # noqa: F401
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState  # noqa: F401

@@ -1,0 +1,281 @@
+"""The Decision module: KvStore publications → LSDB → RIB → route deltas.
+
+reference: openr/decision/Decision.cpp † — Decision subscribes to the
+KvStore publications queue, parses `adj:<node>` / `prefix:...` keys into
+per-area LinkState/PrefixState, debounces bursts with a (min, max)
+AsyncThrottle-style window, rebuilds routes, and emits the delta as a
+DecisionRouteUpdate on the route-updates queue.
+
+TPU-first divergence: the rebuild is one batched-SSSP kernel launch
+(`TpuSpfSolver`) instead of the reference's per-root scalar Dijkstra loop;
+the heavy compute runs off the event loop via ``asyncio.to_thread`` so
+flooding/RPC latency is never blocked behind a solve.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import replace
+
+from openr_tpu.common import constants as C
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.common.throttle import AsyncDebounce
+from openr_tpu.config import Config
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
+from openr_tpu.decision.oracle import metric_key
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
+from openr_tpu.types.kvstore import Publication, Value
+from openr_tpu.types.routes import (
+    RouteDatabase,
+    RouteUpdate,
+    RouteUpdateType,
+    diff_route_dbs,
+)
+from openr_tpu.types.serde import from_wire
+from openr_tpu.types.topology import AdjacencyDatabase, PrefixDatabase
+
+log = logging.getLogger(__name__)
+
+
+def merge_area_ribs(
+    per_area: dict[str, RouteDatabase], my_node: str
+) -> RouteDatabase:
+    """Cross-area best-route selection.
+
+    reference: openr/decision/SpfSolver.cpp † selectBestRoutes runs across
+    ALL areas' prefix entries: highest metric key wins; at equal metrics and
+    equal IGP cost the nexthop sets are unioned (equal-cost multi-area ECMP);
+    otherwise the lower-IGP-cost area wins.
+    """
+    areas = sorted(per_area)
+    if len(areas) == 1:
+        return per_area[areas[0]]
+    out = RouteDatabase(this_node_name=my_node)
+    for area in areas:
+        rdb = per_area[area]
+        for prefix, entry in rdb.unicast_routes.items():
+            cur = out.unicast_routes.get(prefix)
+            if cur is None:
+                out.unicast_routes[prefix] = entry
+                continue
+            ek = metric_key(entry.best_entry) if entry.best_entry else (0, 0, 0)
+            ck = metric_key(cur.best_entry) if cur.best_entry else (0, 0, 0)
+            if ek > ck or (ek == ck and entry.igp_cost < cur.igp_cost):
+                out.unicast_routes[prefix] = entry
+            elif ek == ck and entry.igp_cost == cur.igp_cost:
+                merged = tuple(
+                    sorted(
+                        set(cur.nexthops) | set(entry.nexthops),
+                        key=lambda nh: (nh.neighbor_node, nh.if_name),
+                    )
+                )
+                out.unicast_routes[prefix] = replace(cur, nexthops=merged)
+        for label, mentry in rdb.mpls_routes.items():
+            out.mpls_routes.setdefault(label, mentry)
+    return out
+
+
+class Decision(OpenrModule):
+    """Per-node route computation engine.
+
+    Wiring (reference: Main.cpp †): reads the KvStore publications queue,
+    writes the route-updates queue consumed by Fib. Also exposes
+    synchronous accessors (`get_route_db`, `get_adj_dbs`, ...) used by the
+    OpenrCtrl handler via cross-thread-future-style awaits.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        kvstore_pub_reader: RQueue,
+        route_updates_queue: ReplicateQueue,
+        solver: str | None = None,  # "tpu" | "cpu" | None (config default)
+        counters=None,
+    ):
+        super().__init__(f"{config.node_name}.decision", counters=counters)
+        self.config = config
+        self.node_name = config.node_name
+        self.pub_reader = kvstore_pub_reader
+        self.route_updates = route_updates_queue
+        self.link_states: dict[str, LinkState] = {
+            a: LinkState(a) for a in config.area_ids()
+        }
+        self.prefix_states: dict[str, PrefixState] = {
+            a: PrefixState(a) for a in config.area_ids()
+        }
+        dcfg = config.node.decision
+        backend = solver or ("tpu" if dcfg.use_tpu_solver else "cpu")
+        self.backend = backend
+        self._tpu = None
+        if backend == "tpu":
+            # lazy: the cpu/oracle path must not pay the jax import
+            from openr_tpu.decision.spf_backend import TpuSpfSolver
+
+            self._tpu = TpuSpfSolver(use_dense=dcfg.use_dense_kernel)
+        self.debounce = AsyncDebounce(
+            dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes
+        )
+        self.rib = RouteDatabase(this_node_name=self.node_name)
+        self.rib_computed = asyncio.Event()  # RIB_COMPUTED init gate
+        self.rib_policy = None  # set via apply_rib_policy (openr_tpu.policy)
+        self._spf_runs = 0
+        self._last_spf_ms = 0.0
+
+    # ------------------------------------------------------------------ run
+
+    async def main(self) -> None:
+        self.spawn(self._pub_loop(), name=f"{self.name}.pubs")
+
+    async def cleanup(self) -> None:
+        self.debounce.cancel()
+
+    # ----------------------------------------------------------- publication
+
+    async def _pub_loop(self) -> None:
+        while True:
+            try:
+                pub = await self.pub_reader.get()
+            except QueueClosedError:
+                return
+            if self.process_publication(pub):
+                self.debounce.poke()
+
+    def process_publication(self, pub: Publication) -> bool:
+        """Fold one publication into the LSDB; True if topology or prefix
+        state changed (reference: Decision::processPublication †)."""
+        area = pub.area
+        ls = self.link_states.get(area)
+        ps = self.prefix_states.get(area)
+        if ls is None:
+            # unknown area: learn it dynamically (reference requires areas
+            # pre-configured; we accept them to ease emulation)
+            ls = self.link_states[area] = LinkState(area)
+            ps = self.prefix_states[area] = PrefixState(area)
+        changed = False
+        for key, val in pub.key_vals.items():
+            if val.value is None:
+                continue  # ttl refresh — no payload change
+            changed |= self._apply_key(ls, ps, key, val)
+        for key in pub.expired_keys:
+            changed |= self._expire_key(ls, ps, key)
+        if changed:
+            self.counters and self.counters.increment("decision.lsdb_changes")
+        return changed
+
+    def _apply_key(
+        self, ls: LinkState, ps: PrefixState, key: str, val: Value
+    ) -> bool:
+        node = C.parse_adj_key(key)
+        if node is not None:
+            try:
+                db = from_wire(val.value, AdjacencyDatabase)
+            except Exception:  # noqa: BLE001 — corrupt key: ignore
+                log.warning("%s: bad adj db in key %s", self.name, key)
+                return False
+            if db.this_node_name != node:
+                log.warning("%s: adj key %s names node %s", self.name, key, db.this_node_name)
+            return ls.update_adjacency_db(db)
+        parsed = C.parse_prefix_key(key)
+        if parsed is not None:
+            pnode, _parea, _pfx = parsed
+            try:
+                db = from_wire(val.value, PrefixDatabase)
+            except Exception:  # noqa: BLE001
+                log.warning("%s: bad prefix db in key %s", self.name, key)
+                return False
+            if db.delete_prefix:
+                return any(
+                    ps.withdraw(pnode, e.prefix) for e in db.prefix_entries
+                )
+            return bool(ps.update_prefix_db(db))
+        return False
+
+    def _expire_key(self, ls: LinkState, ps: PrefixState, key: str) -> bool:
+        node = C.parse_adj_key(key)
+        if node is not None:
+            return ls.delete_adjacency_db(node)
+        parsed = C.parse_prefix_key(key)
+        if parsed is not None:
+            pnode, _area, pfx = parsed
+            if pfx:
+                from openr_tpu.types.network import IpPrefix
+
+                return ps.withdraw(pnode, IpPrefix(prefix=pfx))
+            return bool(ps.withdraw_node(pnode))
+        return False
+
+    # -------------------------------------------------------------- rebuild
+
+    def _compute_area(self, ls: LinkState, ps: PrefixState) -> RouteDatabase:
+        if self._tpu is not None:
+            return self._tpu.compute_routes(ls, ps, self.node_name)
+        return oracle_compute_routes(ls, ps, self.node_name)
+
+    def _snapshot_states(self) -> dict[str, tuple[LinkState, PrefixState]]:
+        """Taken on the event loop, so the off-thread solve never races
+        _pub_loop's LSDB mutations."""
+        return {
+            a: (self.link_states[a].snapshot(), self.prefix_states[a].snapshot())
+            for a in self.link_states
+        }
+
+    def compute_rib(
+        self,
+        states: dict[str, tuple[LinkState, PrefixState]] | None = None,
+    ) -> RouteDatabase:
+        """Full cross-area RIB (synchronous; used by rebuild + tests)."""
+        if states is None:
+            states = self._snapshot_states()
+        per_area = {
+            a: self._compute_area(ls, ps) for a, (ls, ps) in states.items()
+        }
+        rdb = merge_area_ribs(per_area, self.node_name)
+        if self.rib_policy is not None:
+            self.rib_policy.apply(rdb)
+        return rdb
+
+    async def _rebuild_routes(self) -> None:
+        t0 = time.perf_counter()
+        states = self._snapshot_states()
+        try:
+            new_rib = await asyncio.to_thread(self.compute_rib, states)
+        except Exception:  # noqa: BLE001 — keep serving the old RIB
+            log.exception("%s: route rebuild failed", self.name)
+            return
+        self._last_spf_ms = (time.perf_counter() - t0) * 1e3
+        self._spf_runs += 1
+        if self.counters:
+            self.counters.increment("decision.spf_runs")
+            self.counters.set("decision.spf_ms", self._last_spf_ms)
+        first = not self.rib_computed.is_set()
+        update = diff_route_dbs(self.rib, new_rib)
+        self.rib = new_rib
+        if first:
+            update.type = RouteUpdateType.FULL_SYNC
+            self.rib_computed.set()
+            self.route_updates.push(update)
+        elif not update.empty():
+            self.route_updates.push(update)
+
+    # ------------------------------------------------------------ accessors
+
+    def get_route_db(self) -> RouteDatabase:
+        return self.rib
+
+    def get_adj_dbs(self) -> dict[str, list[AdjacencyDatabase]]:
+        return {
+            area: [db for n in ls.nodes if (db := ls.adjacency_db(n))]
+            for area, ls in self.link_states.items()
+        }
+
+    def get_received_routes(self) -> dict[str, dict]:
+        return {
+            area: {
+                str(p.prefix): sorted(per_node)
+                for p, per_node in ps.prefixes.items()
+            }
+            for area, ps in self.prefix_states.items()
+        }

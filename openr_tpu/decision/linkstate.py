@@ -143,6 +143,15 @@ class LinkState:
             return True
         return False
 
+    def snapshot(self) -> "LinkState":
+        """O(V) consistent copy for off-thread solves: the dict is copied,
+        the AdjacencyDatabase values are frozen, and the cached CSR (itself
+        immutable once built) is shared."""
+        snap = LinkState(self.area)
+        snap._adj_dbs = dict(self._adj_dbs)
+        snap._csr = self._csr
+        return snap
+
     # ---- queries ----------------------------------------------------------
 
     @property
@@ -276,6 +285,12 @@ class PrefixState:
                 per_node[node] = entry
                 changed.add(entry.prefix)
         return changed
+
+    def snapshot(self) -> "PrefixState":
+        """Consistent copy for off-thread solves (entries are frozen)."""
+        snap = PrefixState(self.area)
+        snap._entries = {p: dict(per) for p, per in self._entries.items()}
+        return snap
 
     def withdraw(self, node: str, prefix: IpPrefix) -> bool:
         per_node = self._entries.get(prefix)

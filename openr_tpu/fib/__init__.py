@@ -1,0 +1,9 @@
+"""FIB programming layer (reference: openr/fib/ †, openr/platform/ †).
+
+`Fib` consumes Decision's route-update queue, diffs against what it has
+programmed, and drives a `FibService`-shaped handler — the same swappable
+process boundary the reference has (thrift FibService): in production the
+native netlink handler (`openr_tpu.platform`), in tests `MockFibHandler`.
+"""
+
+from openr_tpu.fib.fib import Fib, FibService, MockFibHandler  # noqa: F401

@@ -1,0 +1,319 @@
+"""The Fib module: route-update consumption, diffing, kernel programming.
+
+reference: openr/fib/Fib.cpp † — consumes `DecisionRouteUpdate`s, keeps the
+`routeState_` book of programmed routes, programs deltas through the
+FibService thrift boundary (openr/platform/NetlinkFibHandler.cpp †),
+retries with exponential backoff on failure, runs a periodic full sync,
+and republishes *programmed* routes on a stream consumed by PrefixManager
+(originate-on-programmed gating) and OpenrCtrl subscribers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Iterable, Protocol
+
+from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.config import Config
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
+from openr_tpu.types.network import IpPrefix, MplsRoute, UnicastRoute
+from openr_tpu.types.routes import (
+    RibEntry,
+    RibMplsEntry,
+    RouteUpdate,
+    RouteUpdateType,
+)
+
+log = logging.getLogger(__name__)
+
+
+class FibService(Protocol):
+    """The route-programming boundary (reference: Platform.thrift †
+    FibService). Implementations: MockFibHandler (tests),
+    openr_tpu.platform.NetlinkFibHandler (native), or an RpcClient shim."""
+
+    async def add_unicast_routes(self, client_id: int, routes: list[UnicastRoute]) -> None: ...
+    async def delete_unicast_routes(self, client_id: int, prefixes: list[IpPrefix]) -> None: ...
+    async def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None: ...
+    async def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None: ...
+    async def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None: ...
+    async def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None: ...
+    async def get_route_table_by_client(self, client_id: int) -> list[UnicastRoute]: ...
+    async def get_mpls_route_table_by_client(self, client_id: int) -> list[MplsRoute]: ...
+
+
+class FibProgramError(RuntimeError):
+    pass
+
+
+class MockFibHandler:
+    """In-memory FibService with injectable failures.
+
+    reference: MockNetlinkFibHandler in openr/tests/mocks/ † — records
+    programmed routes, lets tests fail the next N operations to exercise
+    Fib's retry/backoff/sync path, and exposes wait helpers.
+    """
+
+    def __init__(self):
+        self.unicast: dict[int, dict[IpPrefix, UnicastRoute]] = {}
+        self.mpls: dict[int, dict[int, MplsRoute]] = {}
+        self.fail_next_n = 0
+        self.op_count = 0
+        self.sync_count = 0
+        self._changed = asyncio.Event()
+
+    def _fail_maybe(self):
+        self.op_count += 1
+        if self.fail_next_n > 0:
+            self.fail_next_n -= 1
+            raise FibProgramError("injected failure")
+
+    def _notify(self):
+        self._changed.set()
+        self._changed = asyncio.Event()
+
+    async def wait_for_change(self, timeout: float = 5.0) -> None:
+        await asyncio.wait_for(self._changed.wait(), timeout)
+
+    async def add_unicast_routes(self, client_id, routes):
+        self._fail_maybe()
+        tbl = self.unicast.setdefault(client_id, {})
+        for r in routes:
+            tbl[r.dest] = r
+        self._notify()
+
+    async def delete_unicast_routes(self, client_id, prefixes):
+        self._fail_maybe()
+        tbl = self.unicast.setdefault(client_id, {})
+        for p in prefixes:
+            tbl.pop(p, None)
+        self._notify()
+
+    async def add_mpls_routes(self, client_id, routes):
+        self._fail_maybe()
+        tbl = self.mpls.setdefault(client_id, {})
+        for r in routes:
+            tbl[r.top_label] = r
+        self._notify()
+
+    async def delete_mpls_routes(self, client_id, labels):
+        self._fail_maybe()
+        tbl = self.mpls.setdefault(client_id, {})
+        for l in labels:
+            tbl.pop(l, None)
+        self._notify()
+
+    async def sync_fib(self, client_id, routes):
+        self._fail_maybe()
+        self.sync_count += 1
+        self.unicast[client_id] = {r.dest: r for r in routes}
+        self._notify()
+
+    async def sync_mpls_fib(self, client_id, routes):
+        self._fail_maybe()
+        self.mpls[client_id] = {r.top_label: r for r in routes}
+        self._notify()
+
+    async def get_route_table_by_client(self, client_id):
+        return list(self.unicast.get(client_id, {}).values())
+
+    async def get_mpls_route_table_by_client(self, client_id):
+        return list(self.mpls.get(client_id, {}).values())
+
+
+# reference: openr/if/Platform.thrift † FibClient enum — OPENR's client id
+# namespaces its routes in the FibService against other routing daemons.
+CLIENT_ID_OPENR = 786
+
+
+class Fib(OpenrModule):
+    """Programs computed routes into the dataplane, reliably.
+
+    State machine mirrors the reference †: AWAITING (no RIB yet) →
+    SYNCING (first FULL_SYNC programmed via sync_fib) → SYNCED
+    (incremental deltas); any program failure re-enters SYNCING with
+    exponential backoff, re-deriving the delta from the route book so no
+    update is ever lost.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        route_updates_reader: RQueue,
+        fib_handler: FibService,
+        fib_updates_queue: ReplicateQueue | None = None,
+        counters=None,
+    ):
+        super().__init__(f"{config.node_name}.fib", counters=counters)
+        self.config = config
+        self.handler = fib_handler
+        self.reader = route_updates_reader
+        self.fib_updates = fib_updates_queue
+        self.dry_run = config.node.fib.dry_run
+        # the RIB as Decision last gave it to us (desired state)
+        self.desired_unicast: dict[IpPrefix, RibEntry] = {}
+        self.desired_mpls: dict[int, RibMplsEntry] = {}
+        # what we have successfully programmed (actual state)
+        self.programmed_unicast: dict[IpPrefix, UnicastRoute] = {}
+        self.programmed_mpls: dict[int, MplsRoute] = {}
+        self.synced = asyncio.Event()  # FIB_SYNCED init gate
+        self._need_full_sync = True
+        self._dirty = asyncio.Event()
+        self.backoff = ExponentialBackoff(
+            config.node.fib.initial_retry_ms, config.node.fib.max_retry_ms
+        )
+
+    async def main(self) -> None:
+        self.spawn(self._update_loop(), name=f"{self.name}.updates")
+        self.spawn(self._program_loop(), name=f"{self.name}.program")
+        self.run_every(
+            self.config.node.fib.sync_interval_s,
+            self._mark_full_sync,
+            name=f"{self.name}.resync",
+        )
+
+    def _mark_full_sync(self) -> None:
+        self._need_full_sync = True
+        self._dirty.set()
+
+    # ------------------------------------------------------------- consume
+
+    async def _update_loop(self) -> None:
+        while True:
+            try:
+                upd = await self.reader.get()
+            except QueueClosedError:
+                return
+            self._fold_update(upd)
+            self._dirty.set()
+
+    def _fold_update(self, upd: RouteUpdate) -> None:
+        if upd.type == RouteUpdateType.FULL_SYNC:
+            self.desired_unicast = dict(upd.unicast_to_update)
+            self.desired_mpls = dict(upd.mpls_to_update)
+            self._need_full_sync = True
+            return
+        for prefix, entry in upd.unicast_to_update.items():
+            self.desired_unicast[prefix] = entry
+        for prefix in upd.unicast_to_delete:
+            self.desired_unicast.pop(prefix, None)
+        for label, mentry in upd.mpls_to_update.items():
+            self.desired_mpls[label] = mentry
+        for label in upd.mpls_to_delete:
+            self.desired_mpls.pop(label, None)
+
+    # ------------------------------------------------------------- program
+
+    async def _program_loop(self) -> None:
+        while not self.stopped:
+            await self._dirty.wait()
+            self._dirty.clear()
+            try:
+                await self._program_once()
+                self.backoff.report_success()
+                if not self.synced.is_set():
+                    self.synced.set()
+                if self.counters:
+                    self.counters.increment("fib.program_ok")
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001
+                self._need_full_sync = True
+                self._dirty.set()
+                self.backoff.report_error()
+                delay = self.backoff.current_ms / 1e3
+                if self.counters:
+                    self.counters.increment("fib.program_fail")
+                log.warning(
+                    "%s: programming failed (%s); retry in %.3fs",
+                    self.name, exc, delay,
+                )
+                await asyncio.sleep(delay)
+
+    async def _program_once(self) -> None:
+        # snapshot the desired state NOW: _update_loop may fold new updates
+        # in while we await the handler, and those must not be reported as
+        # programmed (they re-trigger via _dirty)
+        snap_u = dict(self.desired_unicast)
+        snap_m = dict(self.desired_mpls)
+        desired_u = {p: e.to_unicast_route() for p, e in snap_u.items()}
+        desired_m = {l: e.to_mpls_route() for l, e in snap_m.items()}
+        if self.dry_run:
+            self.programmed_unicast = desired_u
+            self.programmed_mpls = desired_m
+            self._publish_programmed(snap_u, snap_m, full=True)
+            return
+        if self._need_full_sync:
+            await self.handler.sync_fib(CLIENT_ID_OPENR, list(desired_u.values()))
+            await self.handler.sync_mpls_fib(CLIENT_ID_OPENR, list(desired_m.values()))
+            self._need_full_sync = False
+            self.programmed_unicast = desired_u
+            self.programmed_mpls = desired_m
+            self._publish_programmed(snap_u, snap_m, full=True)
+            return
+        # incremental: diff desired vs programmed
+        u_add = [
+            r for p, r in desired_u.items()
+            if self.programmed_unicast.get(p) != r
+        ]
+        u_del = [p for p in self.programmed_unicast if p not in desired_u]
+        m_add = [
+            r for l, r in desired_m.items()
+            if self.programmed_mpls.get(l) != r
+        ]
+        m_del = [l for l in self.programmed_mpls if l not in desired_m]
+        if u_add:
+            await self.handler.add_unicast_routes(CLIENT_ID_OPENR, u_add)
+        if u_del:
+            await self.handler.delete_unicast_routes(CLIENT_ID_OPENR, u_del)
+        if m_add:
+            await self.handler.add_mpls_routes(CLIENT_ID_OPENR, m_add)
+        if m_del:
+            await self.handler.delete_mpls_routes(CLIENT_ID_OPENR, m_del)
+        if u_add or u_del or m_add or m_del:
+            self.programmed_unicast = desired_u
+            self.programmed_mpls = desired_m
+            self._publish_programmed(
+                snap_u, snap_m,
+                u_add=u_add, u_del=u_del, m_add=m_add, m_del=m_del,
+            )
+
+    def _publish_programmed(
+        self,
+        snap_u: dict[IpPrefix, RibEntry],
+        snap_m: dict[int, RibMplsEntry],
+        full: bool = False,
+        u_add: Iterable[UnicastRoute] = (),
+        u_del: Iterable[IpPrefix] = (),
+        m_add: Iterable[MplsRoute] = (),
+        m_del: Iterable[int] = (),
+    ) -> None:
+        """Stream programmed-route updates (reference: Fib's
+        fibRouteUpdatesQueue_ †, consumed by PrefixManager gating). Reads
+        only the snapshot actually handed to the handler."""
+        if self.fib_updates is None:
+            return
+        upd = RouteUpdate()
+        if full:
+            upd.type = RouteUpdateType.FULL_SYNC
+            upd.unicast_to_update = dict(snap_u)
+            upd.mpls_to_update = dict(snap_m)
+        else:
+            upd.type = RouteUpdateType.INCREMENTAL
+            ua = {r.dest for r in u_add}
+            upd.unicast_to_update = {p: e for p, e in snap_u.items() if p in ua}
+            upd.unicast_to_delete = list(u_del)
+            ma = {r.top_label for r in m_add}
+            upd.mpls_to_update = {l: e for l, e in snap_m.items() if l in ma}
+            upd.mpls_to_delete = list(m_del)
+        self.fib_updates.push(upd)
+
+    # ----------------------------------------------------------- accessors
+
+    def get_programmed_unicast(self) -> list[UnicastRoute]:
+        return sorted(self.programmed_unicast.values(), key=lambda r: r.dest)
+
+    def get_programmed_mpls(self) -> list[MplsRoute]:
+        return sorted(self.programmed_mpls.values(), key=lambda r: r.top_label)

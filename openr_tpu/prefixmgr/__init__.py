@@ -1,0 +1,8 @@
+"""Prefix origination authority (reference: openr/prefix-manager/ †)."""
+
+from openr_tpu.prefixmgr.prefix_manager import (  # noqa: F401
+    PrefixEvent,
+    PrefixEventType,
+    PrefixManager,
+    PrefixSource,
+)

@@ -1,0 +1,246 @@
+"""PrefixManager: the single authority for what this node advertises.
+
+reference: openr/prefix-manager/PrefixManager.cpp † — consumes origination
+requests from config (`originated_prefixes`), the API (OpenrCtrl
+advertise/withdraw), and PrefixAllocator; keeps per-(source, prefix)
+entries; advertises the best entry per prefix as per-prefix
+`prefix:<node>:<area>:[<prefix>]` keys through KvStoreClient; withdraws by
+advertising a tombstone (`delete_prefix=True`) that dies by TTL; and gates
+config-originated prefixes on supporting routes being programmed in the
+FIB (install_to_fib / minimum_supporting_routes), fed by Fib's
+programmed-route stream.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from dataclasses import dataclass, field
+
+from openr_tpu.common import constants as C
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.config import Config, OriginatedPrefix
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.routes import RouteUpdate, RouteUpdateType
+from openr_tpu.types.serde import to_wire
+from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+
+log = logging.getLogger(__name__)
+
+
+class PrefixSource(enum.IntEnum):
+    """Origin of a prefix advertisement; higher value wins at equal prefix
+    (reference: thrift PrefixType ranking in PrefixManager †)."""
+
+    RIB = 10          # cross-area redistribution
+    ALLOCATOR = 20    # PrefixAllocator elected prefix
+    CONFIG = 30       # originated_prefixes in config
+    API = 40          # operator advertise via OpenrCtrl
+
+
+class PrefixEventType(enum.IntEnum):
+    ADD_PREFIXES = 0
+    WITHDRAW_PREFIXES = 1
+    WITHDRAW_SOURCE = 2  # withdraw everything from one source
+
+
+@dataclass
+class PrefixEvent:
+    """Origination request (reference: PrefixEvent † on prefixUpdatesQueue)."""
+
+    type: PrefixEventType
+    source: PrefixSource = PrefixSource.API
+    entries: tuple[PrefixEntry, ...] = ()
+    dest_areas: tuple[str, ...] = ()  # () = all configured areas
+
+
+@dataclass
+class _Origination:
+    """Config-originated prefix with FIB gating state."""
+
+    cfg: OriginatedPrefix
+    prefix: IpPrefix = field(init=False)
+    supporting: set[IpPrefix] = field(default_factory=set)
+    advertised: bool = False
+
+    def __post_init__(self):
+        self.prefix = IpPrefix.make(self.cfg.prefix)
+
+    def ready(self) -> bool:
+        return len(self.supporting) >= self.cfg.minimum_supporting_routes
+
+
+class PrefixManager(OpenrModule):
+    def __init__(
+        self,
+        config: Config,
+        kv_client: KvStoreClient,
+        prefix_events_reader: RQueue | None = None,
+        fib_updates_reader: RQueue | None = None,
+        counters=None,
+    ):
+        super().__init__(f"{config.node_name}.prefixmgr", counters=counters)
+        self.config = config
+        self.node_name = config.node_name
+        self.kv_client = kv_client
+        self.events_reader = prefix_events_reader
+        self.fib_reader = fib_updates_reader
+        # (source, prefix) -> (entry, dest_areas)
+        self._entries: dict[
+            tuple[PrefixSource, IpPrefix], tuple[PrefixEntry, tuple[str, ...]]
+        ] = {}
+        # prefix -> set of areas currently advertised into
+        self._advertised: dict[IpPrefix, set[str]] = {}
+        self._originations: list[_Origination] = [
+            _Origination(cfg=op) for op in config.node.originated_prefixes
+        ]
+        self.ttl_ms = config.node.kvstore.key_ttl_ms
+
+    async def main(self) -> None:
+        if self.events_reader is not None:
+            self.spawn(self._event_loop(), name=f"{self.name}.events")
+        if self.fib_reader is not None:
+            self.spawn(self._fib_loop(), name=f"{self.name}.fib")
+        self._sync_originations()
+        self._sync_advertisements()
+
+    # ------------------------------------------------------------- events
+
+    async def _event_loop(self) -> None:
+        while True:
+            try:
+                ev = await self.events_reader.get()
+            except QueueClosedError:
+                return
+            self.process_event(ev)
+
+    def process_event(self, ev: PrefixEvent) -> None:
+        if ev.type == PrefixEventType.ADD_PREFIXES:
+            for e in ev.entries:
+                self._entries[(ev.source, e.prefix)] = (e, ev.dest_areas)
+        elif ev.type == PrefixEventType.WITHDRAW_PREFIXES:
+            for e in ev.entries:
+                self._entries.pop((ev.source, e.prefix), None)
+        elif ev.type == PrefixEventType.WITHDRAW_SOURCE:
+            for key in [k for k in self._entries if k[0] == ev.source]:
+                del self._entries[key]
+        self._sync_advertisements()
+        if self.counters:
+            self.counters.increment("prefixmgr.events")
+
+    # ---------------------------------------------------------- fib gating
+
+    async def _fib_loop(self) -> None:
+        while True:
+            try:
+                upd: RouteUpdate = await self.fib_reader.get()
+            except QueueClosedError:
+                return
+            self._fold_fib_update(upd)
+            self._sync_originations()
+            self._sync_advertisements()
+
+    def _fold_fib_update(self, upd: RouteUpdate) -> None:
+        for orig in self._originations:
+            net = orig.prefix.network
+            if upd.type == RouteUpdateType.FULL_SYNC:
+                orig.supporting = set()
+            for p in upd.unicast_to_update:
+                if (
+                    p != orig.prefix
+                    and p.is_v4 == orig.prefix.is_v4
+                    and p.network.subnet_of(net)
+                ):
+                    orig.supporting.add(p)
+            for p in upd.unicast_to_delete:
+                orig.supporting.discard(p)
+
+    def _sync_originations(self) -> None:
+        """Fold ready config originations into the entry book."""
+        for orig in self._originations:
+            key = (PrefixSource.CONFIG, orig.prefix)
+            if orig.ready():
+                entry = PrefixEntry(
+                    prefix=orig.prefix,
+                    metrics=_metrics_for(orig.cfg),
+                    forwarding_type=orig.cfg.forwarding_type,
+                    forwarding_algorithm=orig.cfg.forwarding_algorithm,
+                    tags=tuple(orig.cfg.tags),
+                )
+                self._entries[key] = (entry, ())
+                orig.advertised = True
+            elif orig.advertised:
+                self._entries.pop(key, None)
+                orig.advertised = False
+
+    # -------------------------------------------------------- advertisement
+
+    def _best_entries(self) -> dict[IpPrefix, tuple[PrefixEntry, tuple[str, ...]]]:
+        best: dict[IpPrefix, tuple[PrefixSource, PrefixEntry, tuple[str, ...]]] = {}
+        for (source, prefix), (entry, areas) in self._entries.items():
+            cur = best.get(prefix)
+            if cur is None or source > cur[0]:
+                best[prefix] = (source, entry, areas)
+        return {p: (e, a) for p, (_s, e, a) in best.items()}
+
+    def _sync_advertisements(self) -> None:
+        """Make the KvStore reflect the current entry book exactly."""
+        want = self._best_entries()
+        all_areas = tuple(self.config.area_ids())
+        # advertise / update
+        for prefix, (entry, dest_areas) in want.items():
+            areas = dest_areas or all_areas
+            adv = self._advertised.setdefault(prefix, set())
+            for area in areas:
+                key = C.prefix_key(self.node_name, area, str(prefix.prefix))
+                db = PrefixDatabase(
+                    this_node_name=self.node_name,
+                    prefix_entries=(entry,),
+                    area=area,
+                )
+                self.kv_client.persist_key(
+                    area, key, to_wire(db), ttl_ms=self.ttl_ms
+                )
+                adv.add(area)
+        # withdraw
+        for prefix in list(self._advertised):
+            stale_areas = self._advertised[prefix] - (
+                set(want[prefix][1] or all_areas) if prefix in want else set()
+            )
+            for area in stale_areas:
+                key = C.prefix_key(self.node_name, area, str(prefix.prefix))
+                tombstone = PrefixDatabase(
+                    this_node_name=self.node_name,
+                    prefix_entries=(PrefixEntry(prefix=prefix),),
+                    area=area,
+                    delete_prefix=True,
+                )
+                # advertise the tombstone once (version bump beats the old
+                # value everywhere), then stop refreshing: it dies by TTL
+                # (reference: PrefixManager deleted-entry advertisement †)
+                self.kv_client.persist_key(
+                    area, key, to_wire(tombstone), ttl_ms=self.ttl_ms
+                )
+                self.kv_client.unset_key(area, key)
+                self._advertised[prefix].discard(area)
+            if not self._advertised[prefix]:
+                del self._advertised[prefix]
+        if self.counters:
+            self.counters.set("prefixmgr.advertised", len(self._advertised))
+
+    # ------------------------------------------------------------ accessors
+
+    def get_advertised(self) -> dict[IpPrefix, PrefixEntry]:
+        return {p: e for p, (e, _a) in self._best_entries().items()
+                if p in self._advertised}
+
+
+def _metrics_for(cfg: OriginatedPrefix):
+    from openr_tpu.types.topology import PrefixMetrics
+
+    return PrefixMetrics(
+        path_preference=cfg.path_preference,
+        source_preference=cfg.source_preference,
+    )

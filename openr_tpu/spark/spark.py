@@ -55,7 +55,9 @@ class HelloMsg:
     if_name: str
     seq: int
     # neighbors I can hear on this interface: name -> [their_seq,
-    # my_recv_ts_us, their_sent_ts_us] (for bidirectional check + RTT)
+    # their_sent_ts_us echoed back verbatim, my_turnaround_lag_us]
+    # (bidirectional check + NTP-free RTT: the echo is on the receiver's
+    # own clock; the lag is a duration, clock-independent)
     heard: dict[str, tuple[int, int, int]] = field(default_factory=dict)
     sent_ts_us: int = 0
     restarting: bool = False
@@ -113,6 +115,11 @@ class _Neighbor:
     last_heard: float = 0.0
     last_seq: int = 0
     handshake_done: bool = False
+    # RTT measurement state: the neighbor's latest hello sent-timestamp
+    # (THEIR clock, echoed back verbatim) and when we received it (OUR
+    # monotonic clock), so our next hello can report our turnaround lag.
+    last_their_sent_us: int = 0
+    last_recv_mono_us: int = 0
 
 
 class Spark(OpenrModule):
@@ -202,10 +209,12 @@ class Spark(OpenrModule):
             if not (fast or slow_due):
                 continue
             heard = {}
+            now_us = int(now * 1e6)
             for (ifn, nname), nb in self.neighbors.items():
                 if ifn != if_name or nb.state == SparkNeighborState.IDLE:
                     continue
-                heard[nname] = (nb.last_seq, int(nb.last_heard * 1e6), nb.rtt_us)
+                lag_us = now_us - nb.last_recv_mono_us if nb.last_recv_mono_us else 0
+                heard[nname] = (nb.last_seq, nb.last_their_sent_us, lag_us)
             pkt = SparkPacket(
                 hello=HelloMsg(
                     node_name=self.node_name,
@@ -320,12 +329,22 @@ class Spark(OpenrModule):
                 self._emit(NeighborEventType.NEIGHBOR_RESTARTING, nb)
             return
 
+        now_us = int(now * 1e6)
+        nb.last_their_sent_us = hello.sent_ts_us
+        nb.last_recv_mono_us = now_us
+
         heard_us = self.node_name in hello.heard
         if nb.state == SparkNeighborState.IDLE:
             nb.state = SparkNeighborState.WARM
         if heard_us:
-            # RTT: neighbor echoed when it last heard us
-            _seq, their_recv_us, _ = hello.heard[self.node_name]
+            # RTT (reference: Spark::processHelloMsg RTT computation †):
+            # the neighbor echoed OUR sent timestamp plus its turnaround
+            # lag; both endpoints of the subtraction are our clock.
+            _seq, echoed_sent_us, their_lag_us = hello.heard[self.node_name]
+            if echoed_sent_us > 0 and their_lag_us >= 0:
+                raw_rtt = now_us - echoed_sent_us - their_lag_us
+                if raw_rtt > 0:
+                    self._update_rtt(nb, raw_rtt)
             if nb.state == SparkNeighborState.WARM:
                 nb.state = SparkNeighborState.NEGOTIATE
                 self.spawn(self._send_handshake(nb, is_ack=False))
@@ -333,6 +352,28 @@ class Spark(OpenrModule):
                 # neighbor came back from graceful restart
                 nb.state = SparkNeighborState.ESTABLISHED
                 self._emit(NeighborEventType.NEIGHBOR_RESTARTED, nb)
+
+    # reference: Spark uses a step-detector on measured RTTs †; an EWMA +
+    # 10% emit-threshold gives the same "ignore jitter, report real shifts"
+    # behavior with less machinery.
+    RTT_EWMA_ALPHA = 0.5
+    RTT_CHANGE_FRACTION = 0.1
+
+    def _update_rtt(self, nb: _Neighbor, raw_rtt_us: int) -> None:
+        old = nb.rtt_us
+        nb.rtt_us = (
+            raw_rtt_us
+            if old == 0
+            else int(
+                self.RTT_EWMA_ALPHA * raw_rtt_us
+                + (1 - self.RTT_EWMA_ALPHA) * old
+            )
+        )
+        if (
+            nb.state == SparkNeighborState.ESTABLISHED
+            and abs(nb.rtt_us - old) > self.RTT_CHANGE_FRACTION * max(old, 1)
+        ):
+            self._emit(NeighborEventType.NEIGHBOR_RTT_CHANGE, nb)
 
     async def _on_handshake(self, if_name: str, hs: HandshakeMsg) -> None:
         if hs.node_name == self.node_name:

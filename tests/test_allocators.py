@@ -1,0 +1,186 @@
+"""Allocator tests (reference analogue:
+openr/allocators/tests/RangeAllocatorTest.cpp † and
+PrefixAllocatorTest.cpp † — N allocators contending over one replicated
+store end with distinct values)."""
+
+import asyncio
+
+from openr_tpu.allocators import PrefixAllocator, RangeAllocator
+from openr_tpu.allocators.prefix_allocator import carve
+from openr_tpu.config import Config, NodeConfig, PrefixAllocationConfig
+from openr_tpu.kvstore import InProcKvTransport, KvStore
+from openr_tpu.kvstore.kvstore import PeerSpec
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.network import IpPrefix
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def settle(cond, timeout=5.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            return False
+        await asyncio.sleep(0.01)
+    return True
+
+
+class StoreNode:
+    def __init__(self, transport, name, node_cfg=None):
+        self.name = name
+        self.cfg = (
+            Config(node_cfg) if node_cfg else Config.default(name)
+        )
+        self.pubs = ReplicateQueue(name=f"{name}.pubs")
+        self.counters = Counters()
+        self.store = KvStore(self.cfg, transport, self.pubs, counters=self.counters)
+        transport.register(name, self.store)
+
+
+async def full_mesh(transport, names):
+    nodes = {n: StoreNode(transport, n) for n in names}
+    for n in nodes.values():
+        await n.store.start()
+    for a in names:
+        for b in names:
+            if a != b:
+                nodes[a].store.add_peer_sync(PeerSpec(node_name=b))
+    return nodes
+
+
+def test_carve():
+    seed = IpPrefix.make("10.0.0.0/8")
+    assert str(carve(seed, 24, 0)) == "10.0.0.0/24"
+    assert str(carve(seed, 24, 257)) == "10.1.1.0/24"
+    seed6 = IpPrefix.make("2001:db8::/32")
+    assert str(carve(seed6, 64, 1)) == "2001:db8:0:1::/64"
+
+
+def test_range_allocator_distinct_values():
+    """5 nodes electing from a range of 8 all end with distinct values."""
+
+    async def body():
+        t = InProcKvTransport()
+        names = [f"node-{i}" for i in range(5)]
+        nodes = await full_mesh(t, names)
+        allocs = {}
+        for n in names:
+            allocs[n] = RangeAllocator(
+                n,
+                nodes[n].store,
+                nodes[n].pubs.get_reader(),
+                key_prefix="alloc:",
+                start=0,
+                end=7,
+                counters=nodes[n].counters,
+            )
+            await allocs[n].start()
+
+        def distinct():
+            vals = [a.my_value for a in allocs.values()]
+            return None not in vals and len(set(vals)) == len(vals)
+
+        ok = await settle(distinct, timeout=8.0)
+        vals = {n: a.my_value for n, a in allocs.items()}
+        assert ok, f"allocation collided or stalled: {vals}"
+        for a in allocs.values():
+            await a.stop()
+        for n in nodes.values():
+            await n.store.stop()
+
+    run(body())
+
+
+def test_range_allocator_exhaustion():
+    async def body():
+        t = InProcKvTransport()
+        names = ["a", "b", "c"]
+        nodes = await full_mesh(t, names)
+        results = {}
+        allocs = {}
+        for n in names:
+            allocs[n] = RangeAllocator(
+                n,
+                nodes[n].store,
+                nodes[n].pubs.get_reader(),
+                key_prefix="tiny:",
+                start=0,
+                end=1,  # only 2 slots for 3 nodes
+            )
+            await allocs[n].start()
+        def converged():
+            won = [a.my_value for a in allocs.values() if a.my_value is not None]
+            return sorted(won) == [0, 1]
+
+        ok = await settle(converged, timeout=8.0)
+        vals = {n: a.my_value for n, a in allocs.items()}
+        assert ok, f"election did not converge: {vals}"
+        for a in allocs.values():
+            await a.stop()
+        for n in nodes.values():
+            await n.store.stop()
+
+    run(body())
+
+
+def test_prefix_allocator_originates_block():
+    async def body():
+        t = InProcKvTransport()
+        cfg = NodeConfig(
+            node_name="node-0",
+            prefix_allocation=PrefixAllocationConfig(
+                seed_prefix="10.0.0.0/8", alloc_prefix_len=24
+            ),
+        )
+        node = StoreNode(t, "node-0", node_cfg=cfg)
+        await node.store.start()
+        events = ReplicateQueue(name="prefix_events")
+        reader = events.get_reader()
+        pa = PrefixAllocator(
+            node.cfg,
+            node.store,
+            node.pubs.get_reader(),
+            events,
+            counters=Counters(),
+        )
+        await pa.start()
+        ev = await asyncio.wait_for(reader.get(), 5.0)
+        assert pa.allocated is not None
+        assert ev.entries[0].prefix == pa.allocated
+        # allocated block is inside the seed
+        assert pa.allocated.network.subnet_of(
+            IpPrefix.make("10.0.0.0/8").network
+        )
+        await pa.stop()
+        await node.store.stop()
+
+    run(body())
+
+
+def test_prefix_allocator_static_index():
+    async def body():
+        t = InProcKvTransport()
+        cfg = NodeConfig(
+            node_name="node-0",
+            prefix_allocation=PrefixAllocationConfig(
+                seed_prefix="10.0.0.0/8", alloc_prefix_len=16,
+                static_index=42,
+            ),
+        )
+        node = StoreNode(t, "node-0", node_cfg=cfg)
+        await node.store.start()
+        events = ReplicateQueue(name="prefix_events")
+        reader = events.get_reader()
+        pa = PrefixAllocator(
+            node.cfg, node.store, node.pubs.get_reader(), events
+        )
+        await pa.start()
+        ev = await asyncio.wait_for(reader.get(), 2.0)
+        assert str(ev.entries[0].prefix) == "10.42.0.0/16"
+        await pa.stop()
+        await node.store.stop()
+
+    run(body())

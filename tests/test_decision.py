@@ -1,0 +1,209 @@
+"""Decision module tests: publication processing, debounce, route deltas.
+
+reference analogue: openr/decision/tests/DecisionTest.cpp † — synthetic
+AdjacencyDatabase/PrefixDatabase fed through the publication queue,
+asserting exact RIB content and incremental deltas.
+"""
+
+import asyncio
+
+from openr_tpu.common.constants import DEFAULT_AREA, adj_key, prefix_key
+from openr_tpu.config import Config, NodeConfig
+from openr_tpu.decision import Decision
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.kvstore import Publication, Value
+from openr_tpu.types.routes import RouteUpdateType
+from openr_tpu.types.serde import to_wire
+from openr_tpu.types.topology import PrefixDatabase
+from openr_tpu.utils import topogen
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def mk_decision(name="node-0", backend="cpu"):
+    cfg = Config(NodeConfig(node_name=name))
+    cfg.node.decision.debounce_min_ms = 5
+    cfg.node.decision.debounce_max_ms = 20
+    pubs = ReplicateQueue(name="pubs")
+    routes = ReplicateQueue(name="routes")
+    d = Decision(
+        cfg, pubs.get_reader(), routes, solver=backend, counters=Counters()
+    )
+    return d, pubs, routes.get_reader()
+
+
+def adj_pub(adj_dbs, area=DEFAULT_AREA, version=1):
+    return Publication(
+        area=area,
+        key_vals={
+            adj_key(db.this_node_name): Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(db),
+            ).with_hash()
+            for db in adj_dbs
+        },
+    )
+
+
+def prefix_pub(prefix_dbs, area=DEFAULT_AREA, version=1):
+    kv = {}
+    for db in prefix_dbs:
+        for e in db.prefix_entries:
+            key = prefix_key(db.this_node_name, area, str(e.prefix.prefix))
+            kv[key] = Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(
+                    PrefixDatabase(
+                        this_node_name=db.this_node_name,
+                        prefix_entries=(e,),
+                        area=area,
+                    )
+                ),
+            ).with_hash()
+    return Publication(area=area, key_vals=kv)
+
+
+async def next_update(reader, timeout=5.0):
+    return await asyncio.wait_for(reader.get(), timeout)
+
+
+def test_full_pipeline_ring():
+    """Feed a ring-4 topology; first rebuild is a FULL_SYNC with loopback
+    routes for every remote node."""
+
+    async def body():
+        d, pubs, routes = mk_decision()
+        await d.start()
+        adj_dbs, prefix_dbs = topogen.ring(4)
+        pubs.push(adj_pub(adj_dbs))
+        pubs.push(prefix_pub(prefix_dbs))
+        upd = await next_update(routes)
+        assert upd.type == RouteUpdateType.FULL_SYNC
+        prefixes = {str(p.prefix) for p in upd.unicast_to_update}
+        assert prefixes == {
+            str(topogen.loopback(i).prefix) for i in (1, 2, 3)
+        }
+        # node-2 is the ECMP corner: two nexthops
+        lb2 = topogen.loopback(2)
+        e = upd.unicast_to_update[lb2]
+        assert {nh.neighbor_node for nh in e.nexthops} == {"node-1", "node-3"}
+        assert d.rib_computed.is_set()
+        await d.stop()
+
+    run(body())
+
+
+def test_incremental_delta_on_metric_change():
+    """Bumping one link metric produces an INCREMENTAL update touching only
+    affected routes."""
+
+    async def body():
+        d, pubs, routes = mk_decision()
+        await d.start()
+        adj_dbs, prefix_dbs = topogen.ring(4)
+        pubs.push(adj_pub(adj_dbs))
+        pubs.push(prefix_pub(prefix_dbs))
+        first = await next_update(routes)
+        assert first.type == RouteUpdateType.FULL_SYNC
+
+        # break the tie toward node-2: raise node-0 → node-1 link metric
+        from dataclasses import replace
+
+        db0 = adj_dbs[0]
+        new_adjs = tuple(
+            replace(a, metric=10) if a.other_node_name == "node-1" else a
+            for a in db0.adjacencies
+        )
+        pubs.push(adj_pub([replace(db0, adjacencies=new_adjs)], version=2))
+        upd = await next_update(routes)
+        assert upd.type == RouteUpdateType.INCREMENTAL
+        touched = {str(p.prefix) for p in upd.unicast_to_update}
+        # routes to node-1 and node-2 change (now both via node-3)
+        assert str(topogen.loopback(1).prefix) in touched
+        assert str(topogen.loopback(2).prefix) in touched
+        lb2 = topogen.loopback(2)
+        assert {
+            nh.neighbor_node for nh in upd.unicast_to_update[lb2].nexthops
+        } == {"node-3"}
+        await d.stop()
+
+    run(body())
+
+
+def test_expired_adj_key_withdraws_node():
+    async def body():
+        d, pubs, routes = mk_decision()
+        await d.start()
+        adj_dbs, prefix_dbs = topogen.ring(4)
+        pubs.push(adj_pub(adj_dbs))
+        pubs.push(prefix_pub(prefix_dbs))
+        await next_update(routes)
+
+        # node-2's adjacency db expires → its loopback unreachable
+        pubs.push(Publication(expired_keys=[adj_key("node-2")]))
+        upd = await next_update(routes)
+        deleted = {str(p.prefix) for p in upd.unicast_to_delete}
+        assert str(topogen.loopback(2).prefix) in deleted
+        await d.stop()
+
+    run(body())
+
+
+def test_debounce_coalesces_burst():
+    """A burst of publications produces ONE rebuild, not one per pub."""
+
+    async def body():
+        d, pubs, routes = mk_decision()
+        await d.start()
+        adj_dbs, prefix_dbs = topogen.grid(3, 3)
+        for db in adj_dbs:
+            pubs.push(adj_pub([db]))
+        pubs.push(prefix_pub(prefix_dbs))
+        upd = await next_update(routes)
+        assert upd.type == RouteUpdateType.FULL_SYNC
+        assert len(upd.unicast_to_update) == 8
+        # all 9 adj pubs + 1 prefix pub coalesced into few rebuilds
+        assert d._spf_runs <= 3
+        await d.stop()
+
+    run(body())
+
+
+def test_tpu_backend_matches_oracle():
+    """Same publication stream through both backends → identical RIBs."""
+
+    async def body():
+        results = {}
+        for backend in ("cpu", "tpu"):
+            d, pubs, routes = mk_decision(backend=backend)
+            await d.start()
+            adj_dbs, prefix_dbs = topogen.fat_tree(4)
+            pubs.push(adj_pub(adj_dbs))
+            pubs.push(prefix_pub(prefix_dbs))
+            await next_update(routes, timeout=60.0)
+            results[backend] = d.get_route_db()
+            await d.stop()
+        cpu, tpu = results["cpu"], results["tpu"]
+        assert cpu.unicast_routes == tpu.unicast_routes
+        assert cpu.mpls_routes == tpu.mpls_routes
+
+    run(body())
+
+
+def test_local_prefix_not_programmed():
+    async def body():
+        d, pubs, routes = mk_decision()
+        await d.start()
+        adj_dbs, prefix_dbs = topogen.ring(3)
+        pubs.push(adj_pub(adj_dbs))
+        pubs.push(prefix_pub(prefix_dbs))
+        upd = await next_update(routes)
+        assert topogen.loopback(0) not in upd.unicast_to_update
+        await d.stop()
+
+    run(body())

@@ -1,0 +1,188 @@
+"""Fib module tests (reference analogue: openr/fib/tests/FibTest.cpp † —
+MockNetlinkFibHandler recording programmed routes, injected failures
+exercising retry/backoff/sync)."""
+
+import asyncio
+
+from openr_tpu.config import Config, NodeConfig
+from openr_tpu.fib import Fib, MockFibHandler
+from openr_tpu.fib.fib import CLIENT_ID_OPENR
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.network import IpPrefix, NextHop
+from openr_tpu.types.routes import (
+    RibEntry,
+    RibMplsEntry,
+    RouteUpdate,
+    RouteUpdateType,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def mk_fib(dry_run=False, initial_retry_ms=4):
+    cfg = Config(NodeConfig(node_name="node-0"))
+    cfg.node.fib.dry_run = dry_run
+    cfg.node.fib.initial_retry_ms = initial_retry_ms
+    cfg.node.fib.max_retry_ms = 64
+    routes = ReplicateQueue(name="routes")
+    fib_updates = ReplicateQueue(name="fib_updates")
+    handler = MockFibHandler()
+    fib = Fib(
+        cfg, routes.get_reader(), handler,
+        fib_updates_queue=fib_updates, counters=Counters(),
+    )
+    return fib, routes, handler, fib_updates.get_reader()
+
+
+def rib_entry(pfx: str, *nbrs: str) -> RibEntry:
+    p = IpPrefix.make(pfx)
+    return RibEntry(
+        prefix=p,
+        nexthops=tuple(
+            NextHop(address=n, if_name=f"if-{n}", metric=1, neighbor_node=n)
+            for n in nbrs
+        ),
+    )
+
+
+def full_sync(*entries: RibEntry, mpls=()) -> RouteUpdate:
+    return RouteUpdate(
+        type=RouteUpdateType.FULL_SYNC,
+        unicast_to_update={e.prefix: e for e in entries},
+        mpls_to_update={m.label: m for m in mpls},
+    )
+
+
+async def settle(cond, timeout=3.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            return False
+        await asyncio.sleep(0.005)
+    return True
+
+
+def test_full_sync_then_incremental():
+    async def body():
+        fib, routes, handler, _ = mk_fib()
+        await fib.start()
+        e1 = rib_entry("10.0.1.0/24", "node-1")
+        e2 = rib_entry("10.0.2.0/24", "node-2")
+        routes.push(full_sync(e1, e2))
+        assert await settle(
+            lambda: len(handler.unicast.get(CLIENT_ID_OPENR, {})) == 2
+        )
+        assert handler.sync_count == 1
+        assert fib.synced.is_set()
+
+        # incremental: delete one, add one
+        e3 = rib_entry("10.0.3.0/24", "node-1", "node-2")
+        routes.push(RouteUpdate(
+            unicast_to_update={e3.prefix: e3},
+            unicast_to_delete=[e1.prefix],
+        ))
+        assert await settle(
+            lambda: set(map(str, handler.unicast[CLIENT_ID_OPENR]))
+            == {"10.0.2.0/24", "10.0.3.0/24"}
+        )
+        assert handler.sync_count == 1  # no re-sync for the delta
+        await fib.stop()
+
+    run(body())
+
+
+def test_retry_backoff_on_failure():
+    async def body():
+        fib, routes, handler, _ = mk_fib()
+        await fib.start()
+        handler.fail_next_n = 3
+        e1 = rib_entry("10.0.1.0/24", "node-1")
+        routes.push(full_sync(e1))
+        assert await settle(
+            lambda: len(handler.unicast.get(CLIENT_ID_OPENR, {})) == 1
+        )
+        assert fib.counters.get("fib.program_fail") == 3
+        assert fib.synced.is_set()
+        await fib.stop()
+
+    run(body())
+
+
+def test_failure_mid_incremental_triggers_full_resync():
+    async def body():
+        fib, routes, handler, _ = mk_fib()
+        await fib.start()
+        e1 = rib_entry("10.0.1.0/24", "node-1")
+        routes.push(full_sync(e1))
+        assert await settle(lambda: fib.synced.is_set())
+        syncs_before = handler.sync_count
+
+        handler.fail_next_n = 1
+        e2 = rib_entry("10.0.2.0/24", "node-2")
+        routes.push(RouteUpdate(unicast_to_update={e2.prefix: e2}))
+        assert await settle(
+            lambda: len(handler.unicast[CLIENT_ID_OPENR]) == 2
+        )
+        # recovery went through sync_fib, not a blind replay
+        assert handler.sync_count > syncs_before
+        await fib.stop()
+
+    run(body())
+
+
+def test_mpls_routes_programmed():
+    async def body():
+        fib, routes, handler, _ = mk_fib()
+        await fib.start()
+        m = RibMplsEntry(
+            label=100101,
+            nexthops=(NextHop(address="node-1", if_name="if-1", neighbor_node="node-1"),),
+        )
+        routes.push(full_sync(rib_entry("10.0.1.0/24", "node-1"), mpls=[m]))
+        assert await settle(
+            lambda: 100101 in handler.mpls.get(CLIENT_ID_OPENR, {})
+        )
+        routes.push(RouteUpdate(mpls_to_delete=[100101]))
+        assert await settle(
+            lambda: 100101 not in handler.mpls[CLIENT_ID_OPENR]
+        )
+        await fib.stop()
+
+    run(body())
+
+
+def test_dry_run_programs_nothing():
+    async def body():
+        fib, routes, handler, fib_updates = mk_fib(dry_run=True)
+        await fib.start()
+        routes.push(full_sync(rib_entry("10.0.1.0/24", "node-1")))
+        upd = await asyncio.wait_for(fib_updates.get(), 3.0)
+        assert upd.type == RouteUpdateType.FULL_SYNC
+        assert handler.op_count == 0
+        assert fib.get_programmed_unicast()
+        await fib.stop()
+
+    run(body())
+
+
+def test_programmed_stream_published():
+    async def body():
+        fib, routes, handler, fib_updates = mk_fib()
+        await fib.start()
+        e1 = rib_entry("10.0.1.0/24", "node-1")
+        routes.push(full_sync(e1))
+        upd = await asyncio.wait_for(fib_updates.get(), 3.0)
+        assert upd.type == RouteUpdateType.FULL_SYNC
+        assert e1.prefix in upd.unicast_to_update
+
+        e2 = rib_entry("10.0.2.0/24", "node-2")
+        routes.push(RouteUpdate(unicast_to_update={e2.prefix: e2}))
+        upd2 = await asyncio.wait_for(fib_updates.get(), 3.0)
+        assert upd2.type == RouteUpdateType.INCREMENTAL
+        assert e2.prefix in upd2.unicast_to_update
+        await fib.stop()
+
+    run(body())

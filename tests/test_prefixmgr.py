@@ -1,0 +1,227 @@
+"""PrefixManager tests (reference analogue:
+openr/prefix-manager/tests/PrefixManagerTest.cpp † — origination sources,
+best-per-prefix selection, withdrawal tombstones, FIB gating)."""
+
+import asyncio
+
+from openr_tpu.common.constants import DEFAULT_AREA, parse_prefix_key, prefix_key
+from openr_tpu.config import Config, NodeConfig, OriginatedPrefix
+from openr_tpu.kvstore import InProcKvTransport, KvStore, KvStoreClient
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.prefixmgr import (
+    PrefixEvent,
+    PrefixEventType,
+    PrefixManager,
+    PrefixSource,
+)
+from openr_tpu.types.network import IpPrefix, NextHop
+from openr_tpu.types.routes import (
+    RibEntry,
+    RouteUpdate,
+    RouteUpdateType,
+)
+from openr_tpu.types.serde import from_wire
+from openr_tpu.types.topology import PrefixDatabase, PrefixEntry, PrefixMetrics
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def settle(cond, timeout=3.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            return False
+        await asyncio.sleep(0.01)
+    return True
+
+
+class Node:
+    def __init__(self, name="node-0", node_cfg=None):
+        self.cfg = Config(node_cfg or NodeConfig(node_name=name))
+        self.pubs = ReplicateQueue(name=f"{name}.pubs")
+        self.counters = Counters()
+        t = InProcKvTransport()
+        self.store = KvStore(self.cfg, t, self.pubs, counters=self.counters)
+        t.register(name, self.store)
+        self.client = KvStoreClient(
+            self.store, name, self.pubs.get_reader(), counters=self.counters
+        )
+        self.prefix_events = ReplicateQueue(name=f"{name}.prefix_events")
+        self.fib_updates = ReplicateQueue(name=f"{name}.fib_updates")
+        self.pm = PrefixManager(
+            self.cfg,
+            self.client,
+            prefix_events_reader=self.prefix_events.get_reader(),
+            fib_updates_reader=self.fib_updates.get_reader(),
+            counters=self.counters,
+        )
+
+    async def start(self):
+        await self.store.start()
+        await self.client.start()
+        await self.pm.start()
+
+    async def stop(self):
+        await self.pm.stop()
+        await self.client.stop()
+        await self.store.stop()
+
+    def kv_prefix_keys(self):
+        return {
+            k: v
+            for k, v in self.store.dump(DEFAULT_AREA).items()
+            if parse_prefix_key(k)
+        }
+
+
+def entry(pfx, **kw):
+    return PrefixEntry(prefix=IpPrefix.make(pfx), **kw)
+
+
+def test_advertise_and_withdraw():
+    async def body():
+        n = Node()
+        await n.start()
+        n.prefix_events.push(
+            PrefixEvent(
+                type=PrefixEventType.ADD_PREFIXES,
+                source=PrefixSource.API,
+                entries=(entry("10.1.0.0/16"),),
+            )
+        )
+        assert await settle(lambda: len(n.kv_prefix_keys()) == 1)
+        key = prefix_key("node-0", DEFAULT_AREA, "10.1.0.0/16")
+        db = from_wire(n.store.get_key(DEFAULT_AREA, key).value, PrefixDatabase)
+        assert not db.delete_prefix
+        assert db.prefix_entries[0].prefix == IpPrefix.make("10.1.0.0/16")
+
+        n.prefix_events.push(
+            PrefixEvent(
+                type=PrefixEventType.WITHDRAW_PREFIXES,
+                source=PrefixSource.API,
+                entries=(entry("10.1.0.0/16"),),
+            )
+        )
+        # tombstone advertised
+        assert await settle(
+            lambda: from_wire(
+                n.store.get_key(DEFAULT_AREA, key).value, PrefixDatabase
+            ).delete_prefix
+        )
+        assert n.pm.get_advertised() == {}
+        await n.stop()
+
+    run(body())
+
+
+def test_source_priority():
+    """API beats CONFIG beats ALLOCATOR for the same prefix."""
+
+    async def body():
+        n = Node()
+        await n.start()
+        p = "10.2.0.0/16"
+        for source, sp in [
+            (PrefixSource.ALLOCATOR, 10),
+            (PrefixSource.API, 40),
+            (PrefixSource.CONFIG, 30),
+        ]:
+            n.prefix_events.push(
+                PrefixEvent(
+                    type=PrefixEventType.ADD_PREFIXES,
+                    source=source,
+                    entries=(
+                        entry(p, metrics=PrefixMetrics(source_preference=sp)),
+                    ),
+                )
+            )
+        key = prefix_key("node-0", DEFAULT_AREA, p)
+        assert await settle(
+            lambda: (v := n.store.get_key(DEFAULT_AREA, key)) is not None
+            and from_wire(v.value, PrefixDatabase)
+            .prefix_entries[0].metrics.source_preference == 40
+        )
+        # withdrawing the API entry falls back to CONFIG
+        n.prefix_events.push(
+            PrefixEvent(
+                type=PrefixEventType.WITHDRAW_PREFIXES,
+                source=PrefixSource.API,
+                entries=(entry(p),),
+            )
+        )
+        assert await settle(
+            lambda: from_wire(
+                n.store.get_key(DEFAULT_AREA, key).value, PrefixDatabase
+            ).prefix_entries[0].metrics.source_preference == 30
+        )
+        await n.stop()
+
+    run(body())
+
+
+def test_fib_gated_origination():
+    """minimum_supporting_routes gates config origination on programmed
+    subnets (reference: originate-on-FIB-programmed †)."""
+
+    async def body():
+        ncfg = NodeConfig(
+            node_name="node-0",
+            originated_prefixes=(
+                OriginatedPrefix(
+                    prefix="10.0.0.0/8", minimum_supporting_routes=1
+                ),
+            ),
+        )
+        n = Node(node_cfg=ncfg)
+        await n.start()
+        key = prefix_key("node-0", DEFAULT_AREA, "10.0.0.0/8")
+        await asyncio.sleep(0.05)
+        assert n.store.get_key(DEFAULT_AREA, key) is None  # gated
+
+        # a supporting subnet gets programmed
+        sub = IpPrefix.make("10.3.0.0/24")
+        n.fib_updates.push(
+            RouteUpdate(
+                type=RouteUpdateType.FULL_SYNC,
+                unicast_to_update={
+                    sub: RibEntry(
+                        prefix=sub,
+                        nexthops=(NextHop(address="n1", if_name="i1"),),
+                    )
+                },
+            )
+        )
+        assert await settle(
+            lambda: (v := n.store.get_key(DEFAULT_AREA, key)) is not None
+            and not from_wire(v.value, PrefixDatabase).delete_prefix
+        )
+
+        # supporting route goes away → withdrawal tombstone
+        n.fib_updates.push(RouteUpdate(unicast_to_delete=[sub]))
+        assert await settle(
+            lambda: from_wire(
+                n.store.get_key(DEFAULT_AREA, key).value, PrefixDatabase
+            ).delete_prefix
+        )
+        await n.stop()
+
+    run(body())
+
+
+def test_ungated_config_origination_advertised_at_start():
+    async def body():
+        ncfg = NodeConfig(
+            node_name="node-0",
+            originated_prefixes=(OriginatedPrefix(prefix="10.9.0.0/16"),),
+        )
+        n = Node(node_cfg=ncfg)
+        await n.start()
+        key = prefix_key("node-0", DEFAULT_AREA, "10.9.0.0/16")
+        assert await settle(lambda: n.store.get_key(DEFAULT_AREA, key) is not None)
+        assert n.pm.get_advertised()
+        await n.stop()
+
+    run(body())

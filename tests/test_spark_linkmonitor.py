@@ -330,3 +330,32 @@ def test_node_overload_advertised():
             await n[mod].stop()
 
     run(main())
+
+
+def test_rtt_measured_from_reflected_timestamps():
+    """A 20ms one-way mock link → measured RTT ≈ 40ms (reference: Spark
+    RTT from reflected hello timestamps minus neighbor turnaround lag †)."""
+
+    async def main():
+        hub = MockIoHub()
+        sa, qa = mk_spark(hub, "a")
+        sb, _qb = mk_spark(hub, "b")
+        hub.link("a", "if-ab", "b", "if-ba", latency_ms=20)
+        await sa.start()
+        await sb.start()
+        sa.add_interface("if-ab")
+        sb.add_interface("if-ba")
+        ok = await settle(
+            lambda: (nb := sa.neighbors.get(("if-ab", "b"))) is not None
+            and nb.rtt_us > 0,
+            timeout=5.0,
+        )
+        assert ok, "rtt never measured"
+        # let the EWMA settle over a few more hello exchanges
+        await asyncio.sleep(0.5)
+        rtt_ms = sa.neighbors[("if-ab", "b")].rtt_us / 1e3
+        assert 25 < rtt_ms < 120, f"rtt {rtt_ms}ms implausible for 2x20ms link"
+        await sa.stop()
+        await sb.stop()
+
+    run(main())
