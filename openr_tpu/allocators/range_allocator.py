@@ -151,13 +151,14 @@ class RangeAllocator(OpenrModule):
             if pub.area != self.area:
                 continue
             if self.my_value is None:
-                # exhausted earlier: any movement on allocation keys (an
-                # expiry or ownership change) may have freed a value
-                touched = [
-                    k
-                    for k in (*pub.key_vals, *pub.expired_keys)
-                    if k.startswith(self.key_prefix)
-                ]
+                # exhausted earlier: an expiry or ownership change (payload
+                # update, not a ttl-only refresh) may have freed a value
+                touched = any(
+                    k.startswith(self.key_prefix) for k in pub.expired_keys
+                ) or any(
+                    k.startswith(self.key_prefix) and v.value is not None
+                    for k, v in pub.key_vals.items()
+                )
                 if touched:
                     self._probe_next()
                 continue
@@ -177,6 +178,10 @@ class RangeAllocator(OpenrModule):
                 self.my_value = None
                 self._probe_next()
 
+    # refresh cadence mirrors KvStoreClient._refresh_ttls: bump only when a
+    # fraction of the lifetime remains, never on every scan tick
+    SCAN_PERIOD_S = 1.0
+
     def _refresh_ttl(self) -> None:
         if self.my_value is None:
             return
@@ -184,6 +189,18 @@ class RangeAllocator(OpenrModule):
         cur = self.kvstore.get_key(self.area, key)
         if cur is None or cur.value is None:
             return
+        db = self.kvstore.dbs.get(self.area)
+        if db is not None:
+            from openr_tpu.common.constants import TTL_REFRESH_FRACTION
+            from openr_tpu.types.kvstore import TTL_INFINITY
+
+            remaining = db.remaining_ttl_ms(key)
+            threshold = max(
+                self.ttl_ms * TTL_REFRESH_FRACTION,
+                2.5 * self.SCAN_PERIOD_S * 1e3,
+            )
+            if remaining == TTL_INFINITY or remaining >= threshold:
+                return
         if cur.originator_id == self.node_name:
             self.kvstore.set_key(
                 self.area,

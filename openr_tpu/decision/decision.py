@@ -74,8 +74,15 @@ def merge_area_ribs(
                 )
                 out.unicast_routes[prefix] = replace(cur, nexthops=merged)
         for label, mentry in rdb.mpls_routes.items():
-            out.mpls_routes.setdefault(label, mentry)
+            cur = out.mpls_routes.get(label)
+            if cur is None or _mpls_igp(mentry) < _mpls_igp(cur):
+                out.mpls_routes[label] = mentry
     return out
+
+
+def _mpls_igp(entry) -> int:
+    """IGP cost of an MPLS route = its nexthops' metric (all equal-cost)."""
+    return min((nh.metric for nh in entry.nexthops), default=1 << 30)
 
 
 class Decision(OpenrModule):
@@ -180,16 +187,13 @@ class Decision(OpenrModule):
             return ls.update_adjacency_db(db)
         parsed = C.parse_prefix_key(key)
         if parsed is not None:
-            pnode, _parea, _pfx = parsed
             try:
                 db = from_wire(val.value, PrefixDatabase)
             except Exception:  # noqa: BLE001
                 log.warning("%s: bad prefix db in key %s", self.name, key)
                 return False
-            if db.delete_prefix:
-                return any(
-                    ps.withdraw(pnode, e.prefix) for e in db.prefix_entries
-                )
+            # update_prefix_db handles delete_prefix tombstones too, keyed
+            # consistently by db.this_node_name
             return bool(ps.update_prefix_db(db))
         return False
 

@@ -119,7 +119,12 @@ class LinkState:
     def __init__(self, area: str = DEFAULT_AREA):
         self.area = area
         self._adj_dbs: dict[str, AdjacencyDatabase] = {}
-        self._csr: CsrGraph | None = None
+        # one-cell CSR cache, SHARED with snapshots: a snapshot that builds
+        # the CSR off-thread publishes it back through the cell, so the live
+        # object (and later snapshots of the same topology) reuse it.
+        # Mutation replaces the cell instead of clearing it, so snapshots
+        # taken before the change keep their own still-valid cache.
+        self._csr_cell: list[CsrGraph | None] = [None]
 
     # ---- mutation ---------------------------------------------------------
 
@@ -133,23 +138,24 @@ class LinkState:
         if old == db:
             return False
         self._adj_dbs[db.this_node_name] = db
-        self._csr = None
+        self._csr_cell = [None]
         return True
 
     def delete_adjacency_db(self, node: str) -> bool:
         if node in self._adj_dbs:
             del self._adj_dbs[node]
-            self._csr = None
+            self._csr_cell = [None]
             return True
         return False
 
     def snapshot(self) -> "LinkState":
         """O(V) consistent copy for off-thread solves: the dict is copied,
-        the AdjacencyDatabase values are frozen, and the cached CSR (itself
-        immutable once built) is shared."""
+        the AdjacencyDatabase values are frozen, and the CSR cache cell is
+        shared — a CSR built on the snapshot (off-thread) becomes visible
+        to the live object until the next topology change."""
         snap = LinkState(self.area)
         snap._adj_dbs = dict(self._adj_dbs)
-        snap._csr = self._csr
+        snap._csr_cell = self._csr_cell
         return snap
 
     # ---- queries ----------------------------------------------------------
@@ -173,9 +179,9 @@ class LinkState:
 
     def to_csr(self) -> CsrGraph:
         """Build (or return cached) padded CSR arrays for the solver."""
-        if self._csr is None:
-            self._csr = self._build_csr()
-        return self._csr
+        if self._csr_cell[0] is None:
+            self._csr_cell[0] = self._build_csr()
+        return self._csr_cell[0]
 
     def _build_csr(self) -> CsrGraph:
         names = sorted(self._adj_dbs)  # deterministic interning

@@ -1,0 +1,10 @@
+"""Multi-node in-process emulator (reference: openr/tests/OpenrWrapper †).
+
+`Cluster` spins N complete OpenrNodes in one process: Spark packets run
+over `MockIoHub` links, KvStore peering over `InProcKvTransport`, and
+route programming into per-node `MockFibHandler`s — the reference's
+multi-node-without-a-cluster testing pattern, also used by the
+`python -m openr_tpu.emulator` CLI for interactive convergence demos.
+"""
+
+from openr_tpu.emulator.cluster import Cluster, ClusterNodeSpec, LinkSpec  # noqa: F401

@@ -160,6 +160,7 @@ class Fib(OpenrModule):
         self.programmed_mpls: dict[int, MplsRoute] = {}
         self.synced = asyncio.Event()  # FIB_SYNCED init gate
         self._need_full_sync = True
+        self._have_rib = False  # AWAITING state: no RIB from Decision yet
         self._dirty = asyncio.Event()
         self.backoff = ExponentialBackoff(
             config.node.fib.initial_retry_ms, config.node.fib.max_retry_ms
@@ -187,6 +188,7 @@ class Fib(OpenrModule):
             except QueueClosedError:
                 return
             self._fold_update(upd)
+            self._have_rib = True
             self._dirty.set()
 
     def _fold_update(self, upd: RouteUpdate) -> None:
@@ -213,7 +215,7 @@ class Fib(OpenrModule):
             try:
                 await self._program_once()
                 self.backoff.report_success()
-                if not self.synced.is_set():
+                if self._have_rib and not self.synced.is_set():
                     self.synced.set()
                 if self.counters:
                     self.counters.increment("fib.program_ok")
@@ -233,6 +235,12 @@ class Fib(OpenrModule):
                 await asyncio.sleep(delay)
 
     async def _program_once(self) -> None:
+        # AWAITING (reference: Fib waits for the first RIB snapshot before
+        # touching the dataplane †): programming an empty FIB before
+        # Decision speaks would wipe still-valid warm-boot routes and
+        # spuriously pass the FIB_SYNCED gate
+        if not self._have_rib:
+            return
         # snapshot the desired state NOW: _update_loop may fold new updates
         # in while we await the handler, and those must not be reported as
         # programmed (they re-trigger via _dirty)

@@ -1,0 +1,193 @@
+"""OpenrNode: the full module graph of one router, wired as in Main.cpp.
+
+reference: openr/Main.cpp † — constructs every typed queue, then every
+module in dependency order, starts each (asyncio tasks here ≙ one
+eventbase thread each there), and exposes the initialization gates
+(KVSTORE_SYNCED → RIB_COMPUTED → FIB_SYNCED — reference: the "OpenR
+Initialization Process" †).
+
+The three swappable boundaries (the reference's seams, preserved for
+testability): packet I/O (`io_provider` ≙ Spark IoProvider), KvStore peer
+transport (`kv_transport` ≙ thrift peer sessions), and route programming
+(`fib_handler` ≙ FibService).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from openr_tpu.allocators import PrefixAllocator
+from openr_tpu.config import Config
+from openr_tpu.decision import Decision
+from openr_tpu.fib import Fib, MockFibHandler
+from openr_tpu.kvstore import KvStore, KvStoreClient
+from openr_tpu.linkmonitor import LinkMonitor
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.prefixmgr import PrefixManager
+from openr_tpu.spark import Spark
+from openr_tpu.types.events import InterfaceEvent, InterfaceInfo
+
+log = logging.getLogger(__name__)
+
+
+class OpenrNode:
+    """One complete Open/R instance (all modules, all queues)."""
+
+    def __init__(
+        self,
+        config: Config,
+        io_provider,
+        kv_transport,
+        fib_handler=None,
+        solver: str | None = None,
+        kvstore_port: int = 0,
+        endpoint_host: str = "127.0.0.1",
+    ):
+        self.config = config
+        self.name = config.node_name
+        self.counters = Counters()
+
+        # ---- queues (reference: Main.cpp queue construction †) ----------
+        self.neighbor_events = ReplicateQueue(name=f"{self.name}.nbr")
+        self.interface_events = ReplicateQueue(name=f"{self.name}.if")
+        self.peer_events = ReplicateQueue(name=f"{self.name}.peers")
+        self.kvstore_pubs = ReplicateQueue(name=f"{self.name}.pubs")
+        self.prefix_events = ReplicateQueue(name=f"{self.name}.prefix")
+        self.route_updates = ReplicateQueue(name=f"{self.name}.routes")
+        self.fib_updates = ReplicateQueue(name=f"{self.name}.fib")
+
+        # ---- modules, dependency order ----------------------------------
+        self.kvstore = KvStore(
+            config,
+            kv_transport,
+            self.kvstore_pubs,
+            peer_events_reader=self.peer_events.get_reader(),
+            counters=self.counters,
+        )
+        self.kv_client = KvStoreClient(
+            self.kvstore,
+            self.name,
+            self.kvstore_pubs.get_reader(),
+            counters=self.counters,
+        )
+        self.decision = Decision(
+            config,
+            self.kvstore_pubs.get_reader(),
+            self.route_updates,
+            solver=solver,
+            counters=self.counters,
+        )
+        self.fib_handler = fib_handler if fib_handler is not None else MockFibHandler()
+        self.fib = Fib(
+            config,
+            self.route_updates.get_reader(),
+            self.fib_handler,
+            fib_updates_queue=self.fib_updates,
+            counters=self.counters,
+        )
+        self.spark = Spark(
+            config,
+            io_provider,
+            self.neighbor_events,
+            kvstore_port=kvstore_port,
+            endpoint_host=endpoint_host,
+            counters=self.counters,
+        )
+        self.linkmonitor = LinkMonitor(
+            config,
+            self.spark,
+            self.kv_client,
+            self.neighbor_events.get_reader(),
+            self.peer_events,
+            interface_events_reader=self.interface_events.get_reader(),
+            counters=self.counters,
+        )
+        self.prefixmgr = PrefixManager(
+            config,
+            self.kv_client,
+            prefix_events_reader=self.prefix_events.get_reader(),
+            fib_updates_reader=self.fib_updates.get_reader(),
+            counters=self.counters,
+        )
+        self.prefix_allocator = None
+        if config.node.prefix_allocation is not None:
+            self.prefix_allocator = PrefixAllocator(
+                config,
+                self.kvstore,
+                self.kvstore_pubs.get_reader(),
+                self.prefix_events,
+                counters=self.counters,
+            )
+
+        # startup order mirrors Main.cpp † (store first, discovery last);
+        # shutdown is the reverse
+        self._modules = [
+            self.kvstore,
+            self.kv_client,
+            self.decision,
+            self.fib,
+            self.prefixmgr,
+            self.spark,
+            self.linkmonitor,
+        ]
+        if self.prefix_allocator is not None:
+            self._modules.append(self.prefix_allocator)
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        assert not self._started
+        self._started = True
+        for m in self._modules:
+            await m.start()
+        log.info("node %s started (%d modules)", self.name, len(self._modules))
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        for m in reversed(self._modules):
+            await m.stop()
+        for q in (
+            self.neighbor_events,
+            self.interface_events,
+            self.peer_events,
+            self.kvstore_pubs,
+            self.prefix_events,
+            self.route_updates,
+            self.fib_updates,
+        ):
+            q.close()
+
+    async def wait_initialized(self, timeout: float = 30.0) -> None:
+        """Block until the three init gates pass (reference: initialization
+        events KVSTORE_SYNCED → RIB_COMPUTED → FIB_SYNCED †)."""
+        async with asyncio.timeout(timeout):
+            await self.kvstore.initial_sync_done.wait()
+            await self.decision.rib_computed.wait()
+            await self.fib.synced.wait()
+
+    @property
+    def initialized(self) -> bool:
+        return (
+            self.kvstore.initial_sync_done.is_set()
+            and self.decision.rib_computed.is_set()
+            and self.fib.synced.is_set()
+        )
+
+    # ------------------------------------------------------------ operator
+
+    def set_interface(self, name: str, up: bool = True) -> None:
+        """Inject an interface event (the netlink seam)."""
+        self.interface_events.push(
+            InterfaceEvent(interfaces=[InterfaceInfo(name=name, is_up=up)])
+        )
+
+    def get_route_db(self):
+        return self.decision.get_route_db()
+
+    def get_programmed_routes(self):
+        return self.fib.get_programmed_unicast()
