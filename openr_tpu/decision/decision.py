@@ -66,13 +66,10 @@ def merge_area_ribs(
             if ek > ck or (ek == ck and entry.igp_cost < cur.igp_cost):
                 out.unicast_routes[prefix] = entry
             elif ek == ck and entry.igp_cost == cur.igp_cost:
-                merged = tuple(
-                    sorted(
-                        set(cur.nexthops) | set(entry.nexthops),
-                        key=lambda nh: (nh.neighbor_node, nh.if_name),
-                    )
+                out.unicast_routes[prefix] = replace(
+                    cur,
+                    nexthops=_union_nexthops(cur.nexthops, entry.nexthops),
                 )
-                out.unicast_routes[prefix] = replace(cur, nexthops=merged)
         for label, mentry in rdb.mpls_routes.items():
             cur = out.mpls_routes.get(label)
             if cur is None or _mpls_igp(mentry) < _mpls_igp(cur):
@@ -83,6 +80,29 @@ def merge_area_ribs(
 def _mpls_igp(entry) -> int:
     """IGP cost of an MPLS route = its nexthops' metric (all equal-cost)."""
     return min((nh.metric for nh in entry.nexthops), default=1 << 30)
+
+
+def _union_nexthops(a, b):
+    """Equal-cost multi-area nexthop union. Each side's UCMP weights were
+    gcd-normalized independently, so naive set-union could carry duplicate
+    (neighbor, interface) slots with clashing weights; dedupe by slot,
+    summing weights, and renormalize across the merged set."""
+    from openr_tpu.decision.ksp import normalize_weights
+
+    slots: dict[tuple, object] = {}
+    wsum: dict[tuple, int] = {}
+    weighted = any(nh.weight for nh in (*a, *b))
+    for nh in (*a, *b):
+        key = (nh.neighbor_node, nh.if_name)
+        slots.setdefault(key, nh)
+        if weighted:
+            wsum[key] = wsum.get(key, 0) + max(nh.weight, 1)
+    if weighted:
+        wsum = normalize_weights(wsum)
+        return tuple(
+            sorted(replace(nh, weight=wsum[k]) for k, nh in slots.items())
+        )
+    return tuple(sorted(slots.values()))
 
 
 class Decision(OpenrModule):
@@ -265,6 +285,28 @@ class Decision(OpenrModule):
             self.route_updates.push(update)
 
     # ------------------------------------------------------------ accessors
+
+    def set_rib_policy(self, policy) -> None:
+        """Install/replace the RibPolicy and recompute (reference:
+        OpenrCtrl setRibPolicy → Decision †). A recompute is also
+        scheduled at the policy's TTL expiry so stale weights don't
+        outlive it on a quiet network."""
+        self.rib_policy = policy
+        self.debounce.poke()
+        if policy is not None and getattr(policy, "ttl_secs", None):
+            self.spawn(
+                self._policy_expiry_watch(policy),
+                name=f"{self.name}.policy-ttl",
+            )
+
+    async def _policy_expiry_watch(self, policy) -> None:
+        await asyncio.sleep(policy.ttl_secs)
+        if self.rib_policy is policy:
+            self.rib_policy = None  # expired: drop and recompute unweighted
+            self.debounce.poke()
+
+    def get_rib_policy(self):
+        return self.rib_policy
 
     def get_route_db(self) -> RouteDatabase:
         return self.rib

@@ -1,0 +1,244 @@
+"""KSP2_ED_ECMP path computation and UCMP weight assignment.
+
+reference: openr/decision/SpfSolver.cpp † selectBestPathsKsp2 (two
+edge-disjoint shortest paths, turned into SR-MPLS source routes by
+pushing the node-segment labels of the path's interior hops) and
+selectBestPathsSpf's UCMP handling (per-nexthop weights from the
+advertised prefix-entry weights, normalized).
+
+Backend-shared: both the CPU oracle and the TPU solver call these
+host-side helpers with their own distance inputs, so RIB equivalence
+between backends is structural. KSP2 runs a host Dijkstra per (prefix,
+path) — it is control-plane-rare in the reference too (SR-MPLS prefixes
+only), while the hot SP_ECMP path stays on the batched TPU kernel.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from typing import Iterable
+
+from openr_tpu.types.network import (
+    MplsAction,
+    MplsActionType,
+    NextHop,
+    sorted_nexthops,
+)
+
+Link = tuple[str, str]  # directed (u, v)
+
+
+def dijkstra(
+    adj: dict[str, dict[str, int]],
+    root: str,
+    overloaded: set[str],
+    banned: frozenset[Link] = frozenset(),
+) -> dict[str, int]:
+    """Plain SSSP honoring node-overload (no transit) and banned links."""
+    dist = {root: 0}
+    pq = [(0, root)]
+    done: set[str] = set()
+    while pq:
+        d, u = heapq.heappop(pq)
+        if u in done:
+            continue
+        done.add(u)
+        if u != root and u in overloaded:
+            continue
+        for v, w in adj.get(u, {}).items():
+            if (u, v) in banned:
+                continue
+            nd = d + w
+            if v not in dist or nd < dist[v]:
+                dist[v] = nd
+                heapq.heappush(pq, (nd, v))
+    return dist
+
+
+def extract_path(
+    adj: dict[str, dict[str, int]],
+    dist: dict[str, int],
+    root: str,
+    dest: str,
+    overloaded: set[str],
+    banned: frozenset[Link] = frozenset(),
+) -> list[str] | None:
+    """Deterministic shortest path root→dest from a distance map: walk
+    back from dest choosing at each step the lexicographically-smallest
+    predecessor p with dist[p] + w(p→v) == dist[v]. Both backends use the
+    identical rule, so their KSP2 RIBs are byte-equal."""
+    if dest not in dist or dest == root:
+        return None
+    rev: dict[str, list[str]] = {}
+    for u, nbrs in adj.items():
+        for v in nbrs:
+            rev.setdefault(v, []).append(u)
+    path = [dest]
+    v = dest
+    seen = {dest}
+    while v != root:
+        best_p = None
+        for p in sorted(rev.get(v, [])):
+            if p in seen or (p, v) in banned or p not in dist:
+                continue
+            if p != root and p in overloaded:
+                continue
+            if dist[p] + adj[p][v] == dist[v]:
+                best_p = p
+                break
+        if best_p is None:
+            return None  # torn DAG (stale dist) — caller treats as no path
+        path.append(best_p)
+        seen.add(best_p)
+        v = best_p
+    path.reverse()
+    return path
+
+
+def path_links(path: list[str]) -> frozenset[Link]:
+    """Both directions of every link on the path (edge-disjoint = the
+    second path may not reuse a link in either direction †)."""
+    links: set[Link] = set()
+    for u, v in zip(path, path[1:]):
+        links.add((u, v))
+        links.add((v, u))
+    return frozenset(links)
+
+
+def two_edge_disjoint_paths(
+    adj: dict[str, dict[str, int]],
+    root: str,
+    dests: Iterable[str],
+    overloaded: set[str],
+) -> list[tuple[int, list[str]]]:
+    """Up to 2 edge-disjoint shortest paths from root to the nearest of
+    `dests` (reference: KSP2 — SPF, prune path-1 links, SPF again †).
+    Returns [(cost, path), ...] sorted by (cost, path)."""
+    dist1 = dijkstra(adj, root, overloaded)
+    reachable = [d for d in dests if d in dist1]
+    if not reachable:
+        return []
+    best = min(dist1[d] for d in reachable)
+    # nearest dest, deterministic tie-break by name
+    dest = min(d for d in reachable if dist1[d] == best)
+    p1 = extract_path(adj, dist1, root, dest, overloaded)
+    if p1 is None:
+        return []
+    out = [(dist1[dest], p1)]
+    banned = path_links(p1)
+    dist2 = dijkstra(adj, root, overloaded, banned=banned)
+    if dest in dist2:
+        p2 = extract_path(adj, dist2, root, dest, overloaded, banned=banned)
+        if p2 is not None:
+            out.append((dist2[dest], p2))
+    out.sort(key=lambda cp: (cp[0], cp[1]))
+    return out
+
+
+def ksp2_nexthops(
+    ls,  # LinkState
+    my_node: str,
+    paths: list[tuple[int, list[str]]],
+) -> tuple[NextHop, ...]:
+    """Turn KSP2 paths into SR-MPLS source-routed nexthops: first link of
+    the path, PUSHing the node-segment labels of the interior hops (top
+    label first) so transit pins the explicit path (reference:
+    createKsp2EdRoutes label-stack construction †)."""
+    my_db = ls.adjacency_db(my_node)
+    if my_db is None:
+        return ()
+    nhs: list[NextHop] = []
+    for cost, path in paths:
+        v1 = path[1]
+        # min-metric link to the first hop
+        cands = [
+            a
+            for a in my_db.adjacencies
+            if a.other_node_name == v1 and not a.is_overloaded
+        ]
+        if not cands:
+            continue
+        link = min(cands, key=lambda a: (a.metric, a.if_name))
+        stack = [ls.node_label(n) for n in path[2:]]
+        if any(lbl <= 0 for lbl in stack):
+            # an unlabeled interior hop cannot be pinned — emitting a
+            # truncated stack would let traffic leave the edge-disjoint
+            # path, silently defeating the protection guarantee; skip
+            continue
+        action = (
+            MplsAction(
+                action=MplsActionType.PUSH, push_labels=tuple(reversed(stack))
+            )
+            if stack
+            else None
+        )
+        nhs.append(
+            NextHop(
+                address=v1,
+                if_name=link.if_name,
+                metric=cost,
+                neighbor_node=v1,
+                area=ls.area,
+                mpls_action=action,
+            )
+        )
+    return sorted_nexthops(nhs)
+
+
+def ksp2_route(
+    ls,  # LinkState
+    my_node: str,
+    prefix,
+    reachable: dict[str, "object"],  # node -> PrefixEntry
+    best_nodes: list[str],
+    adjmap: dict[str, dict[str, int]],
+    overloaded: set[str],
+):
+    """Full KSP2 RibEntry construction, shared verbatim by both backends
+    (oracle + TPU) so their KSP2 RIBs cannot drift. Returns None when no
+    usable path survives or the min_nexthop floor isn't met."""
+    from openr_tpu.types.routes import RibEntry
+
+    paths = two_edge_disjoint_paths(adjmap, my_node, best_nodes, overloaded)
+    nhs = ksp2_nexthops(ls, my_node, paths)
+    if not nhs:
+        return None
+    dest = paths[0][1][-1]
+    best_entry = reachable[dest]
+    if (
+        getattr(best_entry, "min_nexthop", 0)
+        and len(nhs) < best_entry.min_nexthop
+    ):
+        return None  # reference: drop route below min_nexthop †
+    return RibEntry(
+        prefix=prefix,
+        nexthops=nhs,
+        best_node=dest,
+        best_nodes=tuple(best_nodes),
+        best_entry=best_entry,
+        igp_cost=paths[0][0],
+    )
+
+
+def ucmp_weights(chosen_entries: dict[str, "object"]) -> dict[str, int] | None:
+    """node → UCMP weight, or None when no advertiser set a weight
+    (pure ECMP). Nodes without a weight default to 1 so a partially
+    weighted anycast set still forwards everywhere."""
+    if not any(getattr(e, "weight", 0) > 0 for e in chosen_entries.values()):
+        return None
+    return {
+        n: max(getattr(e, "weight", 0), 1) for n, e in chosen_entries.items()
+    }
+
+
+def normalize_weights(weighted: dict[tuple[str, str], int]) -> dict[tuple[str, str], int]:
+    """Divide all (neighbor, if) weights by their gcd (reference: UCMP
+    weight normalization before programming †)."""
+    if not weighted:
+        return weighted
+    g = math.gcd(*weighted.values()) if len(weighted) > 1 else next(
+        iter(weighted.values())
+    )
+    g = g or 1
+    return {k: v // g for k, v in weighted.items()}

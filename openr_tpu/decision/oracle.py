@@ -27,6 +27,11 @@ import heapq
 from dataclasses import dataclass
 
 from openr_tpu.common.constants import DIST_INF, METRIC_MAX, MPLS_LABEL_MIN
+from openr_tpu.decision.ksp import (
+    ksp2_route,
+    normalize_weights,
+    ucmp_weights,
+)
 from openr_tpu.decision.linkstate import LinkState, PrefixState
 from openr_tpu.types.network import (
     MplsAction,
@@ -35,7 +40,7 @@ from openr_tpu.types.network import (
     sorted_nexthops,
 )
 from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
-from openr_tpu.types.topology import PrefixEntry
+from openr_tpu.types.topology import ForwardingAlgorithm, PrefixEntry
 
 INF = float("inf")
 
@@ -139,17 +144,20 @@ def _nexthops_to_nodes(
     my_node: str,
     spf: SpfResult,
     targets: list[str],
+    weights: dict[str, int] | None = None,
 ) -> tuple[NextHop, ...]:
     """Union of ECMP first-hops toward `targets`, as NextHop objects.
 
     Parallel links: every interface at the min metric toward the first-hop
     neighbor becomes its own nexthop (reference keeps per-interface
-    nexthops †).
+    nexthops †). With `weights` (UCMP), each (neighbor, interface) nexthop
+    carries the gcd-normalized sum of the weights of the targets it
+    serves (reference: selectBestPathsSpf UCMP weight aggregation †).
     """
     csr = ls.to_csr()
-    nhs: list[NextHop] = []
-    seen = set()
     my_id = csr.name_to_id.get(my_node)
+    slots: dict[tuple[str, str], int] = {}  # (fh, if) -> igp metric
+    wsum: dict[tuple[str, str], int] = {}
     for tgt in targets:
         igp = spf.dist[tgt]
         for fh in spf.first_hops.get(tgt, ()):
@@ -157,18 +165,25 @@ def _nexthops_to_nodes(
             details = csr.adj_details.get((my_id, fh_id), [])
             best = min((d[1] for d in details), default=None)
             for if_name, metric, _w, _lbl, _oif in details:
-                if metric != best or (fh, if_name) in seen:
+                if metric != best:
                     continue
-                seen.add((fh, if_name))
-                nhs.append(
-                    NextHop(
-                        address=fh,
-                        if_name=if_name,
-                        metric=igp,
-                        neighbor_node=fh,
-                        area=ls.area,
-                    )
-                )
+                key = (fh, if_name)
+                slots.setdefault(key, igp)
+                if weights is not None:
+                    wsum[key] = wsum.get(key, 0) + weights[tgt]
+    if weights is not None:
+        wsum = normalize_weights(wsum)
+    nhs = [
+        NextHop(
+            address=fh,
+            if_name=if_name,
+            metric=igp,
+            weight=wsum.get((fh, if_name), 0) if weights is not None else 0,
+            neighbor_node=fh,
+            area=ls.area,
+        )
+        for (fh, if_name), igp in slots.items()
+    ]
     return sorted_nexthops(nhs)
 
 
@@ -185,6 +200,7 @@ def compute_routes(
     spf = run_spf(ls, my_node, adj)
 
     # ---- unicast ----------------------------------------------------------
+    overloaded_set = None  # built lazily, once, for KSP2 prefixes
     for prefix, per_node in sorted(ps.prefixes.items()):
         reachable = {
             n: e
@@ -199,9 +215,24 @@ def compute_routes(
         )
         if my_node in best_nodes:
             continue  # local prefix: not programmed via SPF
+        if (
+            reachable[best_nodes[0]].forwarding_algorithm
+            == ForwardingAlgorithm.KSP2_ED_ECMP
+        ):
+            if overloaded_set is None:
+                overloaded_set = {
+                    n for n in ls.nodes if ls.is_node_overloaded(n)
+                }
+            ksp_entry = ksp2_route(
+                ls, my_node, prefix, reachable, best_nodes, adj, overloaded_set
+            )
+            if ksp_entry is not None:
+                rdb.unicast_routes[prefix] = ksp_entry
+            continue
         min_igp = min(spf.dist[n] for n in best_nodes)
         chosen = [n for n in best_nodes if spf.dist[n] == min_igp]
-        nexthops = _nexthops_to_nodes(ls, my_node, spf, chosen)
+        weights = ucmp_weights({n: reachable[n] for n in chosen})
+        nexthops = _nexthops_to_nodes(ls, my_node, spf, chosen, weights)
         if not nexthops:
             continue
         best_entry = reachable[chosen[0]]

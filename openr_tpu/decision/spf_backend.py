@@ -21,8 +21,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.common.constants import MPLS_LABEL_MIN
+from openr_tpu.decision.ksp import (
+    ksp2_route,
+    normalize_weights,
+    ucmp_weights,
+)
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
-from openr_tpu.decision.oracle import metric_key
+from openr_tpu.decision.oracle import build_adjacency, metric_key
+from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
     INF_DIST,
     METRIC_MAX,
@@ -141,6 +147,8 @@ class TpuSpfSolver:
         d_root = dist[:, 0]  # [Vp]
 
         # ---- unicast ------------------------------------------------------
+        adjmap = None  # lazy host adjacency for KSP2 prefixes only
+        overloaded: set[str] = set()
         for prefix, per_node in sorted(ps.prefixes.items()):
             reachable = {}
             for n, e in per_node.items():
@@ -161,16 +169,39 @@ class TpuSpfSolver:
             )
             if my_node in best_nodes:
                 continue  # local prefix
+            if (
+                reachable[best_nodes[0]].forwarding_algorithm
+                == ForwardingAlgorithm.KSP2_ED_ECMP
+            ):
+                # host-side masked re-solve, shared with the oracle (KSP2
+                # prefixes are SR-rare; see decision/ksp.py docstring)
+                if adjmap is None:
+                    adjmap = build_adjacency(ls)
+                    overloaded = {
+                        n for n in ls.nodes if ls.is_node_overloaded(n)
+                    }
+                ksp_entry = ksp2_route(
+                    ls, my_node, prefix, reachable, best_nodes,
+                    adjmap, overloaded,
+                )
+                if ksp_entry is not None:
+                    rdb.unicast_routes[prefix] = ksp_entry
+                continue
             ids = np.array(
                 [csr.name_to_id[n] for n in best_nodes], dtype=np.int64
             )
             igps = d_root[ids]
             min_igp = int(igps.min())
             chosen = ids[igps == min_igp]
-            nexthops = self._mk_nexthops(csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area)
+            chosen_names = sorted(csr.node_names[i] for i in chosen)
+            weights = ucmp_weights({n: reachable[n] for n in chosen_names})
+            nexthops = self._mk_nexthops(
+                csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area,
+                weights=weights,
+                target_names=csr.node_names,
+            )
             if not nexthops:
                 continue
-            chosen_names = sorted(csr.node_names[i] for i in chosen)
             best_entry = reachable[chosen_names[0]]
             if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
                 continue
@@ -247,11 +278,16 @@ class TpuSpfSolver:
         targets: np.ndarray,
         igp: int,
         area: str,
+        weights: dict[str, int] | None = None,
+        target_names=None,
     ) -> tuple[NextHop, ...]:
         """Union of valid first-hop interfaces toward `targets` (all at the
-        same IGP distance). Parallel links at min metric each get a nexthop."""
-        nhs: list[NextHop] = []
-        seen = set()
+        same IGP distance). Parallel links at min metric each get a nexthop.
+        With `weights` (UCMP), nexthop weight = gcd-normalized sum of the
+        weights of the targets it serves — identical rule to the oracle's
+        _nexthops_to_nodes."""
+        slots: dict[tuple[str, str], None] = {}
+        wsum: dict[tuple[str, str], int] = {}
         for tgt in targets:
             valid = np.nonzero(fh[:, int(tgt)])[0]
             for n_idx in valid:
@@ -260,16 +296,28 @@ class TpuSpfSolver:
                 best = min(d[1] for d in details)
                 fh_name = csr.node_names[fh_id]
                 for if_name, m, _w, _lbl, _oif in details:
-                    if m != best or (fh_id, if_name) in seen:
+                    if m != best:
                         continue
-                    seen.add((fh_id, if_name))
-                    nhs.append(
-                        NextHop(
-                            address=fh_name,
-                            if_name=if_name,
-                            metric=igp,
-                            neighbor_node=fh_name,
-                            area=area,
+                    key = (fh_name, if_name)
+                    slots[key] = None
+                    if weights is not None:
+                        wsum[key] = (
+                            wsum.get(key, 0)
+                            + weights[target_names[int(tgt)]]
                         )
-                    )
+        if weights is not None:
+            wsum = normalize_weights(wsum)
+        nhs = [
+            NextHop(
+                address=fh_name,
+                if_name=if_name,
+                metric=igp,
+                weight=wsum.get((fh_name, if_name), 0)
+                if weights is not None
+                else 0,
+                neighbor_node=fh_name,
+                area=area,
+            )
+            for (fh_name, if_name) in slots
+        ]
         return sorted_nexthops(nhs)

@@ -85,8 +85,17 @@ class Cluster:
                     spark=FAST_SPARK,
                     originated_prefixes=originated,
                 )
-            ncfg.decision.debounce_min_ms = debounce_ms[0]
-            ncfg.decision.debounce_max_ms = debounce_ms[1]
+            # copy-on-write: never mutate a caller-supplied NodeConfig
+            from dataclasses import replace
+
+            ncfg = replace(
+                ncfg,
+                decision=replace(
+                    ncfg.decision,
+                    debounce_min_ms=debounce_ms[0],
+                    debounce_max_ms=debounce_ms[1],
+                ),
+            )
             cfg = Config(ncfg)
             node = OpenrNode(
                 cfg,

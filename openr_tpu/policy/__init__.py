@@ -1,0 +1,8 @@
+"""Route policy (reference: openr/policy/ † + RibPolicy in OpenrCtrl.thrift †)."""
+
+from openr_tpu.policy.policy import (  # noqa: F401
+    PolicyManager,
+    PolicyStatement,
+    RibPolicy,
+    RibPolicyStatement,
+)

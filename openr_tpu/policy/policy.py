@@ -1,0 +1,179 @@
+"""Policy engines: origination-time transforms and Decision-side RibPolicy.
+
+reference:
+  * openr/policy/PolicyManager † — match/transform applied when prefixes
+    are originated or redistributed (PrefixManager seam): match on tags /
+    prefix list, then accept (optionally rewriting metrics/tags) or deny.
+  * RibPolicy in openr/if/OpenrCtrl.thrift † — Decision-side weight
+    policy with a TTL: statements match routes (by prefix or tag) and
+    assign per-nexthop UCMP weights from area / neighbor maps; weight 0
+    removes the nexthop. Applied by Decision after route computation
+    (Decision::processRibPolicyUpdate / RibPolicy::applyPolicy †).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+
+from openr_tpu.decision.ksp import normalize_weights
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.routes import RouteDatabase
+from openr_tpu.types.topology import PrefixEntry, PrefixMetrics
+
+# ---------------------------------------------------------------- origination
+
+
+@dataclass(frozen=True)
+class PolicyStatement:
+    """One origination policy rule (reference: PolicyStatement †).
+
+    Matching: empty matcher field = wildcard. `match_tags` matches if the
+    entry carries ANY of the tags; `match_prefixes` matches exact prefix
+    or any subnet of a listed prefix.
+    """
+
+    name: str = ""
+    match_tags: tuple[str, ...] = ()
+    match_prefixes: tuple[str, ...] = ()
+    action_accept: bool = True
+    set_path_preference: int | None = None
+    set_source_preference: int | None = None
+    set_distance_increment: int | None = None  # distance += N (redistribution)
+    add_tags: tuple[str, ...] = ()
+
+    def matches(self, entry: PrefixEntry) -> bool:
+        if self.match_tags and not (set(self.match_tags) & set(entry.tags)):
+            return False
+        if self.match_prefixes:
+            net = entry.prefix.network
+            ok = False
+            for p in self.match_prefixes:
+                pn = IpPrefix.make(p).network
+                if pn.version == net.version and net.subnet_of(pn):
+                    ok = True
+                    break
+            if not ok:
+                return False
+        return True
+
+    def apply(self, entry: PrefixEntry) -> PrefixEntry | None:
+        if not self.action_accept:
+            return None
+        m = entry.metrics
+        if self.set_path_preference is not None:
+            m = replace(m, path_preference=self.set_path_preference)
+        if self.set_source_preference is not None:
+            m = replace(m, source_preference=self.set_source_preference)
+        if self.set_distance_increment is not None:
+            m = replace(m, distance=m.distance + self.set_distance_increment)
+        tags = tuple(dict.fromkeys((*entry.tags, *self.add_tags)))
+        return replace(entry, metrics=m, tags=tags)
+
+
+@dataclass
+class PolicyManager:
+    """First-match-wins statement list (reference: PolicyManager †).
+    `default_accept` governs entries no statement matches."""
+
+    statements: tuple[PolicyStatement, ...] = ()
+    default_accept: bool = True
+
+    def apply(self, entry: PrefixEntry) -> PrefixEntry | None:
+        """None = denied (do not originate)."""
+        for st in self.statements:
+            if st.matches(entry):
+                return st.apply(entry)
+        return entry if self.default_accept else None
+
+
+# ------------------------------------------------------------------ RibPolicy
+
+
+@dataclass(frozen=True)
+class RibPolicyStatement:
+    """reference: RibPolicyStatement † — matcher + RouteActionWeight."""
+
+    name: str = ""
+    match_prefixes: tuple[str, ...] = ()
+    match_tags: tuple[str, ...] = ()
+    default_weight: int = 1
+    area_to_weight: dict[str, int] = field(default_factory=dict)
+    neighbor_to_weight: dict[str, int] = field(default_factory=dict)
+
+    def matches(self, entry) -> bool:
+        if self.match_tags:
+            tags = entry.best_entry.tags if entry.best_entry else ()
+            if not (set(self.match_tags) & set(tags)):
+                return False
+        if self.match_prefixes:
+            net = entry.prefix.network
+            return any(
+                (pn := IpPrefix.make(p).network).version == net.version
+                and net.subnet_of(pn)
+                for p in self.match_prefixes
+            )
+        return True
+
+    def weight_for(self, nh) -> int:
+        if nh.neighbor_node in self.neighbor_to_weight:
+            return self.neighbor_to_weight[nh.neighbor_node]
+        if nh.area in self.area_to_weight:
+            return self.area_to_weight[nh.area]
+        return self.default_weight
+
+
+@dataclass
+class RibPolicy:
+    """reference: RibPolicy † — statement list + ttl_secs. Decision holds
+    at most one; `apply` mutates a computed RouteDatabase in place."""
+
+    statements: tuple[RibPolicyStatement, ...] = ()
+    ttl_secs: float = 300.0
+    _expires_at: float = field(default=0.0, compare=False)
+
+    def __post_init__(self):
+        if self._expires_at == 0.0:
+            self._expires_at = time.monotonic() + self.ttl_secs
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self._expires_at
+
+    def apply(self, rdb: RouteDatabase) -> int:
+        """Rewrite nexthop weights on matching routes; returns the number
+        of routes modified. Weight 0 drops the nexthop; a route whose
+        nexthops all drop is removed (reference: applyAction semantics †)."""
+        if self.expired:
+            return 0
+        modified = 0
+        for prefix in list(rdb.unicast_routes):
+            entry = rdb.unicast_routes[prefix]
+            st = next(
+                (s for s in self.statements if s.matches(entry)), None
+            )
+            if st is None:
+                continue
+            weighted = {
+                (nh.neighbor_node, nh.if_name): st.weight_for(nh)
+                for nh in entry.nexthops
+            }
+            kept = {k: w for k, w in weighted.items() if w > 0}
+            if not kept:
+                del rdb.unicast_routes[prefix]
+                modified += 1
+                continue
+            norm = normalize_weights(kept)
+            new_nhs = tuple(
+                sorted(
+                    replace(nh, weight=norm[(nh.neighbor_node, nh.if_name)])
+                    for nh in entry.nexthops
+                    if (nh.neighbor_node, nh.if_name) in kept
+                )
+            )
+            if new_nhs != entry.nexthops:
+                rdb.unicast_routes[prefix] = replace(
+                    entry, nexthops=new_nhs
+                )
+                modified += 1
+        return modified

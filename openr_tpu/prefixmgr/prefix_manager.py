@@ -79,9 +79,11 @@ class PrefixManager(OpenrModule):
         kv_client: KvStoreClient,
         prefix_events_reader: RQueue | None = None,
         fib_updates_reader: RQueue | None = None,
+        policy=None,  # openr_tpu.policy.PolicyManager (origination policy)
         counters=None,
     ):
         super().__init__(f"{config.node_name}.prefixmgr", counters=counters)
+        self.policy = policy
         self.config = config
         self.node_name = config.node_name
         self.kv_client = kv_client
@@ -119,6 +121,12 @@ class PrefixManager(OpenrModule):
     def process_event(self, ev: PrefixEvent) -> None:
         if ev.type == PrefixEventType.ADD_PREFIXES:
             for e in ev.entries:
+                if self.policy is not None:
+                    e = self.policy.apply(e)
+                    if e is None:  # denied by origination policy
+                        if self.counters:
+                            self.counters.increment("prefixmgr.policy_denied")
+                        continue
                 self._entries[(ev.source, e.prefix)] = (e, ev.dest_areas)
         elif ev.type == PrefixEventType.WITHDRAW_PREFIXES:
             for e in ev.entries:

@@ -1,0 +1,257 @@
+"""KSP2_ED_ECMP + UCMP tests (reference analogue: DecisionTest KSP2 and
+UCMP scenarios †) — hand-computed expectations plus oracle/TPU backend
+equivalence."""
+
+from dataclasses import replace
+
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.oracle import compute_routes
+from openr_tpu.decision.spf_backend import TpuSpfSolver
+from openr_tpu.types.network import IpPrefix, MplsActionType
+from openr_tpu.types.topology import (
+    Adjacency,
+    AdjacencyDatabase,
+    ForwardingAlgorithm,
+    ForwardingType,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+)
+from openr_tpu.utils import topogen
+
+
+def _state(adj_dbs, prefix_dbs):
+    ls, ps = LinkState(), PrefixState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    for db in prefix_dbs:
+        ps.update_prefix_db(db)
+    return ls, ps
+
+
+def ksp2_entry(pfx: str) -> PrefixEntry:
+    return PrefixEntry(
+        prefix=IpPrefix.make(pfx),
+        forwarding_type=ForwardingType.SR_MPLS,
+        forwarding_algorithm=ForwardingAlgorithm.KSP2_ED_ECMP,
+    )
+
+
+def test_ksp2_ring4_two_disjoint_paths():
+    """ring-4: node-0 → node-2 has exactly two edge-disjoint paths of
+    cost 2 (via node-1 and via node-3), each SR-pinned by a label push."""
+    adj_dbs, _ = topogen.ring(4)
+    prefix_db = PrefixDatabase(
+        this_node_name="node-2", prefix_entries=(ksp2_entry("10.9.0.0/16"),)
+    )
+    ls, ps = _state(adj_dbs, [prefix_db])
+    rdb = compute_routes(ls, ps, "node-0")
+    e = rdb.unicast_routes[IpPrefix.make("10.9.0.0/16")]
+    assert {nh.neighbor_node for nh in e.nexthops} == {"node-1", "node-3"}
+    assert all(nh.metric == 2 for nh in e.nexthops)
+    # each path interior hop count is 1 (the dest), so PUSH of dest label
+    lbl2 = ls.node_label("node-2")
+    assert lbl2 > 0
+    for nh in e.nexthops:
+        assert nh.mpls_action is not None
+        assert nh.mpls_action.action == MplsActionType.PUSH
+        assert nh.mpls_action.push_labels == (lbl2,)
+
+
+def test_ksp2_second_path_longer():
+    """line+detour: a—b—dest and a—c—d2—dest: path 1 cost 2, path 2 cost 3
+    (edge-disjoint), both present."""
+    from openr_tpu.common.constants import MPLS_LABEL_MIN
+
+    def adj(me, *links):
+        return AdjacencyDatabase(
+            this_node_name=me,
+            node_label=MPLS_LABEL_MIN + 100 + ord(me[0]),
+            adjacencies=tuple(
+                Adjacency(other_node_name=o, if_name=f"if-{me}-{o}", metric=m)
+                for o, m in links
+            ),
+        )
+
+    dbs = [
+        adj("a", ("b", 1), ("c", 1)),
+        adj("b", ("a", 1), ("z", 1)),
+        adj("c", ("a", 1), ("d", 1)),
+        adj("d", ("c", 1), ("z", 1)),
+        adj("z", ("b", 1), ("d", 1)),
+    ]
+    prefix_db = PrefixDatabase(
+        this_node_name="z", prefix_entries=(ksp2_entry("10.9.0.0/16"),)
+    )
+    ls, ps = _state(dbs, [prefix_db])
+    rdb = compute_routes(ls, ps, "a")
+    e = rdb.unicast_routes[IpPrefix.make("10.9.0.0/16")]
+    by_nbr = {nh.neighbor_node: nh for nh in e.nexthops}
+    assert set(by_nbr) == {"b", "c"}
+    assert by_nbr["b"].metric == 2
+    assert by_nbr["c"].metric == 3
+    assert e.igp_cost == 2
+
+
+def test_ksp2_no_second_path():
+    """line a—b—c: only one path exists; route has a single nexthop."""
+    adj_dbs, _ = topogen.ring(3)
+    # remove the 0-2 direct links to make a line 0-1-2
+    def strip(db, other):
+        return replace(
+            db,
+            adjacencies=tuple(
+                a for a in db.adjacencies if a.other_node_name != other
+            ),
+        )
+
+    adj_dbs = [
+        strip(adj_dbs[0], "node-2"),
+        adj_dbs[1],
+        strip(adj_dbs[2], "node-0"),
+    ]
+    prefix_db = PrefixDatabase(
+        this_node_name="node-2", prefix_entries=(ksp2_entry("10.9.0.0/16"),)
+    )
+    ls, ps = _state(adj_dbs, [prefix_db])
+    rdb = compute_routes(ls, ps, "node-0")
+    e = rdb.unicast_routes[IpPrefix.make("10.9.0.0/16")]
+    assert len(e.nexthops) == 1
+    assert e.nexthops[0].neighbor_node == "node-1"
+
+
+def test_ucmp_weighted_anycast():
+    """Same prefix from node-1 (weight 3) and node-3 (weight 1) on ring-4,
+    both at igp 1 from node-0 → nexthop weights 3:1."""
+    adj_dbs, _ = topogen.ring(4)
+    p = "10.9.0.0/16"
+    dbs = [
+        PrefixDatabase(
+            this_node_name="node-1",
+            prefix_entries=(
+                PrefixEntry(prefix=IpPrefix.make(p), weight=3),
+            ),
+        ),
+        PrefixDatabase(
+            this_node_name="node-3",
+            prefix_entries=(
+                PrefixEntry(prefix=IpPrefix.make(p), weight=1),
+            ),
+        ),
+    ]
+    ls, ps = _state(adj_dbs, dbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    e = rdb.unicast_routes[IpPrefix.make(p)]
+    w = {nh.neighbor_node: nh.weight for nh in e.nexthops}
+    assert w == {"node-1": 3, "node-3": 1}
+
+
+def test_ucmp_weights_normalized():
+    """Weights 4 and 2 normalize to 2 and 1 (gcd division)."""
+    adj_dbs, _ = topogen.ring(4)
+    p = "10.9.0.0/16"
+    dbs = [
+        PrefixDatabase(
+            this_node_name="node-1",
+            prefix_entries=(PrefixEntry(prefix=IpPrefix.make(p), weight=4),),
+        ),
+        PrefixDatabase(
+            this_node_name="node-3",
+            prefix_entries=(PrefixEntry(prefix=IpPrefix.make(p), weight=2),),
+        ),
+    ]
+    ls, ps = _state(adj_dbs, dbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    e = rdb.unicast_routes[IpPrefix.make(p)]
+    w = {nh.neighbor_node: nh.weight for nh in e.nexthops}
+    assert w == {"node-1": 2, "node-3": 1}
+
+
+def test_no_weights_means_ecmp():
+    adj_dbs, prefix_dbs = topogen.ring(4)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    rdb = compute_routes(ls, ps, "node-0")
+    for e in rdb.unicast_routes.values():
+        assert all(nh.weight == 0 for nh in e.nexthops)
+
+
+def test_tpu_backend_matches_oracle_ksp2_ucmp():
+    """Mixed workload (SP_ECMP + KSP2 + UCMP prefixes) on a grid: both
+    backends produce identical RouteDatabases."""
+    adj_dbs, prefix_dbs = topogen.grid(3, 3)
+    extra = [
+        PrefixDatabase(
+            this_node_name="node-8",
+            prefix_entries=(ksp2_entry("10.80.0.0/16"),),
+        ),
+        PrefixDatabase(
+            this_node_name="node-2",
+            prefix_entries=(
+                PrefixEntry(prefix=IpPrefix.make("10.81.0.0/16"), weight=2),
+            ),
+        ),
+        PrefixDatabase(
+            this_node_name="node-6",
+            prefix_entries=(
+                PrefixEntry(prefix=IpPrefix.make("10.81.0.0/16"), weight=5),
+            ),
+        ),
+    ]
+    ls, ps = _state(adj_dbs, list(prefix_dbs) + extra)
+    solver = TpuSpfSolver()
+    for root in ("node-0", "node-4", "node-7"):
+        cpu = compute_routes(ls, ps, root)
+        tpu = solver.compute_routes(ls, ps, root)
+        assert cpu.unicast_routes == tpu.unicast_routes, f"root {root}"
+        assert cpu.mpls_routes == tpu.mpls_routes, f"root {root}"
+
+
+def test_ksp2_min_nexthop_enforced():
+    """KSP2 route below the advertised min_nexthop floor is dropped (same
+    rule the SP_ECMP path enforces)."""
+    adj_dbs, _ = topogen.ring(3)
+    # line 0-1-2: only one edge-disjoint path from 0 to 2
+    def strip(db, other):
+        return replace(
+            db,
+            adjacencies=tuple(
+                a for a in db.adjacencies if a.other_node_name != other
+            ),
+        )
+
+    adj_dbs = [
+        strip(adj_dbs[0], "node-2"),
+        adj_dbs[1],
+        strip(adj_dbs[2], "node-0"),
+    ]
+    e = replace(ksp2_entry("10.9.0.0/16"), min_nexthop=2)
+    ls, ps = _state(
+        adj_dbs,
+        [PrefixDatabase(this_node_name="node-2", prefix_entries=(e,))],
+    )
+    rdb = compute_routes(ls, ps, "node-0")
+    assert IpPrefix.make("10.9.0.0/16") not in rdb.unicast_routes
+
+
+def test_ksp2_unlabeled_interior_hop_rejected():
+    """A path whose stack hop (beyond the first link) lacks a node label
+    cannot be SR-pinned and must not be emitted with a truncated stack."""
+    adj_dbs, _ = topogen.ring(6)
+    # erase node-2's label: path 0→1→2→3 needs labels of [2, 3] → unpinnable
+    adj_dbs = [
+        replace(db, node_label=0) if db.this_node_name == "node-2" else db
+        for db in adj_dbs
+    ]
+    ls, ps = _state(
+        adj_dbs,
+        [
+            PrefixDatabase(
+                this_node_name="node-3",
+                prefix_entries=(ksp2_entry("10.9.0.0/16"),),
+            )
+        ],
+    )
+    rdb = compute_routes(ls, ps, "node-0")
+    e = rdb.unicast_routes[IpPrefix.make("10.9.0.0/16")]
+    # only the 0→5→4→3 path survives (all its stack hops are labeled)
+    assert {nh.neighbor_node for nh in e.nexthops} == {"node-5"}

@@ -1,0 +1,150 @@
+"""Policy tests (reference analogue: openr/policy/tests † +
+DecisionTest RibPolicy cases †)."""
+
+import time
+
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.oracle import compute_routes
+from openr_tpu.policy import (
+    PolicyManager,
+    PolicyStatement,
+    RibPolicy,
+    RibPolicyStatement,
+)
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+from openr_tpu.utils import topogen
+
+
+def entry(pfx, tags=(), **kw):
+    return PrefixEntry(prefix=IpPrefix.make(pfx), tags=tuple(tags), **kw)
+
+
+# ------------------------------------------------------------- origination
+
+
+def test_policy_statement_tag_match_and_transform():
+    st = PolicyStatement(
+        name="bump-bgp",
+        match_tags=("bgp",),
+        set_path_preference=700,
+        add_tags=("redistributed",),
+    )
+    e = entry("10.0.0.0/24", tags=["bgp"])
+    out = st.apply(e)
+    assert out.metrics.path_preference == 700
+    assert "redistributed" in out.tags
+    assert not st.matches(entry("10.0.0.0/24", tags=["ospf"]))
+
+
+def test_policy_prefix_match_subnet():
+    st = PolicyStatement(match_prefixes=("10.0.0.0/8",), action_accept=False)
+    mgr = PolicyManager(statements=(st,))
+    assert mgr.apply(entry("10.1.2.0/24")) is None  # denied
+    assert mgr.apply(entry("192.168.0.0/24")) is not None  # default accept
+
+
+def test_policy_first_match_wins():
+    mgr = PolicyManager(
+        statements=(
+            PolicyStatement(match_tags=("a",), set_source_preference=10),
+            PolicyStatement(match_tags=("a", "b"), set_source_preference=99),
+        )
+    )
+    out = mgr.apply(entry("10.0.0.0/24", tags=["a", "b"]))
+    assert out.metrics.source_preference == 10
+
+
+def test_policy_default_deny():
+    mgr = PolicyManager(statements=(), default_accept=False)
+    assert mgr.apply(entry("10.0.0.0/24")) is None
+
+
+# --------------------------------------------------------------- RibPolicy
+
+
+def _rib_with_ecmp():
+    adj_dbs, _ = topogen.ring(4)
+    ls, ps = LinkState(), PrefixState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    ps.update_prefix_db(
+        PrefixDatabase(
+            this_node_name="node-2",
+            prefix_entries=(entry("10.9.0.0/16", tags=["anycast"]),),
+        )
+    )
+    return compute_routes(ls, ps, "node-0")
+
+
+def test_rib_policy_neighbor_weights():
+    rdb = _rib_with_ecmp()
+    p = IpPrefix.make("10.9.0.0/16")
+    assert {nh.neighbor_node for nh in rdb.unicast_routes[p].nexthops} == {
+        "node-1",
+        "node-3",
+    }
+    pol = RibPolicy(
+        statements=(
+            RibPolicyStatement(
+                match_prefixes=("10.9.0.0/16",),
+                neighbor_to_weight={"node-1": 4, "node-3": 2},
+            ),
+        )
+    )
+    assert pol.apply(rdb) == 1
+    w = {nh.neighbor_node: nh.weight for nh in rdb.unicast_routes[p].nexthops}
+    assert w == {"node-1": 2, "node-3": 1}  # normalized
+
+
+def test_rib_policy_zero_weight_drops_nexthop():
+    rdb = _rib_with_ecmp()
+    p = IpPrefix.make("10.9.0.0/16")
+    pol = RibPolicy(
+        statements=(
+            RibPolicyStatement(
+                match_tags=("anycast",),
+                neighbor_to_weight={"node-1": 0},
+                default_weight=1,
+            ),
+        )
+    )
+    pol.apply(rdb)
+    nhs = rdb.unicast_routes[p].nexthops
+    assert {nh.neighbor_node for nh in nhs} == {"node-3"}
+
+
+def test_rib_policy_all_zero_removes_route():
+    rdb = _rib_with_ecmp()
+    p = IpPrefix.make("10.9.0.0/16")
+    pol = RibPolicy(
+        statements=(
+            RibPolicyStatement(
+                match_prefixes=("10.9.0.0/16",), default_weight=0
+            ),
+        )
+    )
+    pol.apply(rdb)
+    assert p not in rdb.unicast_routes
+
+
+def test_rib_policy_ttl_expiry():
+    pol = RibPolicy(statements=(), ttl_secs=0.01)
+    time.sleep(0.02)
+    assert pol.expired
+    rdb = _rib_with_ecmp()
+    assert pol.apply(rdb) == 0
+
+
+def test_rib_policy_nonmatching_untouched():
+    rdb = _rib_with_ecmp()
+    pol = RibPolicy(
+        statements=(
+            RibPolicyStatement(
+                match_prefixes=("172.16.0.0/12",), default_weight=7
+            ),
+        )
+    )
+    assert pol.apply(rdb) == 0
+    p = IpPrefix.make("10.9.0.0/16")
+    assert all(nh.weight == 0 for nh in rdb.unicast_routes[p].nexthops)
