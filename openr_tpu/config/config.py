@@ -123,6 +123,22 @@ class OriginatedPrefix:
     tags: tuple[str, ...] = ()
 
 
+@dataclass(frozen=True)
+class PolicyStatementConfig:
+    """Config mirror of policy.PolicyStatement (kept here so the config
+    schema has no dependency on the policy engine; OpenrNode converts).
+    reference: PolicyStatement in openr/policy/ †."""
+
+    name: str = ""
+    match_tags: tuple[str, ...] = ()
+    match_prefixes: tuple[str, ...] = ()
+    action_accept: bool = True
+    set_path_preference: int | None = None
+    set_source_preference: int | None = None
+    set_distance_increment: int | None = None
+    add_tags: tuple[str, ...] = ()
+
+
 @dataclass
 class PrefixAllocationConfig:
     """reference: OpenrConfig.thrift † PrefixAllocationConfig — carve
@@ -152,6 +168,11 @@ class NodeConfig:
     )
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     originated_prefixes: tuple[OriginatedPrefix, ...] = ()
+    # origination policy statements applied by PrefixManager before a
+    # prefix is advertised (reference: area_policies / PolicyManager †);
+    # empty = accept everything
+    prefix_policy_statements: tuple["PolicyStatementConfig", ...] = ()
+    prefix_policy_default_accept: bool = True
     prefix_allocation: PrefixAllocationConfig | None = None
     enable_v4: bool = True
     enable_best_route_selection: bool = True

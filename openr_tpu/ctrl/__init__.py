@@ -1,0 +1,14 @@
+"""Control-plane API server (reference: openr/ctrl-server/ †).
+
+The reference exposes one thrift service — `OpenrCtrl.thrift`, implemented
+by `OpenrCtrlHandler` holding handles to every module — for operator and
+programmatic access: KvStore get/set/dump + streaming subscription, route
+queries (computed from Decision, programmed from Fib), adjacency dumps,
+overload/link-metric mutation, initialization status, counters. We expose
+the same surface over the framework's line-JSON RPC (openr_tpu/rpc/) with
+server-push streams standing in for thrift server-streams.
+"""
+
+from openr_tpu.ctrl.server import CtrlServer
+
+__all__ = ["CtrlServer"]

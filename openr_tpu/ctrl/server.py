@@ -1,0 +1,406 @@
+"""CtrlServer: the OpenrCtrlHandler equivalent.
+
+reference: openr/ctrl-server/OpenrCtrlHandler.{h,cpp} † — the handler holds
+pointers to every module plus queue readers, answers synchronous queries by
+hopping onto the owning module's eventbase, and maintains a publisher list
+for streaming subscriptions fed by a fiber draining the module queues. Here
+all modules share the asyncio loop, so queries call module methods
+directly; subscriptions are fanned out from one queue reader per stream
+type to any number of RPC stream writers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.kvstore.kvstore import pub_to_json_value, value_from_json
+from openr_tpu.messaging import QueueClosedError
+from openr_tpu.rpc import RpcServer
+from openr_tpu.types.kvstore import KeyDumpParams, Publication
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.routes import RouteUpdateType
+from openr_tpu.types.serde import from_jsonable, to_jsonable
+from openr_tpu.types.topology import PrefixEntry
+
+log = logging.getLogger(__name__)
+
+
+class CtrlServer(OpenrModule):
+    """RPC service over one OpenrNode's module graph."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(f"{node.name}.ctrl", counters=node.counters)
+        self.node = node
+        self.host = host
+        self._requested_port = port
+        self.port: int | None = None
+        self.server = RpcServer(name=self.name)
+        # readers must exist before any module starts pushing
+        self._kv_reader = node.kvstore_pubs.get_reader(f"{self.name}.kvsub")
+        self._fib_reader = node.fib_updates.get_reader(f"{self.name}.fibsub")
+        self._kv_subs: set[asyncio.Queue] = set()
+        self._fib_subs: set[asyncio.Queue] = set()
+        self._register_all()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def main(self) -> None:
+        self.port = await self.server.start(self.host, self._requested_port)
+        self.spawn(self._fanout(self._kv_reader, self._kv_subs, self._encode_pub),
+                   name=f"{self.name}.kvfan")
+        self.spawn(self._fanout(self._fib_reader, self._fib_subs, self._encode_fib),
+                   name=f"{self.name}.fibfan")
+
+    async def cleanup(self) -> None:
+        await self.server.stop()
+
+    # ------------------------------------------------------------ fan-out
+
+    async def _fanout(self, reader, subs: set[asyncio.Queue], encode) -> None:
+        """Drain one module queue, replicate to every live subscriber
+        (reference: OpenrCtrlHandler's kvStorePublishers_ / fibPublishers_
+        lists fed from the subscriber fibers †)."""
+        while True:
+            try:
+                item = await reader.get()
+            except QueueClosedError:
+                for q in subs:
+                    q.put_nowait(None)
+                return
+            payload = encode(item)
+            if payload is None:
+                continue
+            for q in list(subs):
+                try:
+                    q.put_nowait(payload)
+                except asyncio.QueueFull:
+                    # slow/stalled subscriber: evict rather than grow
+                    # without bound (reference: OpenrCtrlHandler drops
+                    # publishers whose stream backs up †)
+                    subs.discard(q)
+                    while not q.empty():
+                        q.get_nowait()
+                    q.put_nowait(None)  # ends that subscriber's stream
+                    if self.counters:
+                        self.counters.increment(f"{self.name}.subs_evicted")
+
+    @staticmethod
+    def _encode_pub(pub) -> dict | None:
+        if not isinstance(pub, Publication):
+            return None
+        return {
+            "area": pub.area,
+            "key_vals": {k: pub_to_json_value(v) for k, v in pub.key_vals.items()},
+            "expired_keys": list(pub.expired_keys),
+        }
+
+    @staticmethod
+    def _encode_fib(upd) -> dict | None:
+        return {
+            "type": int(upd.type),
+            "unicast_to_update": [
+                _unicast_json(e.to_unicast_route())
+                for e in upd.unicast_to_update.values()
+            ],
+            "unicast_to_delete": [str(p) for p in upd.unicast_to_delete],
+            "mpls_to_update": [
+                _mpls_json(e.to_mpls_route())
+                for e in upd.mpls_to_update.values()
+            ],
+            "mpls_to_delete": list(upd.mpls_to_delete),
+        }
+
+    # ------------------------------------------------------------ handlers
+
+    def _register_all(self) -> None:
+        s = self.server
+        for name in (
+            "get_my_node_name", "get_initialization_status", "get_counters",
+            "get_kvstore_keyvals", "set_kvstore_keyvals", "dump_kvstore",
+            "get_kvstore_areas", "get_kvstore_peers",
+            "get_route_db_computed", "get_route_db_programmed",
+            "get_decision_adjacency_dbs", "get_received_routes",
+            "get_interfaces", "set_node_overload", "set_interface_metric",
+            "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
+            "set_rib_policy", "get_rib_policy",
+        ):
+            s.register(name, getattr(self, name))
+        s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
+        s.register_stream("subscribe_fib", self.subscribe_fib)
+
+    # --- node / process -----------------------------------------------------
+
+    async def get_my_node_name(self, params: dict) -> str:
+        return self.node.name
+
+    async def get_initialization_status(self, params: dict) -> dict:
+        """reference: OpenrCtrl initialization-event query † — the
+        KVSTORE_SYNCED → RIB_COMPUTED → FIB_SYNCED gates."""
+        n = self.node
+        return {
+            "KVSTORE_SYNCED": n.kvstore.initial_sync_done.is_set(),
+            "RIB_COMPUTED": n.decision.rib_computed.is_set(),
+            "FIB_SYNCED": n.fib.synced.is_set(),
+            "INITIALIZED": n.initialized,
+        }
+
+    async def get_counters(self, params: dict) -> dict:
+        """reference: fb303 getCounters †."""
+        prefix = params.get("prefix") or ""
+        snap = self.node.counters.snapshot()
+        return {k: v for k, v in snap.items() if k.startswith(prefix)}
+
+    # --- kvstore ------------------------------------------------------------
+
+    def _area(self, params: dict) -> str:
+        return params.get("area") or self.node.config.area_ids()[0]
+
+    async def get_kvstore_keyvals(self, params: dict) -> dict:
+        area = self._area(params)
+        out = {}
+        for k in params.get("keys") or []:
+            v = self.node.kvstore.get_key(area, k)
+            if v is not None:
+                out[k] = pub_to_json_value(v)
+        return {"key_vals": out}
+
+    async def set_kvstore_keyvals(self, params: dict) -> dict:
+        area = self._area(params)
+        for k, raw in (params.get("key_vals") or {}).items():
+            self.node.kvstore.set_key(area, k, value_from_json(raw).with_hash())
+        return {"ok": True}
+
+    async def dump_kvstore(self, params: dict) -> dict:
+        area = self._area(params)
+        dump_params = KeyDumpParams(
+            prefix=params.get("prefix") or "",
+            originator_ids=tuple(params.get("originator_ids") or ()),
+        )
+        kv = self.node.kvstore.dump(area, dump_params)
+        return {"key_vals": {k: pub_to_json_value(v) for k, v in kv.items()}}
+
+    async def get_kvstore_areas(self, params: dict) -> dict:
+        """reference: getKvStoreAreaSummary † — per-area key/peer counts."""
+        out = {}
+        for area in self.node.config.areas:
+            kv = self.node.kvstore.dump(area.area_id)
+            peers = self.node.kvstore.get_peers(area.area_id)
+            out[area.area_id] = {
+                "num_keys": len(kv),
+                "peers": sorted(peers),
+            }
+        return out
+
+    async def get_kvstore_peers(self, params: dict) -> dict:
+        area = self._area(params)
+        return {"peers": sorted(self.node.kvstore.get_peers(area))}
+
+    async def subscribe_kvstore(self, params: dict, stream) -> None:
+        """reference: subscribeAndGetKvStoreFiltered † (thrift server-stream):
+        snapshot-then-deltas, with optional key-prefix filter."""
+        area = self._area(params)
+        prefix = params.get("prefix") or ""
+        # register BEFORE the snapshot: a publication arriving while the
+        # snapshot is in flight must land in the delta stream (overlap is
+        # harmless, a lost update is not)
+        q = self._add_sub(self._kv_subs)
+        try:
+            if params.get("snapshot", True):
+                kv = self.node.kvstore.dump(area, KeyDumpParams(prefix=prefix))
+                await stream.send({
+                    "area": area,
+                    "key_vals": {k: pub_to_json_value(v) for k, v in kv.items()},
+                    "expired_keys": [],
+                    "snapshot": True,
+                })
+            await self._drain_sub(q, stream,
+                                  lambda p: _filter_pub(p, area, prefix))
+        finally:
+            self._remove_sub(self._kv_subs, q)
+
+    # --- decision / fib -----------------------------------------------------
+
+    async def get_route_db_computed(self, params: dict) -> dict:
+        """reference: getRouteDbComputed † — the Decision RIB."""
+        db = self.node.decision.get_route_db()
+        return {
+            "node": self.node.name,
+            "unicast_routes": [
+                {
+                    **_unicast_json(e.to_unicast_route()),
+                    "igp_cost": e.igp_cost,
+                    "best_nodes": list(e.best_nodes),
+                }
+                for e in db.unicast_routes.values()
+            ],
+            "mpls_routes": [
+                _mpls_json(e.to_mpls_route())
+                for e in db.mpls_routes.values()
+            ],
+        }
+
+    async def get_route_db_programmed(self, params: dict) -> dict:
+        """reference: getRouteDb (programmed, from Fib) †."""
+        fib = self.node.fib
+        return {
+            "node": self.node.name,
+            "unicast_routes": [
+                _unicast_json(r) for r in fib.get_programmed_unicast()
+            ],
+            "mpls_routes": [
+                _mpls_json(r) for r in fib.get_programmed_mpls()
+            ],
+        }
+
+    async def get_decision_adjacency_dbs(self, params: dict) -> dict:
+        """reference: getDecisionAdjacenciesFiltered † — the LSDB view."""
+        out = {}
+        for area, dbs in self.node.decision.get_adj_dbs().items():
+            out[area] = [to_jsonable(db) for db in dbs]
+        return out
+
+    async def get_received_routes(self, params: dict) -> dict:
+        """reference: getReceivedRoutesFiltered † — prefix DB view."""
+        return to_jsonable(self.node.decision.get_received_routes())
+
+    async def subscribe_fib(self, params: dict, stream) -> None:
+        """reference: subscribeAndGetFib † — programmed-route stream."""
+        q = self._add_sub(self._fib_subs)
+        try:
+            await self._drain_sub(q, stream, lambda p: p)
+        finally:
+            self._remove_sub(self._fib_subs, q)
+
+    # --- link monitor -------------------------------------------------------
+
+    async def get_interfaces(self, params: dict) -> dict:
+        """reference: getInterfaces / dumpLinks †."""
+        lm = self.node.linkmonitor
+        return {
+            "node": self.node.name,
+            "is_overloaded": lm.node_overloaded,
+            "interfaces": lm.dump_interfaces(),
+        }
+
+    async def set_node_overload(self, params: dict) -> dict:
+        """reference: setNodeOverload / unsetNodeOverload †."""
+        self.node.linkmonitor.set_node_overload(bool(params.get("overload", True)))
+        return {"ok": True}
+
+    async def set_interface_metric(self, params: dict) -> dict:
+        """reference: setInterfaceMetric / unsetInterfaceMetric †."""
+        metric = params.get("metric")
+        self.node.linkmonitor.set_link_metric(
+            params["interface"], int(metric) if metric is not None else None
+        )
+        return {"ok": True}
+
+    # --- prefix manager -----------------------------------------------------
+
+    async def advertise_prefixes(self, params: dict) -> dict:
+        """reference: advertisePrefixes † (PrefixType API source)."""
+        from openr_tpu.prefixmgr.prefix_manager import (
+            PrefixEvent, PrefixEventType, PrefixSource,
+        )
+        entries = [
+            from_jsonable(raw, PrefixEntry) if isinstance(raw, dict)
+            else PrefixEntry(prefix=IpPrefix.make(raw))
+            for raw in params.get("prefixes") or []
+        ]
+        self.node.prefix_events.push(PrefixEvent(
+            type=PrefixEventType.ADD_PREFIXES,
+            source=PrefixSource.API,
+            entries=tuple(entries),
+        ))
+        return {"advertised": len(entries)}
+
+    async def withdraw_prefixes(self, params: dict) -> dict:
+        from openr_tpu.prefixmgr.prefix_manager import (
+            PrefixEvent, PrefixEventType, PrefixSource,
+        )
+        entries = tuple(
+            PrefixEntry(prefix=IpPrefix.make(raw))
+            for raw in params.get("prefixes") or []
+        )
+        self.node.prefix_events.push(PrefixEvent(
+            type=PrefixEventType.WITHDRAW_PREFIXES,
+            source=PrefixSource.API,
+            entries=entries,
+        ))
+        return {"withdrawn": len(entries)}
+
+    async def get_advertised_prefixes(self, params: dict) -> dict:
+        """reference: getAdvertisedRoutesFiltered †."""
+        adv = self.node.prefixmgr.get_advertised()
+        return {str(p): to_jsonable(e) for p, e in adv.items()}
+
+    # --- rib policy ---------------------------------------------------------
+
+    async def set_rib_policy(self, params: dict) -> dict:
+        """reference: setRibPolicy † (policy with TTL, Decision-side)."""
+        from openr_tpu.policy import RibPolicy
+        policy = from_jsonable(params["policy"], RibPolicy)
+        self.node.decision.set_rib_policy(policy)
+        return {"ok": True}
+
+    async def get_rib_policy(self, params: dict) -> dict:
+        pol = self.node.decision.get_rib_policy()
+        return {"policy": to_jsonable(pol) if pol is not None else None}
+
+    # ------------------------------------------------------------ plumbing
+
+    SUB_QUEUE_MAX = 4096  # per-subscriber buffer before eviction
+
+    def _add_sub(self, subs: set[asyncio.Queue]) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.SUB_QUEUE_MAX)
+        subs.add(q)
+        if self.counters:
+            self.counters.increment(f"{self.name}.subscribers")
+        return q
+
+    def _remove_sub(self, subs: set[asyncio.Queue], q: asyncio.Queue) -> None:
+        subs.discard(q)
+        if self.counters:
+            self.counters.increment(f"{self.name}.subscribers", -1)
+
+    async def _drain_sub(self, q: asyncio.Queue, stream, xform) -> None:
+        """Forward one subscriber's queue to its RPC stream until the
+        stream disconnects or the fan-out ends/evicts it (None)."""
+        while True:
+            item = await q.get()
+            if item is None:
+                return
+            out = xform(item)
+            if out is not None:
+                await stream.send(out)  # raises RpcError on disconnect
+
+
+def _unicast_json(r) -> dict:
+    """Operator-facing route encoding: prefixes flattened to strings."""
+    return {
+        "dest": str(r.dest),
+        "nexthops": [to_jsonable(nh) for nh in r.nexthops],
+    }
+
+
+def _mpls_json(r) -> dict:
+    return {
+        "top_label": r.top_label,
+        "nexthops": [to_jsonable(nh) for nh in r.nexthops],
+    }
+
+
+def _filter_pub(payload: dict, area: str, prefix: str) -> dict | None:
+    """Apply the subscriber's area + key-prefix filter to an encoded
+    publication (reference: KvStoreFilters on the subscribe path †)."""
+    if payload.get("area") != area:
+        return None
+    if not prefix:
+        return payload
+    kv = {k: v for k, v in payload["key_vals"].items() if k.startswith(prefix)}
+    exp = [k for k in payload["expired_keys"] if k.startswith(prefix)]
+    if not kv and not exp:
+        return None
+    return {"area": area, "key_vals": kv, "expired_keys": exp}

@@ -211,13 +211,17 @@ def ksp2_route(
         and len(nhs) < best_entry.min_nexthop
     ):
         return None  # reference: drop route below min_nexthop †
+    # cost of the cheapest path that actually produced a nexthop — path 1
+    # may have been dropped (unlabeled interior hop / no usable adjacency),
+    # and cross-area merge tie-breaks on igp_cost, so advertising the
+    # rejected path's cost would beat genuinely cheaper routes
     return RibEntry(
         prefix=prefix,
         nexthops=nhs,
         best_node=dest,
         best_nodes=tuple(best_nodes),
         best_entry=best_entry,
-        igp_cost=paths[0][0],
+        igp_cost=min(nh.metric for nh in nhs),
     )
 
 

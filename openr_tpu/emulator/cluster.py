@@ -72,6 +72,7 @@ class Cluster:
         link_specs: list[LinkSpec],
         solver: str = "cpu",
         debounce_ms: tuple[int, int] = (10, 60),
+        enable_ctrl: bool = False,
     ) -> "Cluster":
         c = Cluster(solver=solver)
         for spec in node_specs:
@@ -102,6 +103,7 @@ class Cluster:
                 c.hub.io_for(spec.name),
                 c.transport,
                 solver=solver,
+                enable_ctrl=enable_ctrl,
             )
             c.transport.register(spec.name, node.kvstore)
             c.nodes[spec.name] = node
@@ -113,6 +115,7 @@ class Cluster:
     def from_edges(
         edges: list[tuple[str, str]] | list[LinkSpec],
         solver: str = "cpu",
+        enable_ctrl: bool = False,
     ) -> "Cluster":
         links = [
             e if isinstance(e, LinkSpec) else LinkSpec(a=e[0], b=e[1])
@@ -123,7 +126,7 @@ class Cluster:
             ClusterNodeSpec(name=n, loopback=loopback_of(i))
             for i, n in enumerate(names)
         ]
-        return Cluster.build(specs, links, solver=solver)
+        return Cluster.build(specs, links, solver=solver, enable_ctrl=enable_ctrl)
 
     # ------------------------------------------------------------ lifecycle
 

@@ -365,6 +365,10 @@ class KvStore(OpenrModule):
         )
         return key in accepted
 
+    def get_peers(self, area: str) -> list[str]:
+        """Peer node names in one area (reference: getKvStorePeersArea †)."""
+        return [node for (a, node) in self.peers if a == area]
+
     def get_key(self, area: str, key: str) -> Value | None:
         db = self.dbs.get(area)
         return db.kv.get(key) if db else None

@@ -274,6 +274,27 @@ class LinkMonitor(OpenrModule):
 
     # ------------------------------------------------------------- operator
 
+    def dump_interfaces(self) -> list[dict]:
+        """Interface + adjacency view (reference: OpenrCtrl dumpLinks † /
+        `breeze lm links`)."""
+        out = []
+        for name, info in sorted(self.interfaces.items()):
+            adjs = [
+                {"neighbor": node, "area": a, "remote_if": nb.remote_if,
+                 "metric": self._metric_for(nb), "rtt_us": nb.rtt_us}
+                for (a, node, local_if), (nb, _label) in sorted(
+                    self.adjacencies.items()
+                )
+                if local_if == name
+            ]
+            out.append({
+                "name": name,
+                "is_up": info.is_up,
+                "metric_override": self._metric_override.get(name),
+                "adjacencies": adjs,
+            })
+        return out
+
     def set_node_overload(self, overloaded: bool) -> None:
         """reference: OpenrCtrl setNodeOverload → LinkMonitor †."""
         if self.node_overloaded != overloaded:

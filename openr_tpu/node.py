@@ -44,6 +44,8 @@ class OpenrNode:
         solver: str | None = None,
         kvstore_port: int = 0,
         endpoint_host: str = "127.0.0.1",
+        enable_ctrl: bool = False,
+        ctrl_port: int = 0,
     ):
         self.config = config
         self.name = config.node_name
@@ -104,11 +106,28 @@ class OpenrNode:
             interface_events_reader=self.interface_events.get_reader(),
             counters=self.counters,
         )
+        origination_policy = None
+        if (
+            config.node.prefix_policy_statements
+            or not config.node.prefix_policy_default_accept
+        ):
+            from dataclasses import asdict
+
+            from openr_tpu.policy import PolicyManager, PolicyStatement
+
+            origination_policy = PolicyManager(
+                statements=tuple(
+                    PolicyStatement(**asdict(s))
+                    for s in config.node.prefix_policy_statements
+                ),
+                default_accept=config.node.prefix_policy_default_accept,
+            )
         self.prefixmgr = PrefixManager(
             config,
             self.kv_client,
             prefix_events_reader=self.prefix_events.get_reader(),
             fib_updates_reader=self.fib_updates.get_reader(),
+            policy=origination_policy,
             counters=self.counters,
         )
         self.prefix_allocator = None
@@ -120,6 +139,14 @@ class OpenrNode:
                 self.prefix_events,
                 counters=self.counters,
             )
+
+        self.ctrl = None
+        if enable_ctrl:
+            # constructed before start so its queue readers see every message
+            # (reference: OpenrCtrlHandler takes queue readers in Main.cpp †)
+            from openr_tpu.ctrl import CtrlServer
+
+            self.ctrl = CtrlServer(self, host=endpoint_host, port=ctrl_port)
 
         # startup order mirrors Main.cpp † (store first, discovery last);
         # shutdown is the reverse
@@ -134,6 +161,8 @@ class OpenrNode:
         ]
         if self.prefix_allocator is not None:
             self._modules.append(self.prefix_allocator)
+        if self.ctrl is not None:
+            self._modules.append(self.ctrl)
         self._started = False
 
     # ------------------------------------------------------------ lifecycle

@@ -130,11 +130,13 @@ class RibPolicy:
 
     statements: tuple[RibPolicyStatement, ...] = ()
     ttl_secs: float = 300.0
-    _expires_at: float = field(default=0.0, compare=False)
 
     def __post_init__(self):
-        if self._expires_at == 0.0:
-            self._expires_at = time.monotonic() + self.ttl_secs
+        # NOT a dataclass field: the deadline is process-local monotonic
+        # time and must never travel over the wire — a deserialized policy
+        # re-stamps its TTL from receipt (reference: setRibPolicy installs
+        # with ttl_secs counted from the install †)
+        self._expires_at = time.monotonic() + self.ttl_secs
 
     @property
     def expired(self) -> bool:

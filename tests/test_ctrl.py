@@ -1,0 +1,246 @@
+"""CtrlServer tests (reference analogue: openr/ctrl-server/tests/
+OpenrCtrlHandlerTest † — queries + mutations + streaming subscription
+against a live module graph)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.emulator import Cluster
+from openr_tpu.rpc import RpcClient
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def _client_for(node) -> RpcClient:
+    cli = RpcClient(port=node.ctrl.port)
+    await cli.connect()
+    return cli
+
+
+async def _converged_cluster():
+    c = Cluster.from_edges([("a", "b"), ("b", "c")], enable_ctrl=True)
+    await c.start()
+    await c.wait_converged(timeout=20.0)
+    return c
+
+
+def test_queries_roundtrip():
+    """Node name, init status, counters, route DBs, adj dump, interfaces."""
+
+    async def body():
+        c = await _converged_cluster()
+        cli = await _client_for(c.nodes["a"])
+
+        assert await cli.call("get_my_node_name") == "a"
+
+        st = await cli.call("get_initialization_status")
+        assert st["INITIALIZED"] and st["KVSTORE_SYNCED"]
+
+        counters = await cli.call("get_counters", {"prefix": "decision."})
+        assert counters and all(k.startswith("decision.") for k in counters)
+
+        rdb = await cli.call("get_route_db_computed")
+        dests = {r["dest"] for r in rdb["unicast_routes"]}
+        assert "10.0.1.1/32" in dests and "10.0.2.1/32" in dests
+
+        prog = await cli.call("get_route_db_programmed")
+        assert {r["dest"] for r in prog["unicast_routes"]} == dests
+
+        adj = await cli.call("get_decision_adjacency_dbs")
+        area = next(iter(adj))
+        assert {db["this_node_name"] for db in adj[area]} == {"a", "b", "c"}
+
+        ifaces = await cli.call("get_interfaces")
+        assert not ifaces["is_overloaded"]
+        assert any(i["adjacencies"] for i in ifaces["interfaces"])
+
+        peers = await cli.call("get_kvstore_peers")
+        assert peers["peers"] == ["b"]
+
+        await cli.close()
+        await c.stop()
+
+    run(body())
+
+
+def test_kvstore_ops_and_overload():
+    """KvStore get/set/dump via RPC; node overload flows to neighbors'
+    route computation (overloaded node carries no transit traffic)."""
+
+    async def body():
+        c = await _converged_cluster()
+        cli_b = await _client_for(c.nodes["b"])
+
+        dump = await cli_b.call("dump_kvstore", {"prefix": "adj:"})
+        assert len(dump["key_vals"]) == 3  # one adj db per node
+
+        got = await cli_b.call(
+            "get_kvstore_keyvals", {"keys": ["adj:a", "nope"]}
+        )
+        assert set(got["key_vals"]) == {"adj:a"}
+
+        # set b overloaded → a loses its route to c (b was the only transit)
+        await cli_b.call("set_node_overload", {"overload": True})
+        na = c.nodes["a"]
+        for _ in range(100):
+            dests = {str(r.dest) for r in na.get_programmed_routes()}
+            if "10.0.2.1/32" not in dests:
+                break
+            await asyncio.sleep(0.1)
+        assert "10.0.2.1/32" not in dests
+        # b's loopback itself stays reachable
+        assert "10.0.1.1/32" in dests
+
+        await cli_b.call("set_node_overload", {"overload": False})
+        for _ in range(100):
+            dests = {str(r.dest) for r in na.get_programmed_routes()}
+            if "10.0.2.1/32" in dests:
+                break
+            await asyncio.sleep(0.1)
+        assert "10.0.2.1/32" in dests
+
+        await cli_b.close()
+        await c.stop()
+
+    run(body())
+
+
+def test_advertise_withdraw_prefixes():
+    """advertisePrefixes via ctrl API propagates network-wide; withdraw
+    removes it (reference: OpenrCtrl advertisePrefixes → PrefixManager †)."""
+
+    async def body():
+        c = await _converged_cluster()
+        cli = await _client_for(c.nodes["c"])
+
+        await cli.call("advertise_prefixes", {"prefixes": ["192.168.7.0/24"]})
+        na = c.nodes["a"]
+        for _ in range(100):
+            dests = {str(r.dest) for r in na.get_programmed_routes()}
+            if "192.168.7.0/24" in dests:
+                break
+            await asyncio.sleep(0.1)
+        assert "192.168.7.0/24" in dests
+
+        adv = await cli.call("get_advertised_prefixes")
+        assert "192.168.7.0/24" in adv
+
+        await cli.call("withdraw_prefixes", {"prefixes": ["192.168.7.0/24"]})
+        for _ in range(100):
+            dests = {str(r.dest) for r in na.get_programmed_routes()}
+            if "192.168.7.0/24" not in dests:
+                break
+            await asyncio.sleep(0.1)
+        assert "192.168.7.0/24" not in dests
+
+        await cli.close()
+        await c.stop()
+
+    run(body())
+
+
+def test_subscribe_kvstore_snapshot_then_deltas():
+    """subscribe_kvstore yields the snapshot, then a delta when a key
+    changes (reference: subscribeAndGetKvStoreFiltered †)."""
+
+    async def body():
+        c = await _converged_cluster()
+        cli = await _client_for(c.nodes["a"])
+
+        stream = await cli.subscribe(
+            "subscribe_kvstore", {"prefix": "prefix:", "snapshot": True}
+        )
+        first = await asyncio.wait_for(anext(stream), timeout=5.0)
+        assert first.get("snapshot") and first["key_vals"]
+
+        # trigger a delta: c advertises a fresh prefix
+        cli_c = await _client_for(c.nodes["c"])
+        await cli_c.call("advertise_prefixes", {"prefixes": ["172.16.0.0/16"]})
+
+        async def until_delta():
+            async for item in stream:
+                for k in item["key_vals"]:
+                    if k.startswith("prefix:c"):
+                        return k
+            raise AssertionError("stream ended without delta")
+
+        key = await asyncio.wait_for(until_delta(), timeout=10.0)
+        assert key.startswith("prefix:c")
+
+        await cli_c.close()
+        await cli.close()
+        await c.stop()
+
+    run(body())
+
+
+def test_subscribe_fib_stream():
+    """subscribe_fib streams programmed-route updates as they happen."""
+
+    async def body():
+        c = await _converged_cluster()
+        cli = await _client_for(c.nodes["a"])
+        stream = await cli.subscribe("subscribe_fib")
+
+        cli_c = await _client_for(c.nodes["c"])
+        await cli_c.call("advertise_prefixes", {"prefixes": ["172.20.0.0/16"]})
+
+        async def until_programmed():
+            async for item in stream:
+                for r in item["unicast_to_update"]:
+                    if r["dest"] == "172.20.0.0/16":
+                        return True
+            return False
+
+        assert await asyncio.wait_for(until_programmed(), timeout=10.0)
+
+        await cli_c.close()
+        await cli.close()
+        await c.stop()
+
+    run(body())
+
+
+def test_set_interface_metric_changes_path():
+    """Raising a's a—b link metric steers a→c's loopback... in a line
+    there's no alt path, so instead verify the metric shows in the adj DB
+    and the route cost rises (reference: setInterfaceMetric †)."""
+
+    async def body():
+        c = await _converged_cluster()
+        na = c.nodes["a"]
+        cli = await _client_for(na)
+
+        ifaces = await cli.call("get_interfaces")
+        if_name = next(
+            i["name"] for i in ifaces["interfaces"] if i["adjacencies"]
+        )
+        await cli.call(
+            "set_interface_metric", {"interface": if_name, "metric": 50}
+        )
+
+        from openr_tpu.types.network import IpPrefix
+
+        target = IpPrefix.make("10.0.2.1/32")
+        for _ in range(100):
+            e = na.get_route_db().unicast_routes.get(target)
+            if e is not None and e.igp_cost == 51:
+                break
+            await asyncio.sleep(0.1)
+        assert e.igp_cost == 51  # 50 (a→b) + 1 (b→c)
+
+        await cli.call("set_interface_metric", {"interface": if_name, "metric": None})
+        for _ in range(100):
+            e = na.get_route_db().unicast_routes.get(target)
+            if e is not None and e.igp_cost == 2:
+                break
+            await asyncio.sleep(0.1)
+        assert e.igp_cost == 2
+
+        await cli.close()
+        await c.stop()
+
+    run(body())

@@ -148,3 +148,85 @@ def test_rib_policy_nonmatching_untouched():
     assert pol.apply(rdb) == 0
     p = IpPrefix.make("10.9.0.0/16")
     assert all(nh.weight == 0 for nh in rdb.unicast_routes[p].nexthops)
+
+
+# ------------------------------------------------- wiring & serialization
+
+
+def test_rib_policy_ttl_restamps_on_deserialize():
+    """_expires_at is process-local and must not travel over the wire: a
+    deserialized policy restarts its TTL from receipt."""
+    from openr_tpu.types.serde import from_jsonable, to_jsonable
+
+    pol = RibPolicy(statements=(), ttl_secs=300.0)
+    raw = to_jsonable(pol)
+    assert "_expires_at" not in raw
+    # simulate a receiver whose monotonic clock is "behind" the sender
+    got = from_jsonable(raw, RibPolicy)
+    assert not got.expired
+    assert got._expires_at - time.monotonic() > 299.0
+
+
+def test_origination_policy_wired_through_config():
+    """prefix_policy_statements in NodeConfig reaches PrefixManager: a
+    denied API prefix is not advertised (reference: origination policy
+    at the PrefixManager seam †)."""
+    import asyncio
+
+    from openr_tpu.config import Config
+    from openr_tpu.config.config import NodeConfig, PolicyStatementConfig
+    from openr_tpu.emulator import Cluster, ClusterNodeSpec, LinkSpec
+
+    async def body():
+        deny_private = PolicyStatementConfig(
+            name="deny-private",
+            match_prefixes=("192.168.0.0/16",),
+            action_accept=False,
+        )
+        from openr_tpu.emulator.cluster import FAST_SPARK
+
+        from openr_tpu.config.config import OriginatedPrefix
+
+        specs = [
+            ClusterNodeSpec(
+                name="a",
+                config=NodeConfig(
+                    node_name="a",
+                    spark=FAST_SPARK,
+                    originated_prefixes=(
+                        OriginatedPrefix(prefix="10.0.0.1/32"),
+                    ),
+                    prefix_policy_statements=(deny_private,),
+                ),
+            ),
+            ClusterNodeSpec(name="b", loopback="10.0.1.1/32"),
+        ]
+        c = Cluster.build(specs, [LinkSpec(a="a", b="b")])
+        await c.start()
+        await c.wait_converged(timeout=20.0)
+        na = c.nodes["a"]
+
+        from openr_tpu.prefixmgr.prefix_manager import (
+            PrefixEvent, PrefixEventType, PrefixSource,
+        )
+
+        na.prefix_events.push(PrefixEvent(
+            type=PrefixEventType.ADD_PREFIXES,
+            source=PrefixSource.API,
+            entries=(
+                entry("192.168.5.0/24"),   # denied by policy
+                entry("172.16.0.0/16"),    # accepted (default)
+            ),
+        ))
+        nb = c.nodes["b"]
+        for _ in range(100):
+            dests = {str(r.dest) for r in nb.get_programmed_routes()}
+            if "172.16.0.0/16" in dests:
+                break
+            await asyncio.sleep(0.1)
+        assert "172.16.0.0/16" in dests
+        assert "192.168.5.0/24" not in dests
+        assert na.counters.get("prefixmgr.policy_denied") == 1
+        await c.stop()
+
+    asyncio.new_event_loop().run_until_complete(body())
