@@ -1,0 +1,12 @@
+"""breeze — the operator CLI (reference: openr/py/openr/cli/ †).
+
+The reference ships a python-click CLI ("breeze") that speaks
+OpenrCtrl thrift to a running node: `breeze kvstore keys`, `breeze
+decision routes`, `breeze lm links`, `breeze fib routes`, … We ship the
+same command tree over the ctrl RPC (openr_tpu/ctrl/). Run it as
+`python -m openr_tpu.cli --port <ctrl-port> <module> <command>`.
+"""
+
+from openr_tpu.cli.breeze import cli
+
+__all__ = ["cli"]

@@ -1,0 +1,6 @@
+"""`python -m openr_tpu.cli` — the breeze entry point."""
+
+from openr_tpu.cli import cli
+
+if __name__ == "__main__":
+    cli()

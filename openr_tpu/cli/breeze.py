@@ -1,0 +1,423 @@
+"""The breeze command tree (reference: openr/py/openr/cli/commands/ †).
+
+Each command opens one RPC connection to the node's ctrl server, makes
+the query, pretty-prints, and exits — the same stateless model as the
+reference's thrift-per-invocation CLI. Output is plain text tables
+(reference: breeze's printing.py table helpers †).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import click
+
+from openr_tpu.common.constants import (
+    ADJ_DB_MARKER,
+    CTRL_PORT,
+    PREFIX_DB_MARKER,
+    parse_adj_key,
+)
+from openr_tpu.rpc import RpcClient, RpcError
+from openr_tpu.types.serde import from_wire
+from openr_tpu.types.topology import AdjacencyDatabase, PrefixDatabase
+
+
+# ------------------------------------------------------------------ plumbing
+
+
+def _run(ctx: click.Context, method: str, params: dict | None = None):
+    """One connect → call → close round trip."""
+    host = ctx.obj["host"]
+    port = ctx.obj["port"]
+
+    async def go():
+        cli_ = RpcClient(host=host, port=port)
+        await cli_.connect(timeout=ctx.obj["timeout"])
+        try:
+            return await cli_.call(method, params or {}, timeout=ctx.obj["timeout"])
+        finally:
+            await cli_.close()
+
+    try:
+        return asyncio.new_event_loop().run_until_complete(go())
+    except (ConnectionError, OSError) as e:
+        raise click.ClickException(
+            f"cannot reach ctrl server at {host}:{port}: {e}"
+        ) from e
+    except RpcError as e:
+        raise click.ClickException(f"rpc {method} failed: {e}") from e
+
+
+def _value_bytes(raw_value: dict) -> bytes | None:
+    v = raw_value.get("value")
+    if isinstance(v, dict) and "__bytes__" in v:
+        return bytes.fromhex(v["__bytes__"])
+    return None
+
+
+def _table(rows: list[list], headers: list[str]) -> str:
+    widths = [
+        max(len(str(headers[i])), *(len(str(r[i])) for r in rows)) if rows
+        else len(str(headers[i]))
+        for i in range(len(headers))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def _nh_str(nh: dict) -> str:
+    s = f"{nh.get('neighbor_node') or nh.get('address')}%{nh.get('if_name')}"
+    if nh.get("weight"):
+        s += f" w={nh['weight']}"
+    act = nh.get("mpls_action")
+    if act:
+        labels = act.get("push_labels") or []
+        kind = {0: "PUSH", 1: "SWAP", 2: "PHP", 3: "POP"}.get(
+            act.get("action"), "?"
+        )
+        s += f" mpls {kind}{labels if labels else ''}"
+    return s
+
+
+# ---------------------------------------------------------------------- root
+
+
+@click.group()
+@click.option("--host", default="127.0.0.1", show_default=True,
+              help="ctrl server host")
+@click.option("--port", default=CTRL_PORT, show_default=True, type=int,
+              help="ctrl server port")
+@click.option("--timeout", default=10.0, show_default=True, type=float)
+@click.pass_context
+def cli(ctx, host, port, timeout):
+    """breeze — query and control a running openr_tpu node."""
+    ctx.ensure_object(dict)
+    ctx.obj.update(host=host, port=port, timeout=timeout)
+
+
+@cli.command()
+@click.pass_context
+def status(ctx):
+    """Node name + initialization gates (KVSTORE_SYNCED → RIB_COMPUTED →
+    FIB_SYNCED)."""
+    name = _run(ctx, "get_my_node_name")
+    st = _run(ctx, "get_initialization_status")
+    click.echo(f"node: {name}")
+    for gate in ("KVSTORE_SYNCED", "RIB_COMPUTED", "FIB_SYNCED", "INITIALIZED"):
+        click.echo(f"  {gate}: {'pass' if st.get(gate) else 'PENDING'}")
+
+
+# ------------------------------------------------------------------- kvstore
+
+
+@cli.group()
+def kvstore():
+    """KvStore inspection (reference: breeze kvstore †)."""
+
+
+@kvstore.command("keys")
+@click.option("--prefix", default="", help="key prefix filter")
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_keys(ctx, prefix, area):
+    """List keys with version/originator/ttl."""
+    res = _run(ctx, "dump_kvstore", {"prefix": prefix, "area": area})
+    rows = []
+    for k, v in sorted(res["key_vals"].items()):
+        ttl = v.get("ttl")
+        rows.append([k, v.get("version"), v.get("originator_id"),
+                     "inf" if ttl == -1 else ttl])
+    click.echo(_table(rows, ["key", "version", "originator", "ttl_ms"]))
+
+
+@kvstore.command("keyvals")
+@click.argument("keys", nargs=-1, required=True)
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_keyvals(ctx, keys, area):
+    """Dump raw values for specific keys (decoded when the key is a known
+    LSDB object)."""
+    res = _run(ctx, "get_kvstore_keyvals", {"keys": list(keys), "area": area})
+    for k, v in sorted(res["key_vals"].items()):
+        click.echo(f"> {k} (v{v.get('version')}, {v.get('originator_id')})")
+        blob = _value_bytes(v)
+        if blob is None:
+            click.echo("  <no value>")
+            continue
+        try:
+            if k.startswith(ADJ_DB_MARKER):
+                click.echo(json.dumps(
+                    _jsonable_wire(blob, AdjacencyDatabase), indent=2))
+            elif k.startswith(PREFIX_DB_MARKER):
+                click.echo(json.dumps(
+                    _jsonable_wire(blob, PrefixDatabase), indent=2))
+            else:
+                click.echo(f"  {blob!r}")
+        except Exception:  # noqa: BLE001 — fall back to raw bytes
+            click.echo(f"  {blob!r}")
+
+
+def _jsonable_wire(blob: bytes, cls):
+    from openr_tpu.types.serde import to_jsonable
+
+    return to_jsonable(from_wire(blob, cls))
+
+
+@kvstore.command("adj")
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_adj(ctx, area):
+    """Adjacency databases as advertised in the KvStore."""
+    res = _run(ctx, "dump_kvstore", {"prefix": ADJ_DB_MARKER, "area": area})
+    rows = []
+    for k, v in sorted(res["key_vals"].items()):
+        node = parse_adj_key(k)
+        blob = _value_bytes(v)
+        if node is None or blob is None:
+            continue
+        db = from_wire(blob, AdjacencyDatabase)
+        for adj in db.adjacencies:
+            rows.append([
+                node, adj.other_node_name, adj.if_name, adj.other_if_name,
+                adj.metric, "overloaded" if db.is_overloaded else "",
+            ])
+    click.echo(_table(
+        rows, ["node", "neighbor", "local-if", "remote-if", "metric", "flags"]
+    ))
+
+
+@kvstore.command("prefixes")
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_prefixes(ctx, area):
+    """Prefix databases as advertised in the KvStore."""
+    res = _run(ctx, "dump_kvstore", {"prefix": PREFIX_DB_MARKER, "area": area})
+    rows = []
+    for k, v in sorted(res["key_vals"].items()):
+        blob = _value_bytes(v)
+        if blob is None:
+            continue
+        db = from_wire(blob, PrefixDatabase)
+        for e in db.prefix_entries:
+            rows.append([db.this_node_name, str(e.prefix),
+                         e.forwarding_type.name, e.forwarding_algorithm.name])
+    click.echo(_table(rows, ["node", "prefix", "fwd-type", "fwd-algo"]))
+
+
+@kvstore.command("peers")
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_peers(ctx, area):
+    """Flooding peers per area."""
+    res = _run(ctx, "get_kvstore_peers", {"area": area})
+    for p in res["peers"]:
+        click.echo(p)
+
+
+@kvstore.command("areas")
+@click.pass_context
+def kvstore_areas(ctx):
+    """Per-area key/peer summary (reference: getKvStoreAreaSummary †)."""
+    res = _run(ctx, "get_kvstore_areas")
+    rows = [
+        [a, info["num_keys"], ",".join(info["peers"]) or "-"]
+        for a, info in sorted(res.items())
+    ]
+    click.echo(_table(rows, ["area", "keys", "peers"]))
+
+
+# ------------------------------------------------------------------ decision
+
+
+@cli.group()
+def decision():
+    """Computed-RIB queries (reference: breeze decision †)."""
+
+
+@decision.command("routes")
+@click.pass_context
+def decision_routes(ctx):
+    """Routes computed by Decision (pre-FIB)."""
+    res = _run(ctx, "get_route_db_computed")
+    rows = [
+        [r["dest"], r.get("igp_cost", ""),
+         " ".join(_nh_str(nh) for nh in r["nexthops"])]
+        for r in sorted(res["unicast_routes"], key=lambda r: r["dest"])
+    ]
+    click.echo(_table(rows, ["prefix", "cost", "nexthops"]))
+    if res["mpls_routes"]:
+        click.echo("")
+        rows = [
+            [r["top_label"], " ".join(_nh_str(nh) for nh in r["nexthops"])]
+            for r in sorted(res["mpls_routes"], key=lambda r: r["top_label"])
+        ]
+        click.echo(_table(rows, ["label", "nexthops"]))
+
+
+@decision.command("adj")
+@click.pass_context
+def decision_adj(ctx):
+    """Decision's LSDB adjacency view."""
+    res = _run(ctx, "get_decision_adjacency_dbs")
+    rows = []
+    for area, dbs in sorted(res.items()):
+        for db in dbs:
+            for adj in db["adjacencies"]:
+                rows.append([area, db["this_node_name"],
+                             adj["other_node_name"], adj["metric"]])
+    click.echo(_table(rows, ["area", "node", "neighbor", "metric"]))
+
+
+@decision.command("received-routes")
+@click.pass_context
+def decision_received(ctx):
+    """Per-prefix advertising nodes (PrefixState view)."""
+    res = _run(ctx, "get_received_routes")
+    rows = []
+    for area, prefixes in sorted(res.items()):
+        for pfx, nodes in sorted(prefixes.items()):
+            rows.append([area, pfx, ",".join(nodes)])
+    click.echo(_table(rows, ["area", "prefix", "advertised-by"]))
+
+
+# ----------------------------------------------------------------------- fib
+
+
+@cli.group()
+def fib():
+    """Programmed-route queries (reference: breeze fib †)."""
+
+
+@fib.command("routes")
+@click.pass_context
+def fib_routes(ctx):
+    """Routes programmed into the dataplane."""
+    res = _run(ctx, "get_route_db_programmed")
+    rows = [
+        [r["dest"], " ".join(_nh_str(nh) for nh in r["nexthops"])]
+        for r in sorted(res["unicast_routes"], key=lambda r: r["dest"])
+    ]
+    click.echo(_table(rows, ["prefix", "nexthops"]))
+
+
+@fib.command("counters")
+@click.pass_context
+def fib_counters(ctx):
+    res = _run(ctx, "get_counters", {"prefix": "fib."})
+    for k, v in sorted(res.items()):
+        click.echo(f"{k}: {v:g}")
+
+
+# ------------------------------------------------------------------------ lm
+
+
+@cli.group()
+def lm():
+    """LinkMonitor state + overload / metric control (reference: breeze lm †)."""
+
+
+@lm.command("links")
+@click.pass_context
+def lm_links(ctx):
+    res = _run(ctx, "get_interfaces")
+    click.echo(
+        f"node {res['node']}"
+        + (" [OVERLOADED]" if res["is_overloaded"] else "")
+    )
+    rows = []
+    for i in res["interfaces"]:
+        nbrs = ",".join(a["neighbor"] for a in i["adjacencies"]) or "-"
+        rows.append([
+            i["name"], "up" if i["is_up"] else "DOWN",
+            i["metric_override"] if i["metric_override"] is not None else "",
+            nbrs,
+        ])
+    click.echo(_table(rows, ["interface", "state", "metric-ovr", "neighbors"]))
+
+
+@lm.command("set-node-overload")
+@click.pass_context
+def lm_set_overload(ctx):
+    _run(ctx, "set_node_overload", {"overload": True})
+    click.echo("node overload SET")
+
+
+@lm.command("unset-node-overload")
+@click.pass_context
+def lm_unset_overload(ctx):
+    _run(ctx, "set_node_overload", {"overload": False})
+    click.echo("node overload UNSET")
+
+
+@lm.command("set-link-metric")
+@click.argument("interface")
+@click.argument("metric", type=int)
+@click.pass_context
+def lm_set_link_metric(ctx, interface, metric):
+    _run(ctx, "set_interface_metric", {"interface": interface, "metric": metric})
+    click.echo(f"metric override {metric} set on {interface}")
+
+
+@lm.command("unset-link-metric")
+@click.argument("interface")
+@click.pass_context
+def lm_unset_link_metric(ctx, interface):
+    _run(ctx, "set_interface_metric", {"interface": interface, "metric": None})
+    click.echo(f"metric override cleared on {interface}")
+
+
+# ------------------------------------------------------------------ prefixmgr
+
+
+@cli.group()
+def prefixmgr():
+    """Prefix origination (reference: breeze prefixmgr †)."""
+
+
+@prefixmgr.command("view")
+@click.pass_context
+def prefixmgr_view(ctx):
+    res = _run(ctx, "get_advertised_prefixes")
+    rows = [
+        [pfx, e["forwarding_type"], e["forwarding_algorithm"],
+         ",".join(e.get("tags") or [])]
+        for pfx, e in sorted(res.items())
+    ]
+    click.echo(_table(rows, ["prefix", "fwd-type", "fwd-algo", "tags"]))
+
+
+@prefixmgr.command("advertise")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.pass_context
+def prefixmgr_advertise(ctx, prefixes):
+    res = _run(ctx, "advertise_prefixes", {"prefixes": list(prefixes)})
+    click.echo(f"advertised {res['advertised']} prefix(es)")
+
+
+@prefixmgr.command("withdraw")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.pass_context
+def prefixmgr_withdraw(ctx, prefixes):
+    res = _run(ctx, "withdraw_prefixes", {"prefixes": list(prefixes)})
+    click.echo(f"withdrew {res['withdrawn']} prefix(es)")
+
+
+# -------------------------------------------------------------------- monitor
+
+
+@cli.group()
+def monitor():
+    """Counters / telemetry (reference: breeze monitor †)."""
+
+
+@monitor.command("counters")
+@click.option("--prefix", default="", help="counter name prefix filter")
+@click.pass_context
+def monitor_counters(ctx, prefix):
+    res = _run(ctx, "get_counters", {"prefix": prefix})
+    for k, v in sorted(res.items()):
+        click.echo(f"{k}: {v:g}")

@@ -20,7 +20,6 @@ from openr_tpu.messaging import QueueClosedError
 from openr_tpu.rpc import RpcServer
 from openr_tpu.types.kvstore import KeyDumpParams, Publication
 from openr_tpu.types.network import IpPrefix
-from openr_tpu.types.routes import RouteUpdateType
 from openr_tpu.types.serde import from_jsonable, to_jsonable
 from openr_tpu.types.topology import PrefixEntry
 
@@ -69,6 +68,8 @@ class CtrlServer(OpenrModule):
                 for q in subs:
                     q.put_nowait(None)
                 return
+            if not subs:  # nobody listening — skip the encode work
+                continue
             payload = encode(item)
             if payload is None:
                 continue

@@ -54,12 +54,21 @@ async def main() -> None:
         "--churn", type=int, default=0,
         help="after convergence, fail/heal this many links and re-measure",
     )
+    ap.add_argument(
+        "--ctrl", action="store_true",
+        help="start a ctrl server per node and print its port "
+        "(drive with `python -m openr_tpu.cli --port <port> ...`)",
+    )
+    ap.add_argument(
+        "--hold", type=float, default=0.0,
+        help="keep the cluster running this many seconds after convergence",
+    )
     args = ap.parse_args()
 
     from openr_tpu.emulator import Cluster
 
     edges = topo_edges(args.topo, args.nodes)
-    cluster = Cluster.from_edges(edges, solver=args.solver)
+    cluster = Cluster.from_edges(edges, solver=args.solver, enable_ctrl=args.ctrl)
     print(f"starting {args.nodes} nodes, {len(edges)} links ({args.topo})")
     t0 = time.perf_counter()
     await cluster.start()
@@ -73,6 +82,10 @@ async def main() -> None:
         f"{total_routes} unicast routes programmed across the cluster"
     )
 
+    if args.ctrl:
+        for name, node in cluster.nodes.items():
+            print(f"ctrl {name} 127.0.0.1:{node.ctrl.port}", flush=True)
+
     for k in range(args.churn):
         a, b = edges[k % len(edges)]
         t0 = time.perf_counter()
@@ -85,6 +98,10 @@ async def main() -> None:
             f"churn {k}: fail/heal {a}—{b}, reconverged in "
             f"{(time.perf_counter() - t0) * 1e3:.1f} ms (incl. 1s hold)"
         )
+
+    if args.hold:
+        print(f"holding for {args.hold}s", flush=True)
+        await asyncio.sleep(args.hold)
 
     await cluster.stop()
 

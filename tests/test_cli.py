@@ -1,0 +1,158 @@
+"""breeze CLI tests (reference analogue: openr/py/openr/cli/tests † —
+drive the click command tree against a live node).
+
+The CLI spins its own event loop per invocation (stateless
+connect-call-close, like the reference's thrift-per-invocation model), so
+the cluster must run on a thread with its own loop while CliRunner
+invokes commands from the test thread.
+"""
+
+import asyncio
+import threading
+
+import pytest
+from click.testing import CliRunner
+
+from openr_tpu.cli import cli
+from openr_tpu.emulator import Cluster
+
+
+class ClusterThread:
+    """Run a converged cluster on a background event loop."""
+
+    def __init__(self, edges):
+        self.edges = edges
+        self.loop = asyncio.new_event_loop()
+        self.cluster = None
+        self.ready = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+
+        async def boot():
+            self.cluster = Cluster.from_edges(self.edges, enable_ctrl=True)
+            await self.cluster.start()
+            await self.cluster.wait_converged(timeout=20.0)
+            self.ready.set()
+
+        self.loop.run_until_complete(boot())
+        self.loop.run_forever()
+
+    def start(self):
+        self.thread.start()
+        assert self.ready.wait(timeout=30.0), "cluster failed to converge"
+
+    def port(self, name: str) -> int:
+        return self.cluster.nodes[name].ctrl.port
+
+    def stop(self):
+        async def down():
+            await self.cluster.stop()
+
+        fut = asyncio.run_coroutine_threadsafe(down(), self.loop)
+        fut.result(timeout=10.0)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10.0)
+
+
+@pytest.fixture(scope="module")
+def live():
+    ct = ClusterThread([("a", "b"), ("b", "c")])
+    ct.start()
+    yield ct
+    ct.stop()
+
+
+def invoke(live, node, *args):
+    runner = CliRunner()
+    res = runner.invoke(
+        cli, ["--port", str(live.port(node)), *args], catch_exceptions=False
+    )
+    assert res.exit_code == 0, res.output
+    return res.output
+
+
+def test_status(live):
+    out = invoke(live, "a", "status")
+    assert "node: a" in out
+    assert out.count("pass") == 4
+
+
+def test_kvstore_keys_and_adj(live):
+    out = invoke(live, "a", "kvstore", "keys")
+    assert "adj:a" in out and "adj:c" in out
+
+    out = invoke(live, "a", "kvstore", "keys", "--prefix", "prefix:")
+    assert "adj:" not in out and "prefix:b" in out
+
+    out = invoke(live, "a", "kvstore", "adj")
+    # b is adjacent to both ends
+    assert [l for l in out.splitlines() if l.startswith("b ")], out
+
+
+def test_kvstore_keyvals_decodes_adj(live):
+    out = invoke(live, "b", "kvstore", "keyvals", "adj:b")
+    assert '"this_node_name": "b"' in out
+    assert '"adjacencies"' in out
+
+
+def test_kvstore_prefixes_and_peers(live):
+    out = invoke(live, "a", "kvstore", "prefixes")
+    assert "10.0.2.1/32" in out
+
+    out = invoke(live, "b", "kvstore", "peers")
+    assert set(out.split()) == {"a", "c"}
+
+
+def test_decision_routes_and_adj(live):
+    out = invoke(live, "a", "decision", "routes")
+    assert "10.0.2.1/32" in out and "b%" in out
+
+    out = invoke(live, "a", "decision", "adj")
+    assert "a" in out and "c" in out
+
+    out = invoke(live, "a", "decision", "received-routes")
+    assert "10.0.1.1/32" in out
+
+
+def test_fib_routes_and_counters(live):
+    out = invoke(live, "a", "fib", "routes")
+    assert "10.0.1.1/32" in out
+
+    out = invoke(live, "a", "fib", "counters")
+    assert "fib." in out
+
+
+def test_lm_links_and_metric(live):
+    out = invoke(live, "a", "lm", "links")
+    assert "node a" in out and "up" in out
+
+    out = invoke(live, "a", "lm", "set-link-metric", "if-a-b", "77")
+    assert "77" in out
+    out = invoke(live, "a", "lm", "links")
+    assert "77" in out
+    invoke(live, "a", "lm", "unset-link-metric", "if-a-b")
+
+
+def test_lm_overload_roundtrip(live):
+    invoke(live, "c", "lm", "set-node-overload")
+    out = invoke(live, "c", "lm", "links")
+    assert "OVERLOADED" in out
+    invoke(live, "c", "lm", "unset-node-overload")
+    out = invoke(live, "c", "lm", "links")
+    assert "OVERLOADED" not in out
+
+
+def test_prefixmgr_advertise_view_withdraw(live):
+    invoke(live, "b", "prefixmgr", "advertise", "10.99.0.0/16")
+    out = invoke(live, "b", "prefixmgr", "view")
+    assert "10.99.0.0/16" in out
+    invoke(live, "b", "prefixmgr", "withdraw", "10.99.0.0/16")
+    out = invoke(live, "b", "prefixmgr", "view")
+    assert "10.99.0.0/16" not in out
+
+
+def test_monitor_counters(live):
+    out = invoke(live, "a", "monitor", "counters", "--prefix", "kvstore.")
+    assert "kvstore." in out
