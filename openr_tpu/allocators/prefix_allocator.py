@@ -44,6 +44,7 @@ class PrefixAllocator(OpenrModule):
         kvstore: KvStore,
         pub_reader: RQueue,
         prefix_events_queue: ReplicateQueue,
+        store=None,  # PersistentStore: elected index survives restart
         counters=None,
     ):
         super().__init__(f"{config.node_name}.prefix-alloc", counters=counters)
@@ -65,6 +66,13 @@ class PrefixAllocator(OpenrModule):
             )
         self.allocated: IpPrefix | None = None
         self.area = config.area_ids()[0]
+        self.store = store
+        # reference: PrefixAllocator seeds the election with the index it
+        # persisted before restart (loadPrefixFromDisk †), so a restarting
+        # node reclaims its block instead of renumbering the fleet
+        saved_index = (
+            store.get(self._store_key()) if store is not None else None
+        )
         self.range_alloc = RangeAllocator(
             config.node_name,
             kvstore,
@@ -74,6 +82,7 @@ class PrefixAllocator(OpenrModule):
             end=self.num_blocks - 1,
             on_allocated=self._on_index,
             area=self.area,
+            initial_value=saved_index,
             counters=counters,
         )
 
@@ -87,7 +96,15 @@ class PrefixAllocator(OpenrModule):
         if self.static_index is None:
             await self.range_alloc.stop()
 
+    def _store_key(self) -> str:
+        return f"prefix-allocator.index.{self.seed}.{self.alloc_len}"
+
     def _on_index(self, index: int | None) -> None:
+        if self.store is not None and index is not None:
+            self.spawn(
+                self.store.store(self._store_key(), index),
+                name=f"{self.name}.persist",
+            )
         old = self.allocated
         new = carve(self.seed, self.alloc_len, index) if index is not None else None
         if new == old:

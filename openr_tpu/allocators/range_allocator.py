@@ -45,6 +45,7 @@ class RangeAllocator(OpenrModule):
         on_allocated: Callable[[int | None], Awaitable | None] | None = None,
         area: str = DEFAULT_AREA,
         ttl_ms: int | None = None,
+        initial_value: int | None = None,
         counters=None,
     ):
         super().__init__(f"{node_name}.range-alloc", counters=counters)
@@ -63,6 +64,14 @@ class RangeAllocator(OpenrModule):
         self.area = area
         self.ttl_ms = ttl_ms or kvstore.config.node.kvstore.key_ttl_ms
         self.my_value: int | None = None
+        # restart stickiness: try the previously-elected value first
+        # (reference: PrefixAllocator loads its last index from
+        # PersistentStore and seeds the election with it †)
+        self._initial = (
+            initial_value
+            if initial_value is not None and start <= initial_value <= end
+            else None
+        )
         self._probe_i = 0
         self.settled = asyncio.Event()
 
@@ -88,17 +97,28 @@ class RangeAllocator(OpenrModule):
             stride += 1
         return self.range_start + ((seed + i * stride) % n)
 
+    def _claimable(self, v: int) -> bool:
+        """Free, expired, or already ours."""
+        cur = self.kvstore.get_key(self.area, self._key(v))
+        return (
+            cur is None
+            or not cur.value
+            or cur.value.decode() == self.node_name
+        )
+
     def _probe_next(self) -> None:
         n = self.range_end - self.range_start + 1
+        if self._initial is not None:
+            v, self._initial = self._initial, None
+            if self._claimable(v):
+                self._claim(v)
+                return
         tried = 0
         while tried < n:
             v = self._candidate(self._probe_i)
             self._probe_i += 1
             tried += 1
-            cur = self.kvstore.get_key(self.area, self._key(v))
-            if cur is None or cur.value is None or not cur.value or (
-                cur.value.decode() == self.node_name
-            ):
+            if self._claimable(v):
                 self._claim(v)
                 return
         # every value owned by someone else

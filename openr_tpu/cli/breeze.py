@@ -41,7 +41,7 @@ def _run(ctx: click.Context, method: str, params: dict | None = None):
             await cli_.close()
 
     try:
-        return asyncio.new_event_loop().run_until_complete(go())
+        return asyncio.run(go())
     except (ConnectionError, OSError) as e:
         raise click.ClickException(
             f"cannot reach ctrl server at {host}:{port}: {e}"
@@ -421,3 +421,18 @@ def monitor_counters(ctx, prefix):
     res = _run(ctx, "get_counters", {"prefix": prefix})
     for k, v in sorted(res.items()):
         click.echo(f"{k}: {v:g}")
+
+
+@monitor.command("logs")
+@click.option("--limit", default=50, show_default=True, type=int)
+@click.option("--event", default=None, help="filter by event name")
+@click.pass_context
+def monitor_logs(ctx, limit, event):
+    """Recent structured event samples (reference: breeze monitor logs †)."""
+    res = _run(ctx, "get_event_logs", {"limit": limit, "event": event})
+    import datetime
+
+    for s in res:
+        ts = datetime.datetime.fromtimestamp(s["ts"]).strftime("%H:%M:%S")
+        attrs = " ".join(f"{k}={v}" for k, v in sorted(s["attrs"].items()))
+        click.echo(f"{ts}  {s['event']:<22} {attrs}")

@@ -1,0 +1,5 @@
+"""PersistentStore (reference: openr/config-store/ †)."""
+
+from openr_tpu.configstore.persistent_store import PersistentStore
+
+__all__ = ["PersistentStore"]

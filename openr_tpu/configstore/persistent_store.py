@@ -1,0 +1,127 @@
+"""Disk-backed object store surviving process restart.
+
+reference: openr/config-store/PersistentStore.{h,cpp} † — a tiny
+thrift-object-on-disk KV used for identity and allocation state (node
+name, elected prefix index, …). The reference serializes a
+PersistentObject log and snapshots it with an atomic write-temp-then-
+rename pattern; we keep the same durability contract (every store() is
+durable once awaited; a crash mid-write never corrupts the previous
+snapshot) over the framework's canonical-JSON codec.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Type, TypeVar
+
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.types.serde import from_jsonable, to_jsonable
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class PersistentStore(OpenrModule):
+    """Async facade over one JSON snapshot file.
+
+    Writes are debounced through the event loop but flushed on every
+    store() return (the reference batches via eventbase + saves with
+    fsync; our store() awaits the durable write directly — callers are
+    rare and small).
+    """
+
+    def __init__(self, path: str, counters=None):
+        super().__init__("configstore", counters=counters)
+        self.path = path
+        self._data: dict[str, Any] = {}
+        self._loaded = False
+        self._flush_lock: Any = None  # created lazily on the running loop
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def main(self) -> None:
+        self.load()
+
+    def load(self) -> None:
+        """Read the snapshot (idempotent; tolerant of a missing file —
+        first boot — but NOT of a corrupt one, which is surfaced loudly
+        like the reference's failure to parse its log)."""
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self.path, "rb") as f:
+                self._data = json.load(f)
+        except FileNotFoundError:
+            self._data = {}
+        except json.JSONDecodeError:
+            # a torn write is impossible (rename is atomic); a truly
+            # corrupt file means something else wrote it — don't silently
+            # wipe state that might be recoverable by hand
+            log.error("configstore %s is corrupt; starting empty", self.path)
+            if self.counters:
+                self.counters.increment("configstore.corrupt")
+            self._data = {}
+
+    # ------------------------------------------------------------------ api
+
+    async def store(self, key: str, obj: Any) -> None:
+        """Durably persist one jsonable/dataclass object under `key`."""
+        self.load()
+        self._data[key] = to_jsonable(obj)
+        await self._flush()
+        if self.counters:
+            self.counters.increment("configstore.stores")
+
+    async def erase(self, key: str) -> bool:
+        self.load()
+        existed = self._data.pop(key, None) is not None
+        if existed:
+            await self._flush()
+        return existed
+
+    def get(self, key: str, cls: Type[T] | None = None) -> T | Any | None:
+        """Load one object (None if absent). `cls` decodes a dataclass."""
+        self.load()
+        raw = self._data.get(key)
+        if raw is None or cls is None:
+            return raw
+        return from_jsonable(raw, cls)
+
+    def keys(self) -> list[str]:
+        self.load()
+        return sorted(self._data)
+
+    # ------------------------------------------------------------ internals
+
+    async def _flush(self) -> None:
+        """Atomic snapshot: write temp in the same directory, fsync,
+        rename over (reference: PersistentStore::saveDatabaseToDisk †).
+        Serialized by a lock: concurrent store() calls would otherwise
+        share the temp file and could rename a torn write over the
+        snapshot."""
+        import asyncio
+
+        if self._flush_lock is None:
+            self._flush_lock = asyncio.Lock()
+        async with self._flush_lock:
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            payload = json.dumps(
+                self._data, separators=(",", ":"), sort_keys=True
+            )
+            # the file is tiny (identity + allocations); a blocking write via
+            # the default executor keeps the event loop clean without aiofiles
+
+            def write():
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                with open(tmp, "w") as f:
+                    f.write(payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+
+            await asyncio.get_event_loop().run_in_executor(None, write)

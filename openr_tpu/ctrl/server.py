@@ -125,7 +125,7 @@ class CtrlServer(OpenrModule):
             "get_decision_adjacency_dbs", "get_received_routes",
             "get_interfaces", "set_node_overload", "set_interface_metric",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
-            "set_rib_policy", "get_rib_policy",
+            "set_rib_policy", "get_rib_policy", "get_event_logs",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -146,6 +146,17 @@ class CtrlServer(OpenrModule):
             "FIB_SYNCED": n.fib.synced.is_set(),
             "INITIALIZED": n.initialized,
         }
+
+    async def get_event_logs(self, params: dict) -> list:
+        """reference: Monitor event-log dump (`breeze monitor logs` †)."""
+        limit = params.get("limit")
+        samples = self.node.monitor.recent(
+            limit=int(limit) if limit is not None else 100,
+            event=params.get("event"),
+        )
+        return [
+            {"event": s.event, "ts": s.ts, "attrs": s.attrs} for s in samples
+        ]
 
     async def get_counters(self, params: dict) -> dict:
         """reference: fb303 getCounters †."""

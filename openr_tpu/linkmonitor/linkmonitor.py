@@ -46,6 +46,7 @@ class LinkMonitor(OpenrModule):
         neighbor_events_reader: RQueue,
         peer_events_queue: ReplicateQueue,
         interface_events_reader: RQueue | None = None,
+        log_samples_queue: ReplicateQueue | None = None,
         counters=None,
     ):
         super().__init__(f"{config.node_name}.linkmonitor", counters=counters)
@@ -56,6 +57,7 @@ class LinkMonitor(OpenrModule):
         self.nbr_reader = neighbor_events_reader
         self.peer_queue = peer_events_queue
         self.if_reader = interface_events_reader
+        self.log_queue = log_samples_queue
 
         self.interfaces: dict[str, InterfaceInfo] = {}
         self._if_backoff: dict[str, ExponentialBackoff] = {}
@@ -175,6 +177,13 @@ class LinkMonitor(OpenrModule):
             )
             if self.counters is not None:
                 self.counters.increment("linkmonitor.neighbor_up")
+            self._log_event(
+                "NEIGHBOR_RESTARTED"
+                if ev.type == NeighborEventType.NEIGHBOR_RESTARTED
+                else "NEIGHBOR_UP",
+                neighbor=info.node_name,
+                interface=info.local_if, area=info.area,
+            )
         elif ev.type == NeighborEventType.NEIGHBOR_DOWN:
             self.adjacencies.pop(key, None)
             # only drop the kvstore peer when no adjacency to that node
@@ -190,6 +199,8 @@ class LinkMonitor(OpenrModule):
                 )
             if self.counters is not None:
                 self.counters.increment("linkmonitor.neighbor_down")
+            self._log_event("NEIGHBOR_DOWN", neighbor=info.node_name,
+                            interface=info.local_if, area=info.area)
         elif ev.type == NeighborEventType.NEIGHBOR_RESTARTING:
             # graceful restart: hold the adjacency, don't re-advertise
             # (reference: GR keeps forwarding state while control restarts †)
@@ -299,7 +310,18 @@ class LinkMonitor(OpenrModule):
         """reference: OpenrCtrl setNodeOverload → LinkMonitor †."""
         if self.node_overloaded != overloaded:
             self.node_overloaded = overloaded
+            self._log_event(
+                "NODE_OVERLOAD_SET" if overloaded else "NODE_OVERLOAD_UNSET"
+            )
             self._advertise_debounce.poke()
+
+    def _log_event(self, event: str, **attrs) -> None:
+        """Emit a structured event sample (reference: LogSample records on
+        neighbor/overload transitions †)."""
+        if self.log_queue is not None:
+            from openr_tpu.monitor import LogSample
+
+            self.log_queue.push(LogSample(event=event, attrs=attrs))
 
     def set_link_metric(self, if_name: str, metric: int | None) -> None:
         """reference: setInterfaceMetric †."""

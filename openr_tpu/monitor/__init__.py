@@ -5,3 +5,4 @@ reference: openr/monitor/ † + the fb303 counter surface every module uses
 """
 
 from openr_tpu.monitor.counters import Counters  # noqa: F401
+from openr_tpu.monitor.monitor import LogSample, Monitor  # noqa: F401

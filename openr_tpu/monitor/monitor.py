@@ -1,0 +1,71 @@
+"""Monitor: event-log sample drain (reference: openr/monitor/Monitor.h †).
+
+The reference's modules emit structured LogSample JSON records (neighbor
+up/down, restarts, overload changes) into a LogSampleQueue; the Monitor
+module drains it, merges in process-common attributes (node name, domain),
+keeps a bounded recent-events buffer, and forwards to the operator's
+logging pipeline. We keep the same shape: a `LogSample` dataclass, a
+ReplicateQueue drain fiber, a ring buffer queryable over the ctrl API.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.messaging import QueueClosedError, RQueue
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class LogSample:
+    """One structured event record (reference: LogSample † — string/int/
+    vector key spaces collapsed into one jsonable dict here)."""
+
+    event: str  # e.g. "NEIGHBOR_UP", "NODE_OVERLOAD"
+    attrs: dict[str, Any] = field(default_factory=dict)
+    ts: float = 0.0  # epoch seconds; stamped by Monitor if 0
+
+
+class Monitor(OpenrModule):
+    """Drains the log-sample queue into a bounded recent-event buffer."""
+
+    MAX_EVENTS = 1000  # ring size (reference keeps a bounded export buffer †)
+
+    def __init__(self, config, log_sample_reader: RQueue, counters=None):
+        super().__init__(f"{config.node_name}.monitor", counters=counters)
+        self.node_name = config.node_name
+        self.reader = log_sample_reader
+        self.events: collections.deque[LogSample] = collections.deque(
+            maxlen=self.MAX_EVENTS
+        )
+
+    async def main(self) -> None:
+        self.spawn(self._drain(), name=f"{self.name}.drain")
+
+    async def _drain(self) -> None:
+        while True:
+            try:
+                sample = await self.reader.get()
+            except QueueClosedError:
+                return
+            if sample.ts == 0.0:
+                sample.ts = time.time()
+            # common attributes merged in, as the reference does with
+            # node/domain on every sample †
+            sample.attrs.setdefault("node_name", self.node_name)
+            self.events.append(sample)
+            if self.counters:
+                self.counters.increment("monitor.log_samples")
+            log.debug("event %s %s", sample.event, sample.attrs)
+
+    def recent(self, limit: int = 100, event: str | None = None) -> list[LogSample]:
+        out = [
+            s for s in self.events if event is None or s.event == event
+        ]
+        return out[-limit:]

@@ -24,7 +24,7 @@ from openr_tpu.fib import Fib, MockFibHandler
 from openr_tpu.kvstore import KvStore, KvStoreClient
 from openr_tpu.linkmonitor import LinkMonitor
 from openr_tpu.messaging import ReplicateQueue
-from openr_tpu.monitor import Counters
+from openr_tpu.monitor import Counters, Monitor
 from openr_tpu.prefixmgr import PrefixManager
 from openr_tpu.spark import Spark
 from openr_tpu.types.events import InterfaceEvent, InterfaceInfo
@@ -46,6 +46,8 @@ class OpenrNode:
         endpoint_host: str = "127.0.0.1",
         enable_ctrl: bool = False,
         ctrl_port: int = 0,
+        store_path: str | None = None,
+        watchdog_abort_fn=None,
     ):
         self.config = config
         self.name = config.node_name
@@ -59,8 +61,17 @@ class OpenrNode:
         self.prefix_events = ReplicateQueue(name=f"{self.name}.prefix")
         self.route_updates = ReplicateQueue(name=f"{self.name}.routes")
         self.fib_updates = ReplicateQueue(name=f"{self.name}.fib")
+        self.log_samples = ReplicateQueue(name=f"{self.name}.logs")
 
         # ---- modules, dependency order ----------------------------------
+        self.store = None
+        if store_path is not None:
+            from openr_tpu.configstore import PersistentStore
+
+            self.store = PersistentStore(store_path, counters=self.counters)
+        self.monitor = Monitor(
+            config, self.log_samples.get_reader(), counters=self.counters
+        )
         self.kvstore = KvStore(
             config,
             kv_transport,
@@ -104,6 +115,7 @@ class OpenrNode:
             self.neighbor_events.get_reader(),
             self.peer_events,
             interface_events_reader=self.interface_events.get_reader(),
+            log_samples_queue=self.log_samples,
             counters=self.counters,
         )
         origination_policy = None
@@ -137,6 +149,7 @@ class OpenrNode:
                 self.kvstore,
                 self.kvstore_pubs.get_reader(),
                 self.prefix_events,
+                store=self.store,
                 counters=self.counters,
             )
 
@@ -151,6 +164,8 @@ class OpenrNode:
         # startup order mirrors Main.cpp † (store first, discovery last);
         # shutdown is the reverse
         self._modules = [
+            *([self.store] if self.store is not None else []),
+            self.monitor,
             self.kvstore,
             self.kv_client,
             self.decision,
@@ -163,6 +178,19 @@ class OpenrNode:
             self._modules.append(self.prefix_allocator)
         if self.ctrl is not None:
             self._modules.append(self.ctrl)
+        self.watchdog = None
+        if config.node.watchdog.enable:
+            # supervises every module's heartbeat; started last so it never
+            # sees half-started modules (reference: Main.cpp watchdog †)
+            from openr_tpu.watchdog import Watchdog
+
+            self.watchdog = Watchdog(
+                config,
+                self._modules,
+                abort_fn=watchdog_abort_fn,
+                counters=self.counters,
+            )
+            self._modules.append(self.watchdog)
         self._started = False
 
     # ------------------------------------------------------------ lifecycle
@@ -188,6 +216,7 @@ class OpenrNode:
             self.prefix_events,
             self.route_updates,
             self.fib_updates,
+            self.log_samples,
         ):
             q.close()
 

@@ -1,0 +1,5 @@
+"""Watchdog (reference: openr/watchdog/ †)."""
+
+from openr_tpu.watchdog.watchdog import Watchdog
+
+__all__ = ["Watchdog"]

@@ -1,0 +1,90 @@
+"""Liveness supervisor: abort on stuck event loops or memory breach.
+
+reference: openr/watchdog/Watchdog.{h,cpp} † — every OpenrEventBase
+periodically stamps a progress timestamp; the Watchdog thread scans all
+registered eventbases each interval and aborts the process (SIGABRT, so
+a supervisor restarts it and the LSDB re-floods from peers) when one has
+not progressed within thread_timeout_s, or when RSS exceeds the
+configured ceiling. Here every OpenrModule already stamps
+`last_heartbeat` from its heartbeat fiber; a module whose fiber is
+starved (event loop blocked, fiber crashed) goes stale and trips the
+scan.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import resource
+import signal
+import time
+
+from openr_tpu.common.eventbase import OpenrModule
+
+log = logging.getLogger(__name__)
+
+
+def _default_abort(reason: str) -> None:
+    """reference: Watchdog fires LOG(FATAL)/abort † — SIGABRT leaves a
+    core for the supervisor; never returns."""
+    log.critical("watchdog aborting process: %s", reason)
+    os.kill(os.getpid(), signal.SIGABRT)
+
+
+class Watchdog(OpenrModule):
+    """Supervises a set of OpenrModules' heartbeats + process memory."""
+
+    def __init__(
+        self,
+        config,
+        modules: list[OpenrModule],
+        abort_fn=None,  # injectable for tests (reference tests stub abort †)
+        max_memory_mb: int | None = None,
+        counters=None,
+    ):
+        super().__init__(f"{config.node_name}.watchdog", counters=counters)
+        self.config = config
+        self.modules = list(modules)
+        self.abort_fn = abort_fn or _default_abort
+        self.max_memory_mb = max_memory_mb
+        self.timeout_s = config.node.watchdog.thread_timeout_s
+        self.interval_s = config.node.watchdog.interval_s
+        self.fired: str | None = None  # reason, once tripped
+
+    async def main(self) -> None:
+        self.run_every(self.interval_s, self.check, name=f"{self.name}.scan")
+
+    def watch(self, module: OpenrModule) -> None:
+        self.modules.append(module)
+
+    # ------------------------------------------------------------------ scan
+
+    def check(self) -> None:
+        """One scan pass (public so tests can drive it synchronously)."""
+        now = time.monotonic()
+        for m in self.modules:
+            if m.stopped:
+                continue
+            age = now - m.last_heartbeat
+            if age > self.timeout_s:
+                self._fire(
+                    f"module {m.name} stuck: no heartbeat for {age:.1f}s "
+                    f"(limit {self.timeout_s}s)"
+                )
+                return
+        if self.max_memory_mb is not None:
+            # ru_maxrss is KiB on Linux
+            rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+            if rss_mb > self.max_memory_mb:
+                self._fire(
+                    f"memory {rss_mb:.0f}MB exceeds limit {self.max_memory_mb}MB"
+                )
+                return
+        if self.counters:
+            self.counters.increment("watchdog.scans")
+
+    def _fire(self, reason: str) -> None:
+        self.fired = reason
+        if self.counters:
+            self.counters.increment("watchdog.aborts")
+        self.abort_fn(reason)
