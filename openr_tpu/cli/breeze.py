@@ -218,6 +218,26 @@ def kvstore_peers(ctx, area):
         click.echo(p)
 
 
+@kvstore.command("floodtopo")
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_floodtopo(ctx, area):
+    """DUAL flood-optimization spanning tree (reference: breeze kvstore
+    summary / getSptInfos †)."""
+    res = _run(ctx, "get_kvstore_flood_topo", {"area": area})
+    if not res.get("enabled"):
+        click.echo("flood optimization: disabled")
+        return
+    click.echo(f"flood root : {res.get('flood_root')}")
+    click.echo(f"flood peers: {','.join(res.get('flood_peers', [])) or '-'}")
+    rows = [
+        [r, s["dist"], s["parent"] or "-", s["state"],
+         ",".join(s["children"]) or "-"]
+        for r, s in sorted(res.get("roots", {}).items())
+    ]
+    click.echo(_table(rows, ["root", "dist", "parent", "state", "children"]))
+
+
 @kvstore.command("areas")
 @click.pass_context
 def kvstore_areas(ctx):

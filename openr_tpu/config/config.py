@@ -41,6 +41,8 @@ class KvstoreConfig:
     flood_rate_msgs_per_sec: int = C.KVSTORE_FLOOD_RATE_MSGS_PER_SEC
     flood_rate_burst_size: int = C.KVSTORE_FLOOD_RATE_BURST
     enable_flood_optimization: bool = False
+    # eligible to be a DUAL flood root (reference: is_flood_root †)
+    is_flood_root: bool = True
     # grace before declaring KVSTORE_SYNCED with zero peers (covers the
     # window before LinkMonitor delivers the first PeerEvent)
     initial_sync_grace_s: float = 2.0

@@ -59,9 +59,17 @@ class PersistentStore(OpenrModule):
             self._data = {}
         except json.JSONDecodeError:
             # a torn write is impossible (rename is atomic); a truly
-            # corrupt file means something else wrote it — don't silently
-            # wipe state that might be recoverable by hand
-            log.error("configstore %s is corrupt; starting empty", self.path)
+            # corrupt file means something else wrote it — move it aside
+            # so the next store() can't overwrite hand-recoverable state
+            aside = f"{self.path}.corrupt"
+            try:
+                os.replace(self.path, aside)
+            except OSError:
+                aside = "<unmovable>"
+            log.error(
+                "configstore %s is corrupt; preserved as %s, starting empty",
+                self.path, aside,
+            )
             if self.counters:
                 self.counters.increment("configstore.corrupt")
             self._data = {}
@@ -123,5 +131,12 @@ class PersistentStore(OpenrModule):
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self.path)
+                # fsync the directory too: without it the rename itself
+                # can be lost on power failure
+                dfd = os.open(d, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
 
             await asyncio.get_event_loop().run_in_executor(None, write)

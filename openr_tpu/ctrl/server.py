@@ -121,6 +121,7 @@ class CtrlServer(OpenrModule):
             "get_my_node_name", "get_initialization_status", "get_counters",
             "get_kvstore_keyvals", "set_kvstore_keyvals", "dump_kvstore",
             "get_kvstore_areas", "get_kvstore_peers",
+            "get_kvstore_flood_topo",
             "get_route_db_computed", "get_route_db_programmed",
             "get_decision_adjacency_dbs", "get_received_routes",
             "get_interfaces", "set_node_overload", "set_interface_metric",
@@ -208,6 +209,10 @@ class CtrlServer(OpenrModule):
     async def get_kvstore_peers(self, params: dict) -> dict:
         area = self._area(params)
         return {"peers": sorted(self.node.kvstore.get_peers(area))}
+
+    async def get_kvstore_flood_topo(self, params: dict) -> dict:
+        """DUAL flood-optimization SPT (reference: getSptInfos †)."""
+        return self.node.kvstore.get_flood_topo(self._area(params))
 
     async def subscribe_kvstore(self, params: dict, stream) -> None:
         """reference: subscribeAndGetKvStoreFiltered † (thrift server-stream):

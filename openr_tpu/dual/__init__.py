@@ -1,0 +1,15 @@
+"""DUAL — diffusing update algorithm for flood-root election.
+
+reference: openr/dual/Dual.cpp †, DualNode † — Open/R uses DUAL
+(EIGRP-style) to elect flood roots and maintain a flooding spanning tree
+per root so KvStore floods O(V) messages per update instead of O(E).
+"""
+
+from openr_tpu.dual.dual import (
+    DUAL_INF,
+    DualMsg,
+    DualNode,
+    RootStatus,
+)
+
+__all__ = ["DUAL_INF", "DualMsg", "DualNode", "RootStatus"]

@@ -76,6 +76,14 @@ class KvStore(OpenrModule):
         }
         self.peers: dict[tuple[str, str], _Peer] = {}  # (area, node) -> peer
         self.initial_sync_done = asyncio.Event()
+        self.flood_topos: dict[str, "FloodTopo"] = {}
+        if config.node.kvstore.enable_flood_optimization:
+            from openr_tpu.kvstore.floodtopo import FloodTopo
+
+            self.flood_topos = {
+                a: FloodTopo(a, self, config.node.kvstore.is_flood_root)
+                for a in config.area_ids()
+            }
 
     # ------------------------------------------------------------------ run
 
@@ -83,6 +91,10 @@ class KvStore(OpenrModule):
         if self.peer_events_reader is not None:
             self.spawn(self._peer_event_loop(), name=f"{self.name}.peers")
         self.run_every(1.0, self._ttl_tick, name=f"{self.name}.ttl")
+        if self.flood_topos:
+            self.run_every(
+                5.0, self._flood_topo_tick, name=f"{self.name}.dualTick"
+            )
         sync_s = self.config.node.kvstore.sync_interval_s
         self.run_every(sync_s, self._anti_entropy, name=f"{self.name}.sync")
         self.spawn(self._initial_sync_grace(), name=f"{self.name}.grace")
@@ -165,6 +177,9 @@ class KvStore(OpenrModule):
                 pass
         if self.counters is not None:
             self.counters.increment("kvstore.peers_removed")
+        ft = self.flood_topos.get(area)
+        if ft is not None:
+            ft.peer_down(node_name)
         # the departed peer may have been the last unsynced one
         self._maybe_initial_sync_done()
 
@@ -215,6 +230,9 @@ class KvStore(OpenrModule):
                 peer.backoff.report_success()
                 if self.counters is not None:
                     self.counters.increment("kvstore.full_syncs")
+                ft = self.flood_topos.get(area)
+                if ft is not None:
+                    ft.peer_up(peer.spec.node_name)
                 self._maybe_initial_sync_done()
                 return
             except asyncio.CancelledError:
@@ -266,11 +284,18 @@ class KvStore(OpenrModule):
         self, area: str, pub: Publication, exclude: str | None
     ) -> None:
         """Split-horizon flood to synced peers (reference: KvStoreDb
-        floodPublication †: skip the sender and anyone in node_ids)."""
+        floodPublication †: skip the sender and anyone in node_ids).
+        With flood optimization on, restrict to the DUAL spanning-tree
+        peers (parent + registered children) — O(V) network messages per
+        update instead of O(E) (reference: getFloodPeers †)."""
+        ft = self.flood_topos.get(area)
+        spt: set[str] | None = ft.flood_peers() if ft is not None else None
         for (parea, pname), peer in self.peers.items():
             if parea != area or pname == exclude:
                 continue
             if pname in pub.node_ids or peer.session is None:
+                continue
+            if spt is not None and pname not in spt:
                 continue
             self.spawn(self._flood_one(peer, pub))
 
@@ -285,6 +310,9 @@ class KvStore(OpenrModule):
             peer.flood_failures += 1
             peer.synced = False
             peer.session = None
+            ft = self.flood_topos.get(peer.spec.area)
+            if ft is not None:
+                ft.peer_down(peer.spec.node_name)
             # trigger re-sync (flood gap may have lost updates)
             self._spawn_sync(peer)
 
@@ -338,6 +366,18 @@ class KvStore(OpenrModule):
             self.counters.increment("kvstore.floods_received")
         self._apply(pub.area, pub, from_peer=sender)
 
+    async def handle_dual_messages(self, params: dict) -> None:
+        ft = self.flood_topos.get(params["area"])
+        if ft is not None:
+            ft.handle_messages(params["sender"], params["msgs"])
+
+    async def handle_flood_topo_set(self, params: dict) -> None:
+        ft = self.flood_topos.get(params["area"])
+        if ft is not None:
+            ft.handle_topo_set(
+                params["root"], params["child"], bool(params["set"])
+            )
+
     def register_rpc(self, server) -> None:
         """Attach transport handlers to this node's RpcServer."""
 
@@ -348,8 +388,18 @@ class KvStore(OpenrModule):
             await self.handle_flood(params)
             return None
 
+        async def dual(params):
+            await self.handle_dual_messages(params)
+            return None
+
+        async def flood_topo_set(params):
+            await self.handle_flood_topo_set(params)
+            return None
+
         server.register("kv.fullSync", full_sync)
         server.register("kv.flood", flood)
+        server.register("kv.dual", dual)
+        server.register("kv.floodTopoSet", flood_topo_set)
 
     # ------------------------------------------------------------ local API
 
@@ -376,6 +426,17 @@ class KvStore(OpenrModule):
     def dump(self, area: str, params: KeyDumpParams | None = None) -> dict[str, Value]:
         db = self.dbs.get(area)
         return db.dump(params) if db else {}
+
+    def get_flood_topo(self, area: str) -> dict:
+        """SPT / flood-optimization dump (reference: getSptInfos †)."""
+        ft = self.flood_topos.get(area)
+        if ft is None:
+            return {"enabled": False}
+        return {"enabled": True, **ft.status()}
+
+    def _flood_topo_tick(self) -> None:
+        for ft in self.flood_topos.values():
+            ft.tick()
 
     # ------------------------------------------------------------------ TTL
 

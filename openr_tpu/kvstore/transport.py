@@ -22,6 +22,14 @@ class KvPeerSession(Protocol):
 
     async def flood(self, pub: Publication) -> None: ...
 
+    async def dual_messages(
+        self, area: str, sender: str, msgs: list[dict]
+    ) -> None: ...
+
+    async def flood_topo_set(
+        self, area: str, root: str, child: str, set_flag: bool
+    ) -> None: ...
+
     async def close(self) -> None: ...
 
 
@@ -77,6 +85,22 @@ class _InProcSession:
         await asyncio.sleep(0)
         await self._peer().handle_flood({"pub": pub_to_json(pub)})
 
+    async def dual_messages(
+        self, area: str, sender: str, msgs: list[dict]
+    ) -> None:
+        await asyncio.sleep(0)
+        await self._peer().handle_dual_messages(
+            {"area": area, "sender": sender, "msgs": msgs}
+        )
+
+    async def flood_topo_set(
+        self, area: str, root: str, child: str, set_flag: bool
+    ) -> None:
+        await asyncio.sleep(0)
+        await self._peer().handle_flood_topo_set(
+            {"area": area, "root": root, "child": child, "set": set_flag}
+        )
+
     async def close(self) -> None:
         pass
 
@@ -107,6 +131,27 @@ class _TcpSession:
     async def flood(self, pub: Publication) -> None:
         try:
             await self._c.notify("kv.flood", {"pub": pub_to_json(pub)})
+        except (ConnectionError, RpcError) as e:
+            raise ConnectionError(str(e)) from e
+
+    async def dual_messages(
+        self, area: str, sender: str, msgs: list[dict]
+    ) -> None:
+        try:
+            await self._c.notify(
+                "kv.dual", {"area": area, "sender": sender, "msgs": msgs}
+            )
+        except (ConnectionError, RpcError) as e:
+            raise ConnectionError(str(e)) from e
+
+    async def flood_topo_set(
+        self, area: str, root: str, child: str, set_flag: bool
+    ) -> None:
+        try:
+            await self._c.notify(
+                "kv.floodTopoSet",
+                {"area": area, "root": root, "child": child, "set": set_flag},
+            )
         except (ConnectionError, RpcError) as e:
             raise ConnectionError(str(e)) from e
 

@@ -24,6 +24,23 @@ from openr_tpu.common.eventbase import OpenrModule
 log = logging.getLogger(__name__)
 
 
+def _current_rss_mb() -> float | None:
+    """Current (not peak) resident set size. /proc/self/statm field 2 is
+    resident pages; ru_maxrss would be the lifetime high-water mark and
+    would keep firing long after a transient spike was freed."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGESIZE") / (1024 * 1024)
+    except (OSError, ValueError, IndexError):
+        # non-Linux fallback: peak RSS (KiB on Linux, bytes on macOS —
+        # use KiB semantics; better than no check at all)
+        try:
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+        except Exception:  # noqa: BLE001
+            return None
+
+
 def _default_abort(reason: str) -> None:
     """reference: Watchdog fires LOG(FATAL)/abort † — SIGABRT leaves a
     core for the supervisor; never returns."""
@@ -73,9 +90,8 @@ class Watchdog(OpenrModule):
                 )
                 return
         if self.max_memory_mb is not None:
-            # ru_maxrss is KiB on Linux
-            rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
-            if rss_mb > self.max_memory_mb:
+            rss_mb = _current_rss_mb()
+            if rss_mb is not None and rss_mb > self.max_memory_mb:
                 self._fire(
                     f"memory {rss_mb:.0f}MB exceeds limit {self.max_memory_mb}MB"
                 )

@@ -1,0 +1,363 @@
+"""DUAL flood-optimization tests (reference analogue:
+openr/dual/tests/DualTest.cpp † — SPT correctness on known topologies,
+reconvergence on link/root failure; and the KvStore flood-topology
+integration: O(V) spanning-tree flooding instead of O(E))."""
+
+import asyncio
+import heapq
+
+import pytest
+
+from openr_tpu.config import Config
+from openr_tpu.dual import DUAL_INF, DualNode
+from openr_tpu.dual.dual import SELF
+from openr_tpu.kvstore import InProcKvTransport, KvStore
+from openr_tpu.kvstore.kvstore import PeerSpec
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.kvstore import TTL_INFINITY, Value
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ---- synchronous pump harness for the pure algorithm ----------------------
+
+
+class Net:
+    """Delivers DualNode messages synchronously until quiescent."""
+
+    def __init__(self):
+        self.nodes: dict[str, DualNode] = {}
+        self.inflight: list[tuple[str, str, list]] = []
+
+    def add(self, name: str, is_root: bool) -> DualNode:
+        node = DualNode(
+            name,
+            is_root=is_root,
+            send=lambda nbr, msgs, _src=name: self.inflight.append(
+                (_src, nbr, msgs)
+            ),
+        )
+        self.nodes[name] = node
+        return node
+
+    def link(self, a: str, b: str, cost: int = 1):
+        self.nodes[a].peer_up(b, cost)
+        self.nodes[b].peer_up(a, cost)
+
+    def cut(self, a: str, b: str):
+        self.nodes[a].peer_down(b)
+        self.nodes[b].peer_down(a)
+        # drop in-flight messages on the cut link (both directions)
+        self.inflight = [
+            (s, d, m)
+            for (s, d, m) in self.inflight
+            if {s, d} != {a, b}
+        ]
+
+    def pump(self, limit: int = 100_000):
+        n = 0
+        while self.inflight:
+            src, dst, msgs = self.inflight.pop(0)
+            node = self.nodes.get(dst)
+            if node is not None:
+                node.process_messages(src, msgs)
+            n += 1
+            assert n < limit, "DUAL did not quiesce"
+        return n
+
+
+def dijkstra(adj: dict[str, dict[str, int]], root: str) -> dict[str, int]:
+    dist = {root: 0}
+    pq = [(0, root)]
+    while pq:
+        d, u = heapq.heappop(pq)
+        if d > dist.get(u, DUAL_INF):
+            continue
+        for v, c in adj.get(u, {}).items():
+            nd = d + c
+            if nd < dist.get(v, DUAL_INF):
+                dist[v] = nd
+                heapq.heappush(pq, (nd, v))
+    return dist
+
+
+def check_spt(net: Net, root: str, adj: dict[str, dict[str, int]]):
+    """Every node's (dist, parent) matches Dijkstra; parents form a tree."""
+    want = dijkstra(adj, root)
+    for name, node in net.nodes.items():
+        st = node.status()[root]
+        assert st.state == "PASSIVE", f"{name} stuck active"
+        assert st.dist == want.get(name, DUAL_INF), (
+            f"{name}: dist {st.dist} != {want.get(name)}"
+        )
+        if name == root:
+            assert st.parent == SELF
+        elif st.dist < DUAL_INF:
+            p = st.parent
+            assert p in adj[name], f"{name}: parent {p} not a neighbor"
+            # parent is on a shortest path
+            assert want[p] + adj[name][p] == want[name]
+
+
+def line_adj(names, cost=1):
+    adj = {n: {} for n in names}
+    for a, b in zip(names, names[1:]):
+        adj[a][b] = cost
+        adj[b][a] = cost
+    return adj
+
+
+def test_dual_line():
+    names = ["a", "b", "c", "d"]
+    net = Net()
+    for n in names:
+        net.add(n, is_root=(n == "a"))
+    for a, b in zip(names, names[1:]):
+        net.link(a, b)
+    net.pump()
+    check_spt(net, "a", line_adj(names))
+
+
+def test_dual_grid_multiroot():
+    """4x4 grid, every node root-eligible: all elect the smallest id and
+    agree on one SPT."""
+    k = 4
+    names = [f"n{r}{c}" for r in range(k) for c in range(k)]
+    net = Net()
+    for n in names:
+        net.add(n, is_root=True)
+    adj = {n: {} for n in names}
+
+    def link(a, b):
+        net.link(a, b)
+        adj[a][b] = 1
+        adj[b][a] = 1
+
+    for r in range(k):
+        for c in range(k):
+            if c + 1 < k:
+                link(f"n{r}{c}", f"n{r}{c + 1}")
+            if r + 1 < k:
+                link(f"n{r}{c}", f"n{r + 1}{c}")
+    net.pump()
+    roots = {n: node.pick_flood_root() for n, node in net.nodes.items()}
+    assert set(roots.values()) == {"n00"}
+    check_spt(net, "n00", adj)
+
+
+def test_dual_weighted_costs():
+    """Triangle with a heavy direct edge: SPT routes around it."""
+    net = Net()
+    for n in "abc":
+        net.add(n, is_root=(n == "a"))
+    net.link("a", "b", 1)
+    net.link("b", "c", 1)
+    net.link("a", "c", 10)
+    net.pump()
+    adj = {"a": {"b": 1, "c": 10}, "b": {"a": 1, "c": 1}, "c": {"b": 1, "a": 10}}
+    check_spt(net, "a", adj)
+    assert net.nodes["c"].status()["a"].dist == 2
+    assert net.nodes["c"].status()["a"].parent == "b"
+
+
+def test_dual_link_failure_reconverges():
+    """Ring: cutting one link forces the far node the long way around."""
+    names = ["a", "b", "c", "d", "e", "f"]
+    net = Net()
+    for n in names:
+        net.add(n, is_root=(n == "a"))
+    ring = list(zip(names, names[1:] + names[:1]))
+    for x, y in ring:
+        net.link(x, y)
+    net.pump()
+    assert net.nodes["d"].status()["a"].dist == 3
+    # cut a-b: b..d must re-route via f-e side
+    net.cut("a", "b")
+    net.pump()
+    adj = {n: {} for n in names}
+    for x, y in ring:
+        if {x, y} != {"a", "b"}:
+            adj[x][y] = 1
+            adj[y][x] = 1
+    check_spt(net, "a", adj)
+    assert net.nodes["b"].status()["a"].dist == 5
+
+
+def test_dual_root_failure_reelects():
+    """Two roots: when the elected (smaller) one dies, everyone fails
+    over to the next-smallest reachable root."""
+    names = ["a", "b", "c", "d"]
+    net = Net()
+    for n in names:
+        net.add(n, is_root=(n in ("a", "b")))
+    for x, y in zip(names, names[1:]):
+        net.link(x, y)
+    net.pump()
+    assert all(
+        node.pick_flood_root() == "a" for node in net.nodes.values()
+    )
+    # a dies: its links go down
+    net.cut("a", "b")
+    net.pump()
+    for n in ("b", "c", "d"):
+        assert net.nodes[n].pick_flood_root() == "b", n
+    check_spt(net, "b", line_adj(["b", "c", "d"]))
+
+
+def test_dual_partition_heals():
+    net = Net()
+    names = ["a", "b", "c", "d"]
+    for n in names:
+        net.add(n, is_root=(n == "a"))
+    net.link("a", "b")
+    net.link("c", "d")  # partitioned half, no root
+    net.pump()
+    assert net.nodes["c"].pick_flood_root() is None
+    assert net.nodes["d"].pick_flood_root() is None
+    net.link("b", "c")  # heal
+    net.pump()
+    check_spt(net, "a", line_adj(names))
+    assert net.nodes["d"].pick_flood_root() == "a"
+
+
+# ---- KvStore integration --------------------------------------------------
+
+
+class FloodWrapper:
+    def __init__(self, transport, name):
+        self.q = ReplicateQueue(name=f"{name}.pubs")
+        self.counters = Counters()
+        self.config = Config.default(name)
+        self.config.node.kvstore.enable_flood_optimization = True
+        self.store = KvStore(
+            self.config, transport, self.q, counters=self.counters
+        )
+        transport.register(name, self.store)
+
+    async def start(self):
+        await self.store.start()
+
+    async def stop(self):
+        await self.store.stop()
+
+
+async def _settle(cond, timeout=5.0, interval=0.01):
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    while not cond():
+        if loop.time() - t0 > timeout:
+            return False
+        await asyncio.sleep(interval)
+    return True
+
+
+def V(version, orig, value):
+    return Value(
+        version=version, originator_id=orig, value=value, ttl=TTL_INFINITY
+    ).with_hash()
+
+
+def test_kvstore_flood_topology_tree():
+    """Full mesh of 5 flood-optimized stores: the DUAL SPT forms, floods
+    still reach everyone, and the flood-peer sets form a spanning tree
+    (sum of degrees == 2*(V-1), not V*(V-1))."""
+
+    async def main():
+        t = InProcKvTransport()
+        names = ["s1", "s2", "s3", "s4", "s5"]
+        ws = {n: FloodWrapper(t, n) for n in names}
+        for w in ws.values():
+            await w.start()
+        for a in names:
+            for b in names:
+                if a != b:
+                    ws[a].store.add_peer_sync(PeerSpec(node_name=b))
+
+        def tree_formed():
+            topos = [
+                ws[n].store.get_flood_topo("0") for n in names
+            ]
+            if any(tp.get("flood_root") != "s1" for tp in topos):
+                return False
+            deg = sum(len(tp["flood_peers"]) for tp in topos)
+            return deg == 2 * (len(names) - 1)
+
+        ok = await _settle(tree_formed)
+        topos = {n: ws[n].store.get_flood_topo("0") for n in names}
+        assert ok, f"flood tree never formed: {topos}"
+
+        # a write still reaches every store through the tree
+        ws["s3"].store.set_key("0", "k", V(1, "s3", b"hello"))
+        ok = await _settle(
+            lambda: all(
+                (v := ws[n].store.get_key("0", "k")) is not None
+                and v.value == b"hello"
+                for n in names
+            )
+        )
+        assert ok, "write did not propagate over the flood tree"
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
+def test_kvstore_flood_tree_survives_node_loss():
+    """Ring of 4 with flood opt: root s1 dies, tree re-forms on s2 and
+    writes still propagate among survivors."""
+
+    async def main():
+        t = InProcKvTransport()
+        names = ["s1", "s2", "s3", "s4"]
+        ws = {n: FloodWrapper(t, n) for n in names}
+        for w in ws.values():
+            await w.start()
+        ring = list(zip(names, names[1:] + names[:1]))
+        for a, b in ring:
+            ws[a].store.add_peer_sync(PeerSpec(node_name=b))
+            ws[b].store.add_peer_sync(PeerSpec(node_name=a))
+
+        ok = await _settle(
+            lambda: all(
+                ws[n].store.get_flood_topo("0").get("flood_root") == "s1"
+                for n in names
+            )
+        )
+        assert ok, "initial flood root not elected"
+
+        # s1 departs: peers drop it (LinkMonitor would do this on real
+        # neighbor-down); unregister so floods to it fail
+        await ws["s1"].stop()
+        t.unregister("s1")
+        for n in ("s2", "s4"):
+            ws[n].store.spawn(
+                ws[n].store._del_peer("0", "s1")
+            )
+
+        survivors = ["s2", "s3", "s4"]
+        ok = await _settle(
+            lambda: all(
+                ws[n].store.get_flood_topo("0").get("flood_root") == "s2"
+                for n in survivors
+            )
+        )
+        assert ok, {
+            n: ws[n].store.get_flood_topo("0") for n in survivors
+        }
+
+        ws["s4"].store.set_key("0", "after", V(1, "s4", b"alive"))
+        ok = await _settle(
+            lambda: all(
+                (v := ws[n].store.get_key("0", "after")) is not None
+                and v.value == b"alive"
+                for n in survivors
+            )
+        )
+        assert ok, "write did not propagate after root loss"
+        for n in survivors:
+            await ws[n].stop()
+
+    run(main())
