@@ -1,0 +1,112 @@
+// C ABI over the openr_nl C++ library, consumed from Python via ctypes
+// (reference boundary: openr/platform/NetlinkFibHandler † is thrift; here
+// the process boundary is a shared library because the FibService runs
+// in-process — the RPC seam stays available one layer up in openr_tpu.fib).
+//
+// Conventions: handles are opaque pointers; functions return 0 or -errno;
+// dump results are malloc'd JSON strings the caller releases with
+// onl_free(). Keep struct layouts in sync with openr_tpu/nl/netlink.py.
+
+#include <cstdlib>
+#include <cstring>
+
+#include "netlink.hpp"
+
+using openr_nl::Route;
+using openr_nl::Socket;
+
+namespace {
+thread_local std::string g_err;
+
+char* dup_str(const std::string& s) {
+  char* p = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+}  // namespace
+
+extern "C" {
+
+void* onl_open(uint32_t groups) {
+  auto* s = new Socket();
+  if (!s->open(groups)) {
+    g_err = s->last_error();
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void onl_close(void* h) { delete static_cast<Socket*>(h); }
+
+int onl_fd(void* h) { return static_cast<Socket*>(h)->fd(); }
+
+const char* onl_last_error(void* h) {
+  if (h) g_err = static_cast<Socket*>(h)->last_error();
+  return g_err.c_str();
+}
+
+int onl_route_add(void* h, const Route* r, int replace) {
+  return static_cast<Socket*>(h)->route_request(*r, false, replace != 0);
+}
+
+int onl_route_del(void* h, const Route* r) {
+  return static_cast<Socket*>(h)->route_request(*r, true, false);
+}
+
+int onl_route_batch(void* h, const Route* rs, int n, int del, int replace,
+                    int32_t* errs) {
+  return static_cast<Socket*>(h)->route_batch(
+      rs, static_cast<size_t>(n), del != 0, replace != 0, errs);
+}
+
+char* onl_routes_dump(void* h, int family, uint32_t table,
+                      uint32_t protocol) {
+  std::vector<Route> out;
+  int rc = static_cast<Socket*>(h)->dump_routes(family, table, protocol, &out);
+  if (rc < 0) return nullptr;
+  return dup_str(openr_nl::routes_to_json(out));
+}
+
+char* onl_links_dump(void* h) {
+  std::vector<openr_nl::LinkInfo> out;
+  if (static_cast<Socket*>(h)->dump_links(&out) < 0) return nullptr;
+  return dup_str(openr_nl::links_to_json(out));
+}
+
+char* onl_addrs_dump(void* h) {
+  std::vector<openr_nl::AddrInfo> out;
+  if (static_cast<Socket*>(h)->dump_addrs(&out) < 0) return nullptr;
+  return dup_str(openr_nl::addrs_to_json(out));
+}
+
+// subscribed-socket event poll; returns malloc'd JSON array ("[]" on
+// timeout), nullptr on error
+char* onl_next_events(void* h, int timeout_ms) {
+  std::vector<openr_nl::Event> evs;
+  int rc = static_cast<Socket*>(h)->next_events(timeout_ms, &evs);
+  if (rc < 0) return nullptr;
+  return dup_str(openr_nl::events_to_json(evs));
+}
+
+void onl_free(char* p) { std::free(p); }
+
+// ---- kernel-free serialization hooks (golden/roundtrip tests) -------------
+
+int onl_build_route_nlmsg(const Route* r, int del, int replace,
+                          uint8_t* buf, int buflen) {
+  auto msg = openr_nl::build_route_msg(*r, del != 0, replace != 0, 1);
+  if (static_cast<int>(msg.size()) > buflen) return -1;
+  std::memcpy(buf, msg.data(), msg.size());
+  return static_cast<int>(msg.size());
+}
+
+int onl_parse_route_nlmsg(const uint8_t* buf, int len, Route* out) {
+  const auto* h = reinterpret_cast<const nlmsghdr*>(buf);
+  if (!NLMSG_OK(h, static_cast<size_t>(len))) return -1;
+  return openr_nl::parse_route_msg(h, out) ? 0 : -1;
+}
+
+uint32_t onl_abi_sizeof_route() { return sizeof(Route); }
+
+}  // extern "C"

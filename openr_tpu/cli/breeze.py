@@ -229,7 +229,11 @@ def kvstore_floodtopo(ctx, area):
         click.echo("flood optimization: disabled")
         return
     click.echo(f"flood root : {res.get('flood_root')}")
-    click.echo(f"flood peers: {','.join(res.get('flood_peers', [])) or '-'}")
+    mode = res.get("mode", "spt")
+    click.echo(
+        f"flood peers: {','.join(res.get('flood_peers', [])) or '-'}"
+        f" ({'tree' if mode == 'spt' else 'ALL peers — tree not formed'})"
+    )
     rows = [
         [r, s["dist"], s["parent"] or "-", s["state"],
          ",".join(s["children"]) or "-"]

@@ -52,13 +52,15 @@ class DualMsg:
     dist: int
 
     def to_json(self) -> dict:
-        return {"root": self.root, "mtype": self.mtype, "dist": self.dist}
+        from openr_tpu.types.serde import to_jsonable
+
+        return to_jsonable(self)
 
     @staticmethod
     def from_json(raw: dict) -> "DualMsg":
-        return DualMsg(
-            root=raw["root"], mtype=raw["mtype"], dist=int(raw["dist"])
-        )
+        from openr_tpu.types.serde import from_jsonable
+
+        return from_jsonable(raw, DualMsg)
 
 
 @dataclass
@@ -86,6 +88,7 @@ class _RootState:
         self.pending: set[str] = set()  # awaited replies while ACTIVE
         self.deferred: set[str] = set()  # queriers owed a reply at finish
         self.sia_probes = 0  # stuck-in-active retransmit count
+        self.dead_ticks = 0  # consecutive ticks at dist == INF (pruning)
         if self.i_am_root:
             self.dist = 0
             self.fd = 0
@@ -251,6 +254,12 @@ class _RootState:
                 if n in self.node.costs:
                     self.node._enqueue(n, DualMsg(self.root, "query", self.dist))
         else:
+            if self.dist >= DUAL_INF and not self.i_am_root:
+                # dead root: stop refreshing it (re-advertising INF would
+                # re-instantiate the machine on every receiver forever)
+                self.dead_ticks += 1
+                return
+            self.dead_ticks = 0
             self._send_all("update", self.dist)
 
     def status(self) -> RootStatus:
@@ -365,13 +374,22 @@ class DualNode:
         if self._on_parent_change_cb is not None:
             self._on_parent_change_cb(root, old, new)
 
-    def tick(self, max_sia_probes: int = 3) -> None:
+    def tick(self, max_sia_probes: int = 3, dead_root_ticks: int = 3) -> None:
         """Periodic self-healing: retransmit/unwedge ACTIVE machines,
-        refresh PASSIVE introductions (see _RootState.tick)."""
+        refresh PASSIVE introductions, and prune machines for roots that
+        have been unreachable for `dead_root_ticks` consecutive ticks —
+        without pruning, every root-eligible node name that EVER existed
+        would stay in the dict (and on the wire) for the cluster's
+        lifetime (see _RootState.tick)."""
 
         def go():
             for rs in self.roots.values():
                 rs.tick(max_sia_probes)
+            for root in [
+                r for r, rs in self.roots.items()
+                if rs.dead_ticks >= dead_root_ticks and not rs.i_am_root
+            ]:
+                del self.roots[root]
 
         self._event(go)
 

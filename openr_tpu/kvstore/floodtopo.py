@@ -16,6 +16,7 @@ import logging
 from typing import TYPE_CHECKING
 
 from openr_tpu.dual import DualMsg, DualNode, RootStatus
+from openr_tpu.dual.dual import SELF
 
 if TYPE_CHECKING:
     from openr_tpu.kvstore.kvstore import KvStore
@@ -61,8 +62,6 @@ class FloodTopo:
     def _parent_changed(
         self, root: str, old: str | None, new: str | None
     ) -> None:
-        from openr_tpu.dual.dual import SELF
-
         for target, flag in ((old, False), (new, True)):
             if target is None or target == SELF:
                 continue
@@ -91,8 +90,6 @@ class FloodTopo:
         of ourselves as our parent's child — a FLOOD_TOPO_SET dropped
         while the parent's session was down would otherwise leave that
         tree edge broken until the next parent change."""
-        from openr_tpu.dual.dual import SELF
-
         self.dual.tick()
         root = self.dual.pick_flood_root()
         if root is None:
@@ -132,8 +129,6 @@ class FloodTopo:
         reference: KvStoreDb::getFloodPeers † — SPT peers when the dual
         root is elected and reachable, full peer list otherwise.
         """
-        from openr_tpu.dual.dual import SELF
-
         root = self.dual.pick_flood_root()
         if root is None:
             return None
@@ -148,11 +143,18 @@ class FloodTopo:
         return peers
 
     def status(self) -> dict:
-        """SPT dump for ctrl/CLI (reference: getSptInfos †)."""
+        """SPT dump for ctrl/CLI (reference: getSptInfos †). `mode` is
+        "spt" when tree-restricted, "all-peers" while falling back to
+        full flooding — an empty peer list under "all-peers" means
+        flooding to EVERYONE, not to nobody."""
         infos: dict[str, RootStatus] = self.dual.status()
+        spt = self.flood_peers()
         return {
             "flood_root": self.dual.pick_flood_root(),
-            "flood_peers": sorted(self.flood_peers() or []),
+            "mode": "all-peers" if spt is None else "spt",
+            "flood_peers": sorted(
+                spt if spt is not None else self.dual.costs
+            ),
             "roots": {
                 r: {
                     "dist": s.dist,

@@ -1,0 +1,22 @@
+"""Python bindings for the native netlink library (native/nl).
+
+reference: openr/nl/ † — the reference's from-scratch C++ rtnetlink
+library. The rebuild keeps this layer native (see native/nl/netlink.hpp)
+and exposes it here via ctypes.
+"""
+
+from openr_tpu.nl.netlink import (
+    NetlinkError,
+    NetlinkRoute,
+    NetlinkSocket,
+    Nexthop,
+    native_available,
+)
+
+__all__ = [
+    "NetlinkError",
+    "NetlinkRoute",
+    "NetlinkSocket",
+    "Nexthop",
+    "native_available",
+]

@@ -1,0 +1,135 @@
+"""NetlinkInterfaceSource: real kernel interfaces → LinkMonitor.
+
+reference: LinkMonitor's netlink subscription in the reference †
+(openr/link-monitor/LinkMonitor.cpp consumes link/addr events from
+openr/nl's NetlinkProtocolSocket and replays an initial snapshot). Here
+the same seam is the InterfaceEvent queue: this module snapshots
+links+addrs at start, then converts subscribed rtnetlink events into
+`InterfaceEvent`s, so LinkMonitor code is identical for mock (tests/
+emulator) and real-kernel deployments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.nl.netlink import (
+    RTMGRP_IPV4_IFADDR,
+    RTMGRP_IPV6_IFADDR,
+    RTMGRP_LINK,
+    NetlinkSocket,
+)
+from openr_tpu.types.events import InterfaceEvent, InterfaceInfo
+
+log = logging.getLogger(__name__)
+
+
+class NetlinkInterfaceSource(OpenrModule):
+    """Feeds kernel link/addr state into an InterfaceEvent queue."""
+
+    def __init__(
+        self,
+        node_name: str,
+        interface_events_queue: ReplicateQueue,
+        counters=None,
+        poll_ms: int = 500,
+    ):
+        super().__init__(f"{node_name}.nlifaces", counters=counters)
+        self.queue = interface_events_queue
+        self.poll_ms = poll_ms
+        self._sock: NetlinkSocket | None = None
+        # name -> InterfaceInfo (current view)
+        self.interfaces: dict[str, InterfaceInfo] = {}
+
+    async def main(self) -> None:
+        groups = RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR
+        # subscribe BEFORE the snapshot so no transition is lost between
+        # dump and first poll (reference: same subscribe-then-replay order †)
+        self._sock = NetlinkSocket(groups=groups)
+        await asyncio.to_thread(self._snapshot)
+        self.queue.push(
+            InterfaceEvent(interfaces=list(self.interfaces.values()))
+        )
+        self.spawn(self._event_loop(), name=f"{self.name}.events")
+
+    async def cleanup(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def _snapshot(self) -> None:
+        assert self._sock is not None
+        addrs_by_if: dict[int, list[str]] = {}
+        for a in self._sock.addrs_dump():
+            addrs_by_if.setdefault(a["ifindex"], []).append(a["addr"])
+        for link in self._sock.links_dump():
+            self.interfaces[link["name"]] = InterfaceInfo(
+                name=link["name"],
+                is_up=bool(link["up"]),
+                ifindex=link["ifindex"],
+                addrs=tuple(addrs_by_if.get(link["ifindex"], ())),
+            )
+
+    async def _event_loop(self) -> None:
+        assert self._sock is not None
+        while not self.stopped:
+            evs = await asyncio.to_thread(
+                self._sock.next_events, self.poll_ms
+            )
+            if not evs:
+                continue
+            changed: dict[str, InterfaceInfo] = {}
+            resync_addrs = False
+            for ev in evs:
+                if ev["kind"] == "link":
+                    name = ev.get("name", "")
+                    if not name:
+                        continue
+                    if ev["deleted"]:
+                        old = self.interfaces.pop(name, None)
+                        if old is not None:
+                            changed[name] = InterfaceInfo(
+                                name=name, is_up=False,
+                                ifindex=old.ifindex, addrs=(),
+                            )
+                    else:
+                        old = self.interfaces.get(name)
+                        info = InterfaceInfo(
+                            name=name,
+                            is_up=bool(ev["up"]),
+                            ifindex=ev["ifindex"],
+                            addrs=old.addrs if old else (),
+                        )
+                        if old != info:
+                            self.interfaces[name] = info
+                            changed[name] = info
+                else:  # addr event: cheapest correct response is re-dump
+                    resync_addrs = True
+            if resync_addrs:
+                await asyncio.to_thread(self._resync_addrs, changed)
+            if changed:
+                if self.counters is not None:
+                    self.counters.increment(
+                        "nlifaces.events", len(changed)
+                    )
+                self.queue.push(
+                    InterfaceEvent(interfaces=list(changed.values()))
+                )
+
+    def _resync_addrs(self, changed: dict[str, InterfaceInfo]) -> None:
+        assert self._sock is not None
+        addrs_by_if: dict[int, list[str]] = {}
+        for a in self._sock.addrs_dump():
+            addrs_by_if.setdefault(a["ifindex"], []).append(a["addr"])
+        for name, info in list(self.interfaces.items()):
+            new_addrs = tuple(addrs_by_if.get(info.ifindex, ()))
+            if new_addrs != info.addrs:
+                ni = InterfaceInfo(
+                    name=name, is_up=info.is_up,
+                    ifindex=info.ifindex, addrs=new_addrs,
+                )
+                self.interfaces[name] = ni
+                changed[name] = ni

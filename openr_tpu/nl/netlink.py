@@ -1,0 +1,376 @@
+"""ctypes bindings for native/nl (libopenr_nl.so).
+
+reference: openr/nl/NetlinkProtocolSocket.h † public API — route add/del
+(v4/v6 ECMP/UCMP + MPLS), link/address dumps, event subscription. The
+blocking native calls are small and fast; async callers run them through
+``asyncio.to_thread`` (the platform module does).
+
+Struct layouts here MUST mirror native/nl/netlink.hpp (#pragma pack(1)).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ipaddress
+import json
+import os
+import socket as pysocket
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+
+AF_MPLS = 28
+MAX_NEXTHOPS = 32
+MAX_LABELS = 8
+RTPROT_OPENR = 99
+
+# RTMGRP_* subscription bits (linux/rtnetlink.h)
+RTMGRP_LINK = 1
+RTMGRP_IPV4_IFADDR = 0x10
+RTMGRP_IPV6_IFADDR = 0x100
+
+
+class NetlinkError(OSError):
+    pass
+
+
+class _CNexthop(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("af", ctypes.c_int32),
+        ("gateway", ctypes.c_uint8 * 16),
+        ("ifindex", ctypes.c_int32),
+        ("weight", ctypes.c_uint32),
+        ("num_labels", ctypes.c_uint32),
+        ("labels", ctypes.c_uint32 * MAX_LABELS),
+    ]
+
+
+class _CRoute(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("family", ctypes.c_int32),
+        ("dst", ctypes.c_uint8 * 16),
+        ("dst_len", ctypes.c_uint32),
+        ("mpls_label", ctypes.c_uint32),
+        ("table", ctypes.c_uint32),
+        ("protocol", ctypes.c_uint32),
+        ("priority", ctypes.c_uint32),
+        ("num_nexthops", ctypes.c_uint32),
+        ("nh", _CNexthop * MAX_NEXTHOPS),
+    ]
+
+
+@dataclass
+class Nexthop:
+    gateway: str | None = None  # v4/v6 literal
+    ifindex: int = 0
+    weight: int = 1
+    labels: tuple[int, ...] = ()  # MPLS push stack, outermost first
+
+
+@dataclass
+class NetlinkRoute:
+    """One unicast or MPLS route (reference: openr/nl route structs †)."""
+
+    dst: str | None = None  # "10.0.0.0/24" / "fc00::/64"; None for MPLS
+    mpls_label: int | None = None  # incoming label (AF_MPLS route)
+    table: int = 254  # RT_TABLE_MAIN
+    protocol: int = RTPROT_OPENR
+    priority: int = 0
+    nexthops: list[Nexthop] = field(default_factory=list)
+
+    @property
+    def family(self) -> int:
+        if self.mpls_label is not None:
+            return AF_MPLS
+        net = ipaddress.ip_network(self.dst, strict=False)
+        return pysocket.AF_INET if net.version == 4 else pysocket.AF_INET6
+
+    def to_c(self) -> _CRoute:
+        c = _CRoute()
+        c.family = self.family
+        c.table = self.table
+        c.protocol = self.protocol
+        c.priority = self.priority
+        if self.mpls_label is not None:
+            c.mpls_label = self.mpls_label
+        else:
+            net = ipaddress.ip_network(self.dst, strict=False)
+            packed = net.network_address.packed
+            ctypes.memmove(c.dst, packed, len(packed))
+            c.dst_len = net.prefixlen
+        if len(self.nexthops) > MAX_NEXTHOPS:
+            raise NetlinkError(
+                f"too many nexthops: {len(self.nexthops)} > {MAX_NEXTHOPS}"
+            )
+        c.num_nexthops = len(self.nexthops)
+        for i, nh in enumerate(self.nexthops):
+            cn = c.nh[i]
+            cn.ifindex = nh.ifindex
+            cn.weight = max(1, nh.weight)
+            if nh.gateway:
+                addr = ipaddress.ip_address(nh.gateway)
+                cn.af = (
+                    pysocket.AF_INET if addr.version == 4
+                    else pysocket.AF_INET6
+                )
+                ctypes.memmove(cn.gateway, addr.packed, len(addr.packed))
+            if len(nh.labels) > MAX_LABELS:
+                raise NetlinkError(f"label stack too deep: {nh.labels}")
+            cn.num_labels = len(nh.labels)
+            for j, lbl in enumerate(nh.labels):
+                cn.labels[j] = lbl
+        return c
+
+    @staticmethod
+    def from_json(d: dict) -> "NetlinkRoute":
+        return NetlinkRoute(
+            dst=d.get("dst"),
+            mpls_label=d.get("mpls_label"),
+            table=d.get("table", 254),
+            protocol=d.get("protocol", RTPROT_OPENR),
+            priority=d.get("priority", 0),
+            nexthops=[
+                Nexthop(
+                    gateway=n.get("gateway"),
+                    ifindex=n.get("ifindex", 0),
+                    weight=n.get("weight", 1),
+                    labels=tuple(n.get("labels", ())),
+                )
+                for n in d.get("nexthops", ())
+            ],
+        )
+
+
+# ---- library loading ------------------------------------------------------
+
+_LIB_PATHS = [
+    Path(__file__).resolve().parents[2] / "native" / "build" / "libopenr_nl.so",
+]
+_lib: ctypes.CDLL | None = None
+_lib_err: str | None = None
+
+
+def _try_build() -> None:
+    """Best-effort `make -C native` (dev convenience; CI prebuilds)."""
+    mk = Path(__file__).resolve().parents[2] / "native"
+    if (mk / "Makefile").exists():
+        subprocess.run(
+            ["make", "-C", str(mk)], capture_output=True, timeout=120,
+            check=False,
+        )
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    path = next((p for p in _LIB_PATHS if p.exists()), None)
+    if path is None:
+        _try_build()
+        path = next((p for p in _LIB_PATHS if p.exists()), None)
+    if path is None:
+        _lib_err = f"libopenr_nl.so not found (tried {_LIB_PATHS})"
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.onl_open.restype = ctypes.c_void_p
+    lib.onl_open.argtypes = [ctypes.c_uint32]
+    lib.onl_close.argtypes = [ctypes.c_void_p]
+    lib.onl_fd.argtypes = [ctypes.c_void_p]
+    lib.onl_last_error.restype = ctypes.c_char_p
+    lib.onl_last_error.argtypes = [ctypes.c_void_p]
+    lib.onl_route_add.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_CRoute), ctypes.c_int
+    ]
+    lib.onl_route_del.argtypes = [ctypes.c_void_p, ctypes.POINTER(_CRoute)]
+    lib.onl_route_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_CRoute), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+    ]
+    for name in ("onl_routes_dump", "onl_links_dump", "onl_addrs_dump",
+                 "onl_next_events"):
+        getattr(lib, name).restype = ctypes.c_void_p  # manual free
+    lib.onl_routes_dump.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32
+    ]
+    lib.onl_links_dump.argtypes = [ctypes.c_void_p]
+    lib.onl_addrs_dump.argtypes = [ctypes.c_void_p]
+    lib.onl_next_events.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.onl_free.argtypes = [ctypes.c_void_p]
+    lib.onl_build_route_nlmsg.argtypes = [
+        ctypes.POINTER(_CRoute), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+    ]
+    lib.onl_parse_route_nlmsg.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.POINTER(_CRoute)
+    ]
+    lib.onl_abi_sizeof_route.restype = ctypes.c_uint32
+    # ABI guard: struct drift between the .py and .hpp copies is a
+    # memory-corruption bug — fail loudly at load time instead
+    expect = ctypes.sizeof(_CRoute)
+    got = lib.onl_abi_sizeof_route()
+    if got != expect:
+        _lib_err = f"ABI mismatch: C Route={got}B, ctypes={expect}B"
+        return None
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _json_result(lib, h, raw: int | None) -> list:
+    if not raw:
+        err = lib.onl_last_error(h).decode()
+        raise NetlinkError(err or "netlink dump failed")
+    try:
+        return json.loads(ctypes.string_at(raw).decode())
+    finally:
+        lib.onl_free(raw)
+
+
+class NetlinkSocket:
+    """One rtnetlink socket (reference: NetlinkProtocolSocket †).
+
+    Blocking; run via asyncio.to_thread from event-loop code. Pass
+    `groups` (RTMGRP_* bitmask) to subscribe to link/addr events and
+    drive `next_events`.
+    """
+
+    def __init__(self, groups: int = 0):
+        lib = _load()
+        if lib is None:
+            raise NetlinkError(_lib_err or "native netlink unavailable")
+        self._lib = lib
+        self._h = lib.onl_open(groups)
+        if not self._h:
+            raise NetlinkError(lib.onl_last_error(None).decode())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.onl_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _check(self, rc: int, what: str) -> None:
+        if rc != 0:
+            err = self._lib.onl_last_error(self._h).decode()
+            raise NetlinkError(rc, f"{what}: {err or os.strerror(-rc)}")
+
+    # ---- routes ----
+
+    def route_add(self, route: NetlinkRoute, replace: bool = True) -> None:
+        c = route.to_c()
+        self._check(
+            self._lib.onl_route_add(self._h, ctypes.byref(c), int(replace)),
+            f"route_add {route.dst or route.mpls_label}",
+        )
+
+    def route_del(self, route: NetlinkRoute) -> None:
+        c = route.to_c()
+        self._check(
+            self._lib.onl_route_del(self._h, ctypes.byref(c)),
+            f"route_del {route.dst or route.mpls_label}",
+        )
+
+    def route_batch(
+        self, routes: list[NetlinkRoute], delete: bool = False,
+        replace: bool = True,
+    ) -> list[int]:
+        """Pipelined add/del of many routes; returns per-route 0/-errno."""
+        if not routes:
+            return []
+        arr = (_CRoute * len(routes))(*[r.to_c() for r in routes])
+        errs = (ctypes.c_int32 * len(routes))()
+        self._lib.onl_route_batch(
+            self._h, arr, len(routes), int(delete), int(replace), errs
+        )
+        return list(errs)
+
+    def routes_dump(
+        self, family: int = 0, table: int = 0, protocol: int = 0
+    ) -> list[NetlinkRoute]:
+        raw = self._lib.onl_routes_dump(self._h, family, table, protocol)
+        return [
+            NetlinkRoute.from_json(d)
+            for d in _json_result(self._lib, self._h, raw)
+        ]
+
+    # ---- links / addrs / events ----
+
+    def links_dump(self) -> list[dict]:
+        return _json_result(
+            self._lib, self._h, self._lib.onl_links_dump(self._h)
+        )
+
+    def addrs_dump(self) -> list[dict]:
+        return _json_result(
+            self._lib, self._h, self._lib.onl_addrs_dump(self._h)
+        )
+
+    def next_events(self, timeout_ms: int = 1000) -> list[dict]:
+        return _json_result(
+            self._lib, self._h, self._lib.onl_next_events(self._h, timeout_ms)
+        )
+
+    # ---- kernel-free serialization (tests) ----
+
+    @staticmethod
+    def build_nlmsg(
+        route: NetlinkRoute, delete: bool = False, replace: bool = True
+    ) -> bytes:
+        lib = _load()
+        if lib is None:
+            raise NetlinkError(_lib_err or "native netlink unavailable")
+        c = route.to_c()
+        buf = (ctypes.c_uint8 * 4096)()
+        n = lib.onl_build_route_nlmsg(
+            ctypes.byref(c), int(delete), int(replace), buf, len(buf)
+        )
+        if n < 0:
+            raise NetlinkError("build_nlmsg failed")
+        return bytes(buf[:n])
+
+    @staticmethod
+    def parse_nlmsg(data: bytes) -> NetlinkRoute:
+        lib = _load()
+        if lib is None:
+            raise NetlinkError(_lib_err or "native netlink unavailable")
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        out = _CRoute()
+        if lib.onl_parse_route_nlmsg(buf, len(data), ctypes.byref(out)) != 0:
+            raise NetlinkError("parse_nlmsg failed")
+        # convert back through the JSON form for one canonical path
+        nhs = []
+        for i in range(out.num_nexthops):
+            cn = out.nh[i]
+            gw = None
+            if cn.af:
+                alen = 4 if cn.af == pysocket.AF_INET else 16
+                gw = str(ipaddress.ip_address(bytes(cn.gateway[:alen])))
+            nhs.append(
+                Nexthop(
+                    gateway=gw,
+                    ifindex=cn.ifindex,
+                    weight=cn.weight,
+                    labels=tuple(cn.labels[j] for j in range(cn.num_labels)),
+                )
+            )
+        if out.family == AF_MPLS:
+            return NetlinkRoute(
+                mpls_label=out.mpls_label, table=out.table,
+                protocol=out.protocol, priority=out.priority, nexthops=nhs,
+            )
+        alen = 4 if out.family == pysocket.AF_INET else 16
+        addr = ipaddress.ip_address(bytes(out.dst[:alen]))
+        return NetlinkRoute(
+            dst=f"{addr}/{out.dst_len}", table=out.table,
+            protocol=out.protocol, priority=out.priority, nexthops=nhs,
+        )

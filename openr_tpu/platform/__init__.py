@@ -1,0 +1,9 @@
+"""Platform layer: kernel route programming service.
+
+reference: openr/platform/ † — NetlinkFibHandler implements the
+Platform.thrift FibService on Linux via the native netlink library.
+"""
+
+from openr_tpu.platform.netlink_fib import NetlinkFibService
+
+__all__ = ["NetlinkFibService"]

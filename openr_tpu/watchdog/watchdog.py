@@ -33,10 +33,16 @@ def _current_rss_mb() -> float | None:
             pages = int(f.read().split()[1])
         return pages * os.sysconf("SC_PAGESIZE") / (1024 * 1024)
     except (OSError, ValueError, IndexError):
-        # non-Linux fallback: peak RSS (KiB on Linux, bytes on macOS —
-        # use KiB semantics; better than no check at all)
+        # non-Linux fallback: peak RSS (ru_maxrss is KiB on Linux but
+        # BYTES on Darwin; it is also the lifetime high-water mark, so
+        # this path re-admits the transient-spike false positive — it is
+        # a degraded fallback, not the design)
         try:
-            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+            import sys
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            div = 1024 * 1024 if sys.platform == "darwin" else 1024
+            return rss / div
         except Exception:  # noqa: BLE001
             return None
 
