@@ -1,0 +1,217 @@
+"""BASELINE config 5: incremental SPF under sustained link-flap churn.
+
+Measures, on one Decision module fed through its real publication path:
+  * steady-state recompute latency p50/p99 (full LSDB → RIB, using the
+    incremental CSR patch journal + device-array cache),
+  * flap → RouteUpdate end-to-end latency (publication push to route
+    delta emitted, including debounce),
+  * coalescing: flaps absorbed per recompute (debounce effectiveness).
+
+Run: python benchmarks/bench_churn.py [--nodes 1280] [--flaps-per-sec 1000]
+     [--seconds 10]
+Prints one JSON line (same contract as bench.py).
+
+reference analogue: openr/decision/tests/DecisionBenchmark.cpp † measures
+full rebuilds on synthetic grids; the reference has no incremental path —
+this harness exists to show churn does NOT cost a full rebuild here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+
+def build_decision(adj_dbs, prefix_dbs):
+    from openr_tpu.config import Config
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging import ReplicateQueue
+    from openr_tpu.types.kvstore import Publication, Value
+    from openr_tpu.types.serde import to_wire
+
+    cfg = Config.default(adj_dbs[0].this_node_name)
+    pubs = ReplicateQueue(name="pubs")
+    routes = ReplicateQueue(name="routes")
+    dec = Decision(cfg, pubs.get_reader("d"), routes, solver="tpu")
+
+    def pub_for(db, version=1):
+        return Publication(
+            area="0",
+            key_vals={
+                f"adj:{db.this_node_name}": Value(
+                    version=version,
+                    originator_id=db.this_node_name,
+                    value=to_wire(db),
+                ).with_hash()
+            },
+        )
+
+    for db in adj_dbs:
+        dec.process_publication(pub_for(db))
+    from openr_tpu.common import constants as C
+
+    for pdb in prefix_dbs:
+        for entry in pdb.prefix_entries:
+            dec.process_publication(
+                Publication(
+                    area="0",
+                    key_vals={
+                        C.prefix_key(
+                            pdb.this_node_name, "0", str(entry.prefix)
+                        ): Value(
+                            version=1,
+                            originator_id=pdb.this_node_name,
+                            value=to_wire(pdb),
+                        ).with_hash()
+                    },
+                )
+            )
+    return dec, pubs, routes, pub_for
+
+
+async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
+    """Flap link metrics at the target rate while Decision runs live."""
+    import dataclasses
+
+    from openr_tpu.messaging import QueueClosedError
+
+    await dec.start()
+    reader = routes.get_reader("bench")
+    # LSDB was loaded synchronously before start: trigger + await the
+    # first full RIB (includes the one-time jit compile)
+    dec.debounce.poke()
+    await asyncio.wait_for(dec.rib_computed.wait(), 600)
+
+    rng = np.random.default_rng(7)
+    flap_t: dict[int, float] = {}  # flap seq -> send time
+    got_t: list[float] = []  # flap→update latencies
+    spf_ms: list[float] = []
+    versions = {db.this_node_name: 1 for db in adj_dbs}
+    n_flaps = 0
+    stop = time.perf_counter() + seconds
+    interval = 1.0 / flaps_per_sec
+
+    async def drain():
+        while True:
+            try:
+                upd = await reader.get()
+            except QueueClosedError:
+                return
+            now = time.perf_counter()
+            # only credit flaps published BEFORE the snapshot behind this
+            # update — later flaps land in the NEXT rebuild and counting
+            # them here would deflate the reported latency
+            cutoff = dec._last_emitted_snapshot_t0
+            for seq, t0 in list(flap_t.items()):
+                if t0 <= cutoff:
+                    got_t.append((now - t0) * 1e3)
+                    del flap_t[seq]
+            _ = upd
+
+    drainer = asyncio.ensure_future(drain())
+    next_send = time.perf_counter()
+    base_spf_runs = dec._spf_runs
+    while time.perf_counter() < stop:
+        i = int(rng.integers(0, len(adj_dbs)))
+        db = adj_dbs[i]
+        k = int(rng.integers(0, len(db.adjacencies)))
+        new_adjs = list(db.adjacencies)
+        a = new_adjs[k]
+        new_adjs[k] = dataclasses.replace(
+            a, metric=int(rng.integers(1, 64))
+        )
+        db = dataclasses.replace(db, adjacencies=tuple(new_adjs))
+        adj_dbs[i] = db
+        versions[db.this_node_name] += 1
+        flap_t[n_flaps] = time.perf_counter()
+        dec.process_publication(
+            pub_for(db, version=versions[db.this_node_name])
+        )
+        dec.debounce.poke()
+        if dec._last_spf_ms:
+            spf_ms.append(dec._last_spf_ms)
+        n_flaps += 1
+        next_send += interval
+        delay = next_send - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        else:
+            await asyncio.sleep(0)  # yield so Decision can run
+    # let the tail drain
+    await asyncio.sleep(1.0)
+    spf_runs = dec._spf_runs - base_spf_runs
+    drainer.cancel()
+    await dec.stop()
+    return n_flaps, spf_runs, spf_ms, got_t
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1280)
+    ap.add_argument("--flaps-per-sec", type=float, default=1000.0)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument(
+        "--backend", choices=("auto", "cpu"), default="auto",
+        help="cpu forces jax onto host CPU (the axon sitecustomize "
+        "overrides JAX_PLATFORMS env, so the config must be set "
+        "in-process before backend init)",
+    )
+    args = ap.parse_args()
+    if args.backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from openr_tpu.utils import topogen
+
+    # 3-tier fat-tree with ~args.nodes nodes: 5k^2/4 = n → k
+    k = max(4, int(round((args.nodes * 4 / 5) ** 0.5 / 2)) * 2)
+    adj_dbs, prefix_dbs = topogen.fat_tree(k, metric=10)
+    dec, pubs, routes, pub_for = build_decision(adj_dbs, prefix_dbs)
+
+    n_flaps, spf_runs, spf_ms, lat = asyncio.new_event_loop().run_until_complete(
+        churn(
+            dec, pubs, routes, pub_for, list(adj_dbs),
+            args.flaps_per_sec, args.seconds,
+        )
+    )
+    spf = np.array(spf_ms) if spf_ms else np.array([0.0])
+    latency = np.array(lat) if lat else np.array([0.0])
+    out = {
+        "metric": "churn_steady_state_recompute_p50_ms",
+        "value": round(float(np.percentile(spf, 50)), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "detail": {
+            "config": 5,
+            "nodes": len(adj_dbs),
+            "k": k,
+            "flaps_sent": n_flaps,
+            "flap_rate_target": args.flaps_per_sec,
+            "recomputes": spf_runs,
+            "flaps_per_recompute": round(n_flaps / max(spf_runs, 1), 1),
+            "spf_p99_ms": round(float(np.percentile(spf, 99)), 3),
+            "flap_to_rib_p50_ms": round(float(np.percentile(latency, 50)), 3),
+            "flap_to_rib_p99_ms": round(float(np.percentile(latency, 99)), 3),
+            "backend": _backend(),
+        },
+    }
+    print(json.dumps(out))
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+if __name__ == "__main__":
+    main()

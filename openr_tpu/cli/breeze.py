@@ -111,6 +111,21 @@ def status(ctx):
         click.echo(f"  {gate}: {'pass' if st.get(gate) else 'PENDING'}")
 
 
+@cli.command()
+@click.pass_context
+def validate(ctx):
+    """End-to-end health cross-checks; exit 1 on any failure
+    (reference: openr validate †)."""
+    res = _run(ctx, "validate")
+    for c in res["checks"]:
+        mark = "PASS" if c["pass"] else "FAIL"
+        detail = f"  ({c['detail']})" if c.get("detail") else ""
+        click.echo(f"[{mark}] {c['name']}{detail}")
+    if not res["pass"]:
+        raise SystemExit(1)
+    click.echo("all checks passed")
+
+
 # ------------------------------------------------------------------- kvstore
 
 
@@ -306,6 +321,18 @@ def decision_received(ctx):
         for pfx, nodes in sorted(prefixes.items()):
             rows.append([area, pfx, ",".join(nodes)])
     click.echo(_table(rows, ["area", "prefix", "advertised-by"]))
+
+
+@decision.command("rib-policy")
+@click.pass_context
+def decision_rib_policy(ctx):
+    """Show the installed RibPolicy (reference: breeze decision
+    rib-policy †)."""
+    res = _run(ctx, "get_rib_policy")
+    if not res.get("policy"):
+        click.echo("no rib policy installed")
+        return
+    click.echo(json.dumps(res, indent=2, sort_keys=True))
 
 
 # ----------------------------------------------------------------------- fib

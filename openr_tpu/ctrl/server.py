@@ -121,7 +121,7 @@ class CtrlServer(OpenrModule):
             "get_my_node_name", "get_initialization_status", "get_counters",
             "get_kvstore_keyvals", "set_kvstore_keyvals", "dump_kvstore",
             "get_kvstore_areas", "get_kvstore_peers",
-            "get_kvstore_flood_topo",
+            "get_kvstore_flood_topo", "validate",
             "get_route_db_computed", "get_route_db_programmed",
             "get_decision_adjacency_dbs", "get_received_routes",
             "get_interfaces", "set_node_overload", "set_interface_metric",
@@ -213,6 +213,92 @@ class CtrlServer(OpenrModule):
     async def get_kvstore_flood_topo(self, params: dict) -> dict:
         """DUAL flood-optimization SPT (reference: getSptInfos †)."""
         return self.node.kvstore.get_flood_topo(self._area(params))
+
+    async def validate(self, params: dict) -> dict:
+        """End-to-end node health cross-checks (reference: the `openr
+        validate` checker †): initialization gates, Spark↔LSDB
+        adjacency consistency, own keys present in KvStore, computed vs
+        programmed route convergence, watchdog state."""
+        from openr_tpu.common import constants as C
+        from openr_tpu.spark.spark import SparkNeighborState
+
+        n = self.node
+        checks: list[dict] = []
+
+        def check(name: str, ok: bool, detail: str = "") -> None:
+            checks.append({"name": name, "pass": bool(ok), "detail": detail})
+
+        st = await self.get_initialization_status({})
+        for gate in ("KVSTORE_SYNCED", "RIB_COMPUTED", "FIB_SYNCED"):
+            check(f"init.{gate}", bool(st.get(gate)))
+
+        # Spark ESTABLISHED neighbors all appear in our advertised adj db
+        established = {
+            nname
+            for (_ifn, nname), nb in n.spark.neighbors.items()
+            if nb.state == SparkNeighborState.ESTABLISHED
+        }
+        advertised = set()
+        for area in n.config.area_ids():
+            v = n.kvstore.get_key(area, C.adj_key(n.name))
+            if v is not None and v.value is not None:
+                from openr_tpu.types.serde import from_wire
+                from openr_tpu.types.topology import AdjacencyDatabase
+
+                db = from_wire(v.value, AdjacencyDatabase)
+                advertised |= {a.other_node_name for a in db.adjacencies}
+        missing = established - advertised
+        check(
+            "spark.neighbors_advertised",
+            not missing,
+            f"established-but-unadvertised: {sorted(missing)}" if missing else
+            f"{len(established)} neighbors",
+        )
+
+        # Decision's LSDB contains our own adjacency db (flood loopback)
+        in_lsdb = any(
+            n.decision.link_states[a].adjacency_db(n.name) is not None
+            for a in n.decision.link_states
+        )
+        check("decision.own_adj_in_lsdb", in_lsdb or not established)
+
+        # computed RIB vs programmed FIB convergence — compare route
+        # VALUES, not key sets: a nexthop change stuck in the retry loop
+        # leaves the same prefixes programmed with stale contents
+        desired_u = {
+            p: e.to_unicast_route() for p, e in n.fib.desired_unicast.items()
+        }
+        desired_m = {
+            lbl: e.to_mpls_route() for lbl, e in n.fib.desired_mpls.items()
+        }
+        stale = [
+            str(p) for p, r in desired_u.items()
+            if n.fib.programmed_unicast.get(p) != r
+        ] + [p for p in map(str, n.fib.programmed_unicast) if p not in
+             {str(q) for q in desired_u}]
+        stale_m = [
+            lbl for lbl, r in desired_m.items()
+            if n.fib.programmed_mpls.get(lbl) != r
+        ] + [lbl for lbl in n.fib.programmed_mpls if lbl not in desired_m]
+        check(
+            "fib.converged",
+            desired_u == n.fib.programmed_unicast
+            and desired_m == n.fib.programmed_mpls,
+            f"rib={len(n.decision.rib.unicast_routes)} "
+            f"desired={len(desired_u)}u/{len(desired_m)}m "
+            f"stale={stale[:3]}{stale_m[:3]}" if stale or stale_m else
+            f"rib={len(n.decision.rib.unicast_routes)} "
+            f"desired={len(desired_u)}u/{len(desired_m)}m programmed-ok",
+        )
+
+        # watchdog has not fired
+        wd = getattr(n, "watchdog", None)
+        check("watchdog.healthy", wd is None or not wd.fired)
+
+        return {
+            "pass": all(c["pass"] for c in checks),
+            "checks": checks,
+        }
 
     async def subscribe_kvstore(self, params: dict, stream) -> None:
         """reference: subscribeAndGetKvStoreFiltered † (thrift server-stream):

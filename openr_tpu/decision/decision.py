@@ -150,6 +150,10 @@ class Decision(OpenrModule):
         self.rib_policy = None  # set via apply_rib_policy (openr_tpu.policy)
         self._spf_runs = 0
         self._last_spf_ms = 0.0
+        # perf_counter() of the snapshot behind the most recently
+        # EMITTED RouteUpdate (benchmarks use it to attribute a flap to
+        # the rebuild that actually contained it)
+        self._last_emitted_snapshot_t0 = 0.0
 
     # ------------------------------------------------------------------ run
 
@@ -277,6 +281,7 @@ class Decision(OpenrModule):
         first = not self.rib_computed.is_set()
         update = diff_route_dbs(self.rib, new_rib)
         self.rib = new_rib
+        self._last_emitted_snapshot_t0 = t0
         if first:
             update.type = RouteUpdateType.FULL_SYNC
             self.rib_computed.set()

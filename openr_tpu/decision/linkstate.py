@@ -13,6 +13,7 @@ power-of-two buckets; invalid slots are masked with `INF_METRIC`.
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field, replace
 
 import numpy as np
@@ -30,6 +31,27 @@ from openr_tpu.types.network import IpPrefix
 # Metric sentinel for masked/invalid edge slots. Valid metrics are clamped
 # to METRIC_MAX so the int32 relax step in ops/spf.py cannot overflow.
 INF_METRIC = DIST_INF
+
+# process-wide monotonic CsrGraph version counter (anchors patch journals)
+_csr_version = itertools.count(1)
+
+
+@dataclass(frozen=True)
+class MetricPatch:
+    """One metric-only edge update in a CsrGraph patch journal.
+
+    reference analogue: the reference's LinkState SPF-cache invalidation
+    distinguishes LINK_ATTRIBUTES changes from topology changes †; this is
+    the rebuild's sharper version — a metric-only change is *data*, so it
+    patches the padded arrays (host and device) instead of rebuilding
+    them. `edge_idx` is the slot in the edge-list arrays, (dense_row,
+    dense_col) the slot in the dense in-neighbor tables.
+    """
+
+    edge_idx: int
+    dense_row: int
+    dense_col: int
+    metric: int
 
 
 @dataclass
@@ -63,6 +85,15 @@ class CsrGraph:
     name_to_id: dict[str, int]
     _dense: tuple[np.ndarray, np.ndarray] | None = None
     _dense_width: int | None = None
+    # --- incremental-churn support ------------------------------------
+    # (src_id, dst_id) -> edge-array slot (built once per base)
+    edge_index: dict[tuple[int, int], int] = field(default_factory=dict)
+    # unique id of this materialization; patched copies keep the base's
+    # id in `base_version` plus the cumulative journal that produced them,
+    # so the TPU backend can scatter-update device-resident arrays
+    version: int = 0
+    base_version: int = 0
+    patches: tuple["MetricPatch", ...] = ()
 
     @property
     def padded_nodes(self) -> int:
@@ -100,6 +131,46 @@ class CsrGraph:
             )
         return self._dense
 
+    def dense_col(self, edge_idx: int, dst: int) -> int:
+        """Dense-table column of edge slot `edge_idx` (the dense layout
+        follows the dst-sorted edge order, so the column is the rank of
+        the edge within its destination's run)."""
+        first = int(
+            np.searchsorted(
+                self.edge_dst[: self.num_edges], dst, side="left"
+            )
+        )
+        return edge_idx - first
+
+
+def _metric_only_delta(
+    old: AdjacencyDatabase, new: AdjacencyDatabase
+) -> list[Adjacency] | None:
+    """The adjacencies whose metric (or rtt) changed, or None if anything
+    *structural* differs (adjacency set, overload bits, labels, weights —
+    those need a full CSR rebuild)."""
+    if (
+        old.this_node_name != new.this_node_name
+        or old.is_overloaded != new.is_overloaded
+        or old.node_label != new.node_label
+        or len(old.adjacencies) != len(new.adjacencies)
+    ):
+        return None
+    delta: list[Adjacency] = []
+    for oa, na in zip(old.adjacencies, new.adjacencies):
+        if (
+            oa.other_node_name != na.other_node_name
+            or oa.if_name != na.if_name
+            or oa.other_if_name != na.other_if_name
+            or oa.adj_label != na.adj_label
+            or oa.is_overloaded != na.is_overloaded
+            or oa.weight != na.weight
+        ):
+            return None
+        if oa.metric != na.metric or oa.rtt_us != na.rtt_us:
+            delta.append(na)
+    return delta
+
 
 class LinkState:
     """The per-area adjacency graph (reference: openr/decision/LinkState †).
@@ -125,6 +196,12 @@ class LinkState:
         # Mutation replaces the cell instead of clearing it, so snapshots
         # taken before the change keep their own still-valid cache.
         self._csr_cell: list[CsrGraph | None] = [None]
+        # metric-only changes since the base CSR in the cell: applied
+        # copy-on-write at to_csr() time (one array copy per solve, not
+        # per flap), so churn never pays the O(E) python rebuild.
+        # Rebound (never mutated in place) so snapshots stay consistent.
+        self._pending: list[tuple[str, Adjacency]] = []
+        self._patched: CsrGraph | None = None
 
     # ---- mutation ---------------------------------------------------------
 
@@ -138,13 +215,29 @@ class LinkState:
         if old == db:
             return False
         self._adj_dbs[db.this_node_name] = db
+        base = self._csr_cell[0]
+        if base is not None and old is not None:
+            delta = _metric_only_delta(old, db)
+            if delta is not None and (
+                len(self._pending) + len(delta)
+                <= max(64, base.num_edges // 8)  # compaction cap
+            ):
+                self._pending = self._pending + [
+                    (db.this_node_name, a) for a in delta
+                ]
+                self._patched = None
+                return True
         self._csr_cell = [None]
+        self._pending = []
+        self._patched = None
         return True
 
     def delete_adjacency_db(self, node: str) -> bool:
         if node in self._adj_dbs:
             del self._adj_dbs[node]
             self._csr_cell = [None]
+            self._pending = []
+            self._patched = None
             return True
         return False
 
@@ -156,6 +249,10 @@ class LinkState:
         snap = LinkState(self.area)
         snap._adj_dbs = dict(self._adj_dbs)
         snap._csr_cell = self._csr_cell
+        # pending/patched are rebound on mutation, never mutated, so
+        # sharing the current references is race-free
+        snap._pending = self._pending
+        snap._patched = self._patched
         return snap
 
     # ---- queries ----------------------------------------------------------
@@ -178,10 +275,64 @@ class LinkState:
     # ---- CSR materialization ---------------------------------------------
 
     def to_csr(self) -> CsrGraph:
-        """Build (or return cached) padded CSR arrays for the solver."""
+        """Build (or return cached) padded CSR arrays for the solver.
+
+        With metric-only churn pending, returns a copy-on-write patched
+        view of the cached base — O(E) numpy copies + O(patches) fixups
+        instead of the O(E) python rebuild — carrying the cumulative
+        patch journal for the solver's device-array cache.
+        """
         if self._csr_cell[0] is None:
             self._csr_cell[0] = self._build_csr()
-        return self._csr_cell[0]
+            self._pending = []
+            self._patched = None
+        base = self._csr_cell[0]
+        if not self._pending:
+            return base
+        if self._patched is None:
+            self._patched = self._apply_pending(base, self._pending)
+        return self._patched
+
+    def _apply_pending(
+        self, base: CsrGraph, pending: list[tuple[str, Adjacency]]
+    ) -> CsrGraph:
+        new_metric = base.edge_metric.copy()
+        details = dict(base.adj_details)  # shallow; touched lists replaced
+        dense = base._dense
+        wgt = dense[1].copy() if dense is not None else None
+        touched: dict[tuple[int, int], list[list]] = {}
+        for node, adj in pending:
+            u = base.name_to_id.get(node)
+            w = base.name_to_id.get(adj.other_node_name)
+            if u is None or w is None:
+                continue
+            key = (u, w)
+            if key not in base.edge_index:
+                continue  # edge unusable in base (one-sided/overloaded)
+            lst = touched.get(key)
+            if lst is None:
+                lst = touched[key] = [list(d) for d in details[key]]
+            for d in lst:
+                if d[0] == adj.if_name and d[4] == adj.other_if_name:
+                    d[1] = int(adj.metric)
+        journal = list(base.patches)
+        for key, lst in touched.items():
+            details[key] = [tuple(d) for d in lst]
+            m = min(min(d[1] for d in lst), METRIC_MAX)
+            idx = base.edge_index[key]
+            new_metric[idx] = m
+            col = base.dense_col(idx, key[1])
+            if wgt is not None:
+                wgt[key[1], col] = m
+            journal.append(MetricPatch(idx, key[1], col, int(m)))
+        return replace(
+            base,
+            edge_metric=new_metric,
+            adj_details=details,
+            _dense=(dense[0], wgt) if dense is not None else None,
+            version=next(_csr_version),
+            patches=tuple(journal),
+        )
 
     def _build_csr(self) -> CsrGraph:
         names = sorted(self._adj_dbs)  # deterministic interning
@@ -241,10 +392,12 @@ class LinkState:
 
         # Sort by destination for contiguous segment reduction.
         items = sorted(edge_best.items(), key=lambda kv: (kv[0][1], kv[0][0]))
+        edge_index: dict[tuple[int, int], int] = {}
         for i, ((s, d), m) in enumerate(items):
             edge_src[i] = s
             edge_dst[i] = d
             edge_metric[i] = min(m, METRIC_MAX)
+            edge_index[(s, d)] = i
 
         node_overloaded = np.zeros(vp, dtype=bool)
         node_mask = np.zeros(vp, dtype=bool)
@@ -252,6 +405,7 @@ class LinkState:
             node_mask[i] = True
             node_overloaded[i] = self._adj_dbs[n].is_overloaded
 
+        ver = next(_csr_version)
         return CsrGraph(
             num_nodes=v,
             num_edges=e,
@@ -263,6 +417,9 @@ class LinkState:
             node_names=names,
             adj_details=adj_details,
             name_to_id=name_to_id,
+            edge_index=edge_index,
+            version=ver,
+            base_version=ver,
         )
 
 

@@ -59,6 +59,75 @@ class TpuSpfSolver:
     def __init__(self, use_dense: bool | None = None, dense_waste_limit: int = 8):
         self.use_dense = use_dense
         self.dense_waste_limit = dense_waste_limit
+        # device-resident LSDB arrays keyed by the CSR's base version
+        # (one entry per area's topology; small LRU): metric-only churn
+        # arrives as a patch journal (linkstate.py MetricPatch) and is
+        # applied by scatter on device instead of re-uploading O(E)
+        # arrays per rebuild (SURVEY §7 step 5: "device-resident LSDB
+        # updated by scatter")
+        self._dev: dict[int, dict] = {}
+        self._dev_lru_cap = 4
+
+    def _device_arrays(self, csr, use_dense: bool):
+        """Cached (and incrementally patched) device copies of the LSDB."""
+        cache = self._dev.get(csr.base_version)
+        if (
+            cache is not None
+            and cache["dense"] == use_dense
+            # journals are cumulative per base, so patching forward is
+            # always correct; a solve against an OLDER snapshot than the
+            # cache has applied cannot be patched backward — re-upload
+            and csr.version >= cache["version"]
+        ):
+            if cache["version"] != csr.version:
+                # journal entries are idempotent .set()s and cumulative
+                # per base, so applying the full journal is always correct
+                if csr.patches:
+                    if use_dense:
+                        rows = jnp.asarray(
+                            [p.dense_row for p in csr.patches], jnp.int32
+                        )
+                        cols = jnp.asarray(
+                            [p.dense_col for p in csr.patches], jnp.int32
+                        )
+                        vals = jnp.asarray(
+                            [p.metric for p in csr.patches], jnp.int32
+                        )
+                        cache["wgt"] = cache["wgt"].at[rows, cols].set(vals)
+                    else:
+                        idxs = jnp.asarray(
+                            [p.edge_idx for p in csr.patches], jnp.int32
+                        )
+                        vals = jnp.asarray(
+                            [p.metric for p in csr.patches], jnp.int32
+                        )
+                        cache["metric"] = (
+                            cache["metric"].at[idxs].set(vals)
+                        )
+                cache["version"] = csr.version
+            return cache
+        cache = {
+            "version": csr.version,
+            "dense": use_dense,
+        }
+        if use_dense:
+            nbr, wgt = csr.dense_tables()
+            cache["nbr"] = jnp.asarray(nbr)
+            cache["wgt"] = jnp.asarray(wgt)
+            cache["over"] = jnp.asarray(csr.node_overloaded)
+        else:
+            blocked = build_blocked(
+                csr.edge_metric, csr.edge_src, csr.node_overloaded
+            )
+            cache["src"] = jnp.asarray(csr.edge_src)
+            cache["dst"] = jnp.asarray(csr.edge_dst)
+            cache["metric"] = jnp.asarray(csr.edge_metric)
+            cache["blocked"] = jnp.asarray(blocked)
+        self._dev.pop(csr.base_version, None)  # refresh LRU position
+        self._dev[csr.base_version] = cache
+        while len(self._dev) > self._dev_lru_cap:
+            self._dev.pop(next(iter(self._dev)))
+        return cache
 
     def _solve_dist(self, csr, roots: np.ndarray) -> np.ndarray:
         use_dense = self.use_dense
@@ -69,23 +138,20 @@ class TpuSpfSolver:
             use_dense = (
                 table_slots <= self.dense_waste_limit * max(csr.num_edges, 1)
             )
+        dev = self._device_arrays(csr, use_dense)
         if use_dense:
-            nbr, wgt = csr.dense_tables()
             return batched_sssp_dense(
-                jnp.asarray(nbr),
-                jnp.asarray(wgt),
-                jnp.asarray(csr.node_overloaded),
+                dev["nbr"],
+                dev["wgt"],
+                dev["over"],
                 jnp.asarray(roots),
                 has_overloads=bool(csr.node_overloaded.any()),
             )
-        blocked = build_blocked(
-            csr.edge_metric, csr.edge_src, csr.node_overloaded
-        )
         return batched_sssp(
-            jnp.asarray(csr.edge_src),
-            jnp.asarray(csr.edge_dst),
-            jnp.asarray(csr.edge_metric),
-            jnp.asarray(blocked),
+            dev["src"],
+            dev["dst"],
+            dev["metric"],
+            dev["blocked"],
             jnp.asarray(roots),
             csr.padded_nodes,
         )
@@ -145,6 +211,11 @@ class TpuSpfSolver:
         csr, dist, fh, nbr_ids = solved
         my_id = csr.name_to_id[my_node]
         d_root = dist[:, 0]  # [Vp]
+        # hoisted out of the per-prefix loop: "does ANY neighbor serve as
+        # a first hop toward node X" is O(B) per node — scanning it per
+        # prefix made RIB assembly O(P·B·V) and dominated churn rebuilds
+        fh_any = fh.any(axis=0)  # [Vp]
+        slot_cache = self._nbr_slot_cache(csr, my_id, nbr_ids)
 
         # ---- unicast ------------------------------------------------------
         adjmap = None  # lazy host adjacency for KSP2 prefixes only
@@ -158,7 +229,7 @@ class TpuSpfSolver:
                 elif (
                     nid is not None
                     and d_root[nid] < INF_DIST
-                    and fh[:, nid].any()
+                    and fh_any[nid]
                 ):
                     reachable[n] = e
             if not reachable:
@@ -199,6 +270,7 @@ class TpuSpfSolver:
                 csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area,
                 weights=weights,
                 target_names=csr.node_names,
+                slot_cache=slot_cache,
             )
             if not nexthops:
                 continue
@@ -220,11 +292,12 @@ class TpuSpfSolver:
             nid = csr.name_to_id[node]
             if label < MPLS_LABEL_MIN or node == my_node:
                 continue
-            if d_root[nid] >= INF_DIST or not fh[:, nid].any():
+            if d_root[nid] >= INF_DIST or not fh_any[nid]:
                 continue
             igp = int(d_root[nid])
             base = self._mk_nexthops(
-                csr, my_id, nbr_ids, fh, np.array([nid]), igp, ls.area
+                csr, my_id, nbr_ids, fh, np.array([nid]), igp, ls.area,
+                slot_cache=slot_cache,
             )
             nhs = tuple(
                 NextHop(
@@ -270,6 +343,27 @@ class TpuSpfSolver:
         return rdb
 
     @staticmethod
+    def _nbr_slot_cache(
+        csr: CsrGraph, my_id: int, nbr_ids: list[int]
+    ) -> list[list[tuple[str, str]]]:
+        """Per-neighbor (fh_name, if_name) slots at the neighbor's
+        min-metric parallel links — hoisted out of the per-prefix loop
+        (it only depends on my own adjacencies, not the target)."""
+        cache: list[list[tuple[str, str]]] = []
+        for fh_id in nbr_ids:
+            details = csr.adj_details[(my_id, fh_id)]
+            best = min(d[1] for d in details)
+            fh_name = csr.node_names[fh_id]
+            cache.append(
+                [
+                    (fh_name, if_name)
+                    for if_name, m, _w, _lbl, _oif in details
+                    if m == best
+                ]
+            )
+        return cache
+
+    @staticmethod
     def _mk_nexthops(
         csr: CsrGraph,
         my_id: int,
@@ -280,25 +374,21 @@ class TpuSpfSolver:
         area: str,
         weights: dict[str, int] | None = None,
         target_names=None,
+        slot_cache: list[list[tuple[str, str]]] | None = None,
     ) -> tuple[NextHop, ...]:
         """Union of valid first-hop interfaces toward `targets` (all at the
         same IGP distance). Parallel links at min metric each get a nexthop.
         With `weights` (UCMP), nexthop weight = gcd-normalized sum of the
         weights of the targets it serves — identical rule to the oracle's
         _nexthops_to_nodes."""
+        if slot_cache is None:
+            slot_cache = TpuSpfSolver._nbr_slot_cache(csr, my_id, nbr_ids)
         slots: dict[tuple[str, str], None] = {}
         wsum: dict[tuple[str, str], int] = {}
         for tgt in targets:
             valid = np.nonzero(fh[:, int(tgt)])[0]
             for n_idx in valid:
-                fh_id = nbr_ids[int(n_idx)]
-                details = csr.adj_details[(my_id, fh_id)]
-                best = min(d[1] for d in details)
-                fh_name = csr.node_names[fh_id]
-                for if_name, m, _w, _lbl, _oif in details:
-                    if m != best:
-                        continue
-                    key = (fh_name, if_name)
+                for key in slot_cache[int(n_idx)]:
                     slots[key] = None
                     if weights is not None:
                         wsum[key] = (

@@ -244,3 +244,26 @@ def test_set_interface_metric_changes_path():
         await c.stop()
 
     run(body())
+
+
+def test_validate_healthy_cluster():
+    """`validate` passes on a converged cluster and reports each check
+    (reference: openr validate †)."""
+
+    async def main():
+        c = await _converged_cluster()
+        try:
+            cli = await _client_for(c.nodes["b"])
+            res = await cli.call("validate", {})
+            assert res["pass"], res
+            names = {chk["name"] for chk in res["checks"]}
+            assert {
+                "init.KVSTORE_SYNCED", "init.RIB_COMPUTED",
+                "init.FIB_SYNCED", "spark.neighbors_advertised",
+                "fib.converged",
+            } <= names
+            await cli.close()
+        finally:
+            await c.stop()
+
+    run(main())
