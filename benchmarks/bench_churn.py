@@ -119,6 +119,8 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
     drainer = asyncio.ensure_future(drain())
     next_send = time.perf_counter()
     base_spf_runs = dec._spf_runs
+    last_runs = dec._spf_runs
+    no_change_flaps = [0]
     while time.perf_counter() < stop:
         i = int(rng.integers(0, len(adj_dbs)))
         db = adj_dbs[i]
@@ -136,8 +138,21 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
             pub_for(db, version=versions[db.this_node_name])
         )
         dec.debounce.poke()
-        if dec._last_spf_ms:
+        # one recompute-latency sample PER RECOMPUTE (flap-weighted
+        # sampling would duplicate the pre-churn value hundreds of times)
+        if dec._spf_runs != last_runs:
+            last_runs = dec._spf_runs
             spf_ms.append(dec._last_spf_ms)
+        # flaps proven to have produced no route change (their rebuild
+        # completed without emitting) are dropped, not timed forever
+        emitted, completed = (
+            dec._last_emitted_snapshot_t0, dec._last_completed_snapshot_t0
+        )
+        if completed > emitted:
+            for seq, t in list(flap_t.items()):
+                if emitted < t <= completed:
+                    del flap_t[seq]
+                    no_change_flaps[0] += 1
         n_flaps += 1
         next_send += interval
         delay = next_send - time.perf_counter()
@@ -150,7 +165,7 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
     spf_runs = dec._spf_runs - base_spf_runs
     drainer.cancel()
     await dec.stop()
-    return n_flaps, spf_runs, spf_ms, got_t
+    return n_flaps, spf_runs, spf_ms, got_t, no_change_flaps[0]
 
 
 def main() -> None:
@@ -177,7 +192,7 @@ def main() -> None:
     adj_dbs, prefix_dbs = topogen.fat_tree(k, metric=10)
     dec, pubs, routes, pub_for = build_decision(adj_dbs, prefix_dbs)
 
-    n_flaps, spf_runs, spf_ms, lat = asyncio.new_event_loop().run_until_complete(
+    n_flaps, spf_runs, spf_ms, lat, no_change = asyncio.new_event_loop().run_until_complete(
         churn(
             dec, pubs, routes, pub_for, list(adj_dbs),
             args.flaps_per_sec, args.seconds,
@@ -198,6 +213,7 @@ def main() -> None:
             "flap_rate_target": args.flaps_per_sec,
             "recomputes": spf_runs,
             "flaps_per_recompute": round(n_flaps / max(spf_runs, 1), 1),
+            "no_change_flaps": no_change,
             "spf_p99_ms": round(float(np.percentile(spf, 99)), 3),
             "flap_to_rib_p50_ms": round(float(np.percentile(latency, 50)), 3),
             "flap_to_rib_p99_ms": round(float(np.percentile(latency, 99)), 3),

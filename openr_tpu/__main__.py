@@ -1,0 +1,148 @@
+"""Process entry point: one real Open/R node.
+
+reference: openr/Main.cpp † — parse config, construct all queues and
+modules in dependency order, start servers, install signal handlers,
+run until stopped, tear down in reverse order.
+
+    python -m openr_tpu --config node.json [--dataplane netlink|none]
+
+Dataplanes:
+  * ``netlink`` — real router mode: kernel interfaces feed LinkMonitor
+    through the native netlink event source, and routes are programmed
+    into the kernel FIB via the native library (requires CAP_NET_ADMIN
+    and `make -C native`).
+  * ``none`` (default) — control-plane overlay mode: interfaces are the
+    static point-to-point UDP links from `udp_interfaces` in the config
+    and the FIB handler is the in-memory mock (useful for multi-host
+    control-plane deployments and development).
+
+KvStore peering and the ctrl API listen on `kvstore_port` / `ctrl_port`
+at `endpoint_host`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from openr_tpu.config import Config
+from openr_tpu.fib import MockFibHandler
+from openr_tpu.kvstore import TcpKvTransport
+from openr_tpu.node import OpenrNode
+from openr_tpu.rpc import RpcServer
+from openr_tpu.spark.io import UdpIoProvider
+from openr_tpu.types.events import InterfaceEvent, InterfaceInfo
+
+log = logging.getLogger("openr_tpu.main")
+
+
+async def run_node(config: Config, dataplane: str, store_path: str | None):
+    io = UdpIoProvider()
+    for u in config.node.udp_interfaces:
+        await io.add_interface(
+            u.if_name, u.local_port, (u.peer_host, u.peer_port)
+        )
+
+    if dataplane == "netlink":
+        from openr_tpu.platform import NetlinkFibService
+
+        fib_handler = NetlinkFibService()
+    else:
+        fib_handler = MockFibHandler()
+
+    host = config.node.endpoint_host
+    # KvStore peering listener FIRST: its bound port (ephemeral-capable)
+    # is what Spark advertises to neighbors (reference: the thrift
+    # server carrying KvStore peer sessions †)
+    kv_rpc = RpcServer(f"{config.node_name}.kv")
+    kv_port = await kv_rpc.start(host, config.node.kvstore_port)
+    log.info("kvstore peering on %s:%d", host, kv_port)
+
+    node = OpenrNode(
+        config,
+        io,
+        TcpKvTransport(),
+        fib_handler=fib_handler,
+        kvstore_port=kv_port,
+        endpoint_host=host,
+        enable_ctrl=True,
+        ctrl_port=config.node.ctrl_port,
+        store_path=store_path,
+    )
+    node.kvstore.register_rpc(kv_rpc)
+
+    iface_src = None
+    if dataplane == "netlink":
+        from openr_tpu.nl.interface_source import NetlinkInterfaceSource
+
+        iface_src = NetlinkInterfaceSource(
+            node.name, node.interface_events, counters=node.counters
+        )
+
+    await node.start()
+    if iface_src is not None:
+        await iface_src.start()
+    elif config.node.udp_interfaces:
+        node.interface_events.push(
+            InterfaceEvent(
+                interfaces=[
+                    InterfaceInfo(name=u.if_name, is_up=True)
+                    for u in config.node.udp_interfaces
+                ]
+            )
+        )
+    log.info(
+        "node %s up (ctrl %s:%d, dataplane=%s)",
+        node.name, host, node.ctrl.port if node.ctrl else 0, dataplane,
+    )
+
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop_ev.set)
+    await stop_ev.wait()
+
+    log.info("shutting down")
+    if iface_src is not None:
+        await iface_src.stop()
+    await node.stop()
+    await kv_rpc.stop()
+    if hasattr(fib_handler, "close"):
+        fib_handler.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="openr_tpu")
+    ap.add_argument("--config", required=True, help="node config JSON path")
+    ap.add_argument(
+        "--dataplane", choices=("none", "netlink"), default="none"
+    )
+    ap.add_argument(
+        "--store-path", default=None,
+        help="PersistentStore snapshot path (default: no persistence)",
+    )
+    ap.add_argument("--log-level", default="INFO")
+    ap.add_argument(
+        "--jax-platform", default=None,
+        help="force the jax backend (e.g. 'cpu'); needed where a"
+        " sitecustomize pins a TPU plugin the host can't reach",
+    )
+    args = ap.parse_args(argv)
+    if args.jax_platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.jax_platform)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    config = Config.from_file(args.config)
+    asyncio.run(run_node(config, args.dataplane, args.store_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

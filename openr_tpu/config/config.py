@@ -68,6 +68,9 @@ class DecisionConfig:
     # TPU solver knobs (rebuild-specific)
     use_tpu_solver: bool = True  # False → CPU oracle path (tests/tiny nodes)
     use_dense_kernel: bool | None = None  # None = auto
+    # VMEM-resident Pallas relax kernel (TPU only; falls back to the XLA
+    # dense kernel when the distance matrix exceeds the VMEM budget)
+    use_pallas_kernel: bool = False
     enable_lfa: bool = False
 
 
@@ -155,6 +158,20 @@ class PrefixAllocationConfig:
 
 
 @dataclass
+class UdpInterfaceConfig:
+    """One point-to-point UDP 'interface' for a standalone deployment
+    without per-interface kernel multicast: Spark's hello traffic for
+    `if_name` is carried on a local UDP port bound to a fixed peer
+    (reference: the IoProvider abstraction † makes the packet path
+    pluggable; this is the cross-host provider's link definition)."""
+
+    if_name: str
+    local_port: int
+    peer_host: str
+    peer_port: int
+
+
+@dataclass
 class NodeConfig:
     """Root config document (reference: OpenrConfig.thrift † OpenrConfig)."""
 
@@ -182,6 +199,12 @@ class NodeConfig:
     ctrl_port: int = C.CTRL_PORT
     kvstore_port: int = C.KVSTORE_PORT
     dry_run: bool = False
+    # standalone-process deployment: static point-to-point UDP links for
+    # Spark when kernel multicast interfaces aren't used (python -m
+    # openr_tpu); empty = interfaces come from netlink
+    udp_interfaces: tuple[UdpInterfaceConfig, ...] = ()
+    # host to bind kvstore/ctrl listeners + advertise to neighbors
+    endpoint_host: str = "127.0.0.1"
 
 
 class Config:

@@ -262,33 +262,21 @@ class CtrlServer(OpenrModule):
         )
         check("decision.own_adj_in_lsdb", in_lsdb or not established)
 
-        # computed RIB vs programmed FIB convergence — compare route
-        # VALUES, not key sets: a nexthop change stuck in the retry loop
-        # leaves the same prefixes programmed with stale contents
-        desired_u = {
-            p: e.to_unicast_route() for p, e in n.fib.desired_unicast.items()
-        }
-        desired_m = {
-            lbl: e.to_mpls_route() for lbl, e in n.fib.desired_mpls.items()
-        }
-        stale = [
-            str(p) for p, r in desired_u.items()
-            if n.fib.programmed_unicast.get(p) != r
-        ] + [p for p in map(str, n.fib.programmed_unicast) if p not in
-             {str(q) for q in desired_u}]
-        stale_m = [
-            lbl for lbl, r in desired_m.items()
-            if n.fib.programmed_mpls.get(lbl) != r
-        ] + [lbl for lbl in n.fib.programmed_mpls if lbl not in desired_m]
+        # computed RIB vs programmed FIB convergence — VALUE-level diff
+        # from Fib itself (a nexthop change stuck in the retry loop
+        # leaves the same prefixes programmed with stale contents)
+        fibstate = n.fib.pending_changes()
         check(
             "fib.converged",
-            desired_u == n.fib.programmed_unicast
-            and desired_m == n.fib.programmed_mpls,
+            fibstate["converged"],
             f"rib={len(n.decision.rib.unicast_routes)} "
-            f"desired={len(desired_u)}u/{len(desired_m)}m "
-            f"stale={stale[:3]}{stale_m[:3]}" if stale or stale_m else
-            f"rib={len(n.decision.rib.unicast_routes)} "
-            f"desired={len(desired_u)}u/{len(desired_m)}m programmed-ok",
+            f"desired={fibstate['desired_unicast']}u/"
+            f"{fibstate['desired_mpls']}m "
+            + (
+                f"stale={fibstate['stale']}{fibstate['stale_mpls']} "
+                f"pending={fibstate['pending']}"
+                if not fibstate["converged"] else "programmed-ok"
+            ),
         )
 
         # watchdog has not fired

@@ -141,7 +141,10 @@ class Decision(OpenrModule):
             # lazy: the cpu/oracle path must not pay the jax import
             from openr_tpu.decision.spf_backend import TpuSpfSolver
 
-            self._tpu = TpuSpfSolver(use_dense=dcfg.use_dense_kernel)
+            self._tpu = TpuSpfSolver(
+                use_dense=dcfg.use_dense_kernel,
+                use_pallas=dcfg.use_pallas_kernel,
+            )
         self.debounce = AsyncDebounce(
             dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes
         )
@@ -151,9 +154,12 @@ class Decision(OpenrModule):
         self._spf_runs = 0
         self._last_spf_ms = 0.0
         # perf_counter() of the snapshot behind the most recently
-        # EMITTED RouteUpdate (benchmarks use it to attribute a flap to
-        # the rebuild that actually contained it)
+        # EMITTED RouteUpdate, and behind the most recently COMPLETED
+        # rebuild (emitted or not) — benchmarks use the pair to attribute
+        # a flap to the rebuild that actually contained it, or to prove
+        # it produced no route change at all
         self._last_emitted_snapshot_t0 = 0.0
+        self._last_completed_snapshot_t0 = 0.0
 
     # ------------------------------------------------------------------ run
 
@@ -281,7 +287,9 @@ class Decision(OpenrModule):
         first = not self.rib_computed.is_set()
         update = diff_route_dbs(self.rib, new_rib)
         self.rib = new_rib
-        self._last_emitted_snapshot_t0 = t0
+        self._last_completed_snapshot_t0 = t0
+        if first or not update.empty():
+            self._last_emitted_snapshot_t0 = t0
         if first:
             update.type = RouteUpdateType.FULL_SYNC
             self.rib_computed.set()

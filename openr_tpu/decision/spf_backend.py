@@ -56,9 +56,15 @@ class TpuSpfSolver:
     segment-min kernel. Both produce identical distances (tested).
     """
 
-    def __init__(self, use_dense: bool | None = None, dense_waste_limit: int = 8):
+    def __init__(
+        self,
+        use_dense: bool | None = None,
+        dense_waste_limit: int = 8,
+        use_pallas: bool = False,
+    ):
         self.use_dense = use_dense
         self.dense_waste_limit = dense_waste_limit
+        self.use_pallas = use_pallas
         # device-resident LSDB arrays keyed by the CSR's base version
         # (one entry per area's topology; small LRU): metric-only churn
         # arrives as a patch journal (linkstate.py MetricPatch) and is
@@ -140,12 +146,24 @@ class TpuSpfSolver:
             )
         dev = self._device_arrays(csr, use_dense)
         if use_dense:
+            has_over = bool(csr.node_overloaded.any())
+            if self.use_pallas:
+                from openr_tpu.ops.spf_pallas import (
+                    batched_sssp_pallas,
+                    fits_vmem,
+                )
+
+                if fits_vmem(csr.padded_nodes, len(roots)):
+                    return batched_sssp_pallas(
+                        dev["nbr"], dev["wgt"], dev["over"],
+                        jnp.asarray(roots), has_overloads=has_over,
+                    )
             return batched_sssp_dense(
                 dev["nbr"],
                 dev["wgt"],
                 dev["over"],
                 jnp.asarray(roots),
-                has_overloads=bool(csr.node_overloaded.any()),
+                has_overloads=has_over,
             )
         return batched_sssp(
             dev["src"],

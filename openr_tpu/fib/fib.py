@@ -320,6 +320,31 @@ class Fib(OpenrModule):
 
     # ----------------------------------------------------------- accessors
 
+    def pending_changes(self) -> dict:
+        """Desired-vs-programmed delta counts + examples (single source
+        of truth for convergence checks — validate uses this instead of
+        re-deriving the diff)."""
+        desired_u = {p: e.to_unicast_route() for p, e in self.desired_unicast.items()}
+        desired_m = {l: e.to_mpls_route() for l, e in self.desired_mpls.items()}
+        u_stale = [
+            str(p) for p, r in desired_u.items()
+            if self.programmed_unicast.get(p) != r
+        ]
+        u_del = [str(p) for p in self.programmed_unicast if p not in desired_u]
+        m_stale = [
+            l for l, r in desired_m.items()
+            if self.programmed_mpls.get(l) != r
+        ]
+        m_del = [l for l in self.programmed_mpls if l not in desired_m]
+        return {
+            "converged": not (u_stale or u_del or m_stale or m_del),
+            "desired_unicast": len(desired_u),
+            "desired_mpls": len(desired_m),
+            "stale": u_stale[:3] + u_del[:3],
+            "stale_mpls": m_stale[:3] + m_del[:3],
+            "pending": len(u_stale) + len(u_del) + len(m_stale) + len(m_del),
+        }
+
     def get_programmed_unicast(self) -> list[UnicastRoute]:
         return sorted(self.programmed_unicast.values(), key=lambda r: r.dest)
 
