@@ -323,6 +323,9 @@ class CtrlServer(OpenrModule):
                     **_unicast_json(e.to_unicast_route()),
                     "igp_cost": e.igp_cost,
                     "best_nodes": list(e.best_nodes),
+                    "backup_nexthops": [
+                        to_jsonable(nh) for nh in e.backup_nexthops
+                    ],
                 }
                 for e in db.unicast_routes.values()
             ],

@@ -144,6 +144,7 @@ class Decision(OpenrModule):
             self._tpu = TpuSpfSolver(
                 use_dense=dcfg.use_dense_kernel,
                 use_pallas=dcfg.use_pallas_kernel,
+                enable_lfa=dcfg.enable_lfa,
             )
         self.debounce = AsyncDebounce(
             dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes
@@ -246,7 +247,10 @@ class Decision(OpenrModule):
     def _compute_area(self, ls: LinkState, ps: PrefixState) -> RouteDatabase:
         if self._tpu is not None:
             return self._tpu.compute_routes(ls, ps, self.node_name)
-        return oracle_compute_routes(ls, ps, self.node_name)
+        return oracle_compute_routes(
+            ls, ps, self.node_name,
+            enable_lfa=self.config.node.decision.enable_lfa,
+        )
 
     def _snapshot_states(self) -> dict[str, tuple[LinkState, PrefixState]]:
         """Taken on the event loop, so the off-thread solve never races

@@ -187,10 +187,71 @@ def _nexthops_to_nodes(
     return sorted_nexthops(nhs)
 
 
+def _lfa_backups(
+    ls: LinkState,
+    my_node: str,
+    spf: SpfResult,
+    lfa_spfs: dict[str, SpfResult],
+    targets: list[str],
+) -> tuple[NextHop, ...]:
+    """RFC 5286 loop-free alternates toward `targets` — the oracle mirror
+    of TpuSpfSolver._mk_backup_nexthops / ops.spf.lfa_matrix:
+    dist_n(t) < dist_n(root) + dist_root(t), neighbor not already a
+    primary first hop for any target, overloaded neighbors excluded
+    unless they ARE the target."""
+    csr = ls.to_csr()
+    my_id = csr.name_to_id[my_node]
+    primary: set[str] = set()
+    for t in targets:
+        primary |= spf.first_hops.get(t, set())
+    out: dict[tuple[str, str], int] = {}
+    for n, nspf in sorted(lfa_spfs.items()):
+        if n in primary:
+            continue
+        d_n_root = nspf.dist.get(my_node)
+        if d_n_root is None:
+            continue
+        over = ls.is_node_overloaded(n)
+        vias = [
+            nspf.dist[t]
+            for t in targets
+            if t in nspf.dist
+            and t in spf.dist
+            and nspf.dist[t] < d_n_root + spf.dist[t]
+            and (not over or t == n)
+        ]
+        if not vias:
+            continue
+        via = min(vias)
+        n_id = csr.name_to_id[n]
+        details = csr.adj_details.get((my_id, n_id), [])
+        best = min((d[1] for d in details), default=None)
+        if best is None:
+            continue
+        m = best + via
+        for if_name, metric, _w, _lbl, _oif in details:
+            if metric != best:
+                continue
+            key = (n, if_name)
+            if key not in out or m < out[key]:
+                out[key] = m
+    return sorted_nexthops(
+        NextHop(
+            address=n,
+            if_name=if_name,
+            metric=m,
+            neighbor_node=n,
+            area=ls.area,
+        )
+        for (n, if_name), m in out.items()
+    )
+
+
 def compute_routes(
     ls: LinkState,
     ps: PrefixState,
     my_node: str,
+    enable_lfa: bool = False,
 ) -> RouteDatabase:
     """Full RIB for `my_node` (reference: SpfSolver::buildRouteDb †)."""
     rdb = RouteDatabase(this_node_name=my_node)
@@ -198,6 +259,13 @@ def compute_routes(
         return rdb
     adj = build_adjacency(ls)
     spf = run_spf(ls, my_node, adj)
+    lfa_spfs: dict[str, SpfResult] | None = None
+    if enable_lfa:
+        # one SPF per neighbor — the batched TPU solve gets these rows
+        # for free; the oracle pays them explicitly
+        lfa_spfs = {
+            n: run_spf(ls, n, adj) for n in sorted(adj.get(my_node, {}))
+        }
 
     # ---- unicast ----------------------------------------------------------
     overloaded_set = None  # built lazily, once, for KSP2 prefixes
@@ -238,6 +306,9 @@ def compute_routes(
         best_entry = reachable[chosen[0]]
         if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
             continue  # reference: drop route below min_nexthop †
+        backups: tuple[NextHop, ...] = ()
+        if lfa_spfs is not None:
+            backups = _lfa_backups(ls, my_node, spf, lfa_spfs, chosen)
         rdb.unicast_routes[prefix] = RibEntry(
             prefix=prefix,
             nexthops=nexthops,
@@ -245,6 +316,7 @@ def compute_routes(
             best_nodes=tuple(best_nodes),
             best_entry=best_entry,
             igp_cost=min_igp,
+            backup_nexthops=backups,
         )
 
     # ---- MPLS node-segment routes ----------------------------------------

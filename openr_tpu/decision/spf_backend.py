@@ -61,10 +61,12 @@ class TpuSpfSolver:
         use_dense: bool | None = None,
         dense_waste_limit: int = 8,
         use_pallas: bool = False,
+        enable_lfa: bool = False,
     ):
         self.use_dense = use_dense
         self.dense_waste_limit = dense_waste_limit
         self.use_pallas = use_pallas
+        self.enable_lfa = enable_lfa
         # device-resident LSDB arrays keyed by the CSR's base version
         # (one entry per area's topology; small LRU): metric-only churn
         # arrives as a patch journal (linkstate.py MetricPatch) and is
@@ -153,7 +155,9 @@ class TpuSpfSolver:
                     fits_vmem,
                 )
 
-                if fits_vmem(csr.padded_nodes, len(roots)):
+                if fits_vmem(
+                    csr.padded_nodes, len(roots), csr.dense_width()
+                ):
                     return batched_sssp_pallas(
                         dev["nbr"], dev["wgt"], dev["over"],
                         jnp.asarray(roots), has_overloads=has_over,
@@ -175,8 +179,10 @@ class TpuSpfSolver:
         )
 
     def solve(self, ls: LinkState, my_node: str):
-        """Run the batched kernel; returns (csr, dist, fh, neighbor_ids) or
-        None if my_node is not in the topology. dist/fh are host numpy."""
+        """Run the batched kernel; returns (csr, dist, fh, neighbor_ids,
+        lfa) — lfa is the [N, Vp] loop-free-alternate matrix or None when
+        enable_lfa is off — or None if my_node is not in the topology.
+        dist/fh/lfa are host numpy."""
         csr = ls.to_csr()
         my_id = csr.name_to_id.get(my_node)
         if my_id is None:
@@ -215,7 +221,19 @@ class TpuSpfSolver:
                 jnp.asarray(nbr_over),
             )
         )
-        return csr, np.asarray(dist), fh, nbr_ids
+        lfa = None
+        if self.enable_lfa:
+            from openr_tpu.ops.spf import lfa_matrix
+
+            lfa = np.asarray(
+                lfa_matrix(
+                    dist,
+                    jnp.int32(my_id),
+                    jnp.asarray(nbr_ids_p),
+                    jnp.asarray(nbr_over),
+                )
+            )
+        return csr, np.asarray(dist), fh, nbr_ids, lfa
 
     # ------------------------------------------------------------------ RIB
 
@@ -226,7 +244,7 @@ class TpuSpfSolver:
         solved = self.solve(ls, my_node)
         if solved is None:
             return rdb
-        csr, dist, fh, nbr_ids = solved
+        csr, dist, fh, nbr_ids, lfa = solved
         my_id = csr.name_to_id[my_node]
         d_root = dist[:, 0]  # [Vp]
         # hoisted out of the per-prefix loop: "does ANY neighbor serve as
@@ -295,6 +313,12 @@ class TpuSpfSolver:
             best_entry = reachable[chosen_names[0]]
             if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
                 continue
+            backups: tuple[NextHop, ...] = ()
+            if lfa is not None:
+                backups = self._mk_backup_nexthops(
+                    csr, my_id, nbr_ids, fh, lfa, dist, chosen, ls.area,
+                    slot_cache,
+                )
             rdb.unicast_routes[prefix] = RibEntry(
                 prefix=prefix,
                 nexthops=nexthops,
@@ -302,6 +326,7 @@ class TpuSpfSolver:
                 best_nodes=tuple(best_nodes),
                 best_entry=best_entry,
                 igp_cost=min_igp,
+                backup_nexthops=backups,
             )
 
         # ---- MPLS node segments ------------------------------------------
@@ -359,6 +384,53 @@ class TpuSpfSolver:
                     ),
                 )
         return rdb
+
+    @staticmethod
+    def _mk_backup_nexthops(
+        csr: CsrGraph,
+        my_id: int,
+        nbr_ids: list[int],
+        fh: np.ndarray,
+        lfa: np.ndarray,
+        dist: np.ndarray,
+        targets: np.ndarray,
+        area: str,
+        slot_cache: list[list[tuple[str, str]]],
+    ) -> tuple[NextHop, ...]:
+        """LFA backups toward `targets`: loop-free neighbors that are not
+        already primary first hops for any target. Metric = best
+        via-neighbor path cost: metric(root→n) + min over targets of
+        dist_n(target)."""
+        n_real = len(nbr_ids)
+        is_primary = fh[:n_real, targets].any(axis=1)
+        is_lfa = lfa[:n_real, targets].any(axis=1)
+        out: dict[tuple[str, str], int] = {}
+        for n_idx in np.nonzero(is_lfa & ~is_primary)[0]:
+            col = 1 + int(n_idx)
+            # metric over the targets this neighbor is actually
+            # loop-free for (a shorter non-loop-free path must not win)
+            via = min(
+                int(dist[int(t), col])
+                for t in targets
+                if lfa[int(n_idx), int(t)]
+            )
+            link = min(
+                d[1] for d in csr.adj_details[(my_id, nbr_ids[int(n_idx)])]
+            )
+            m = link + via
+            for key in slot_cache[int(n_idx)]:
+                if key not in out or m < out[key]:
+                    out[key] = m
+        return sorted_nexthops(
+            NextHop(
+                address=fh_name,
+                if_name=if_name,
+                metric=m,
+                neighbor_node=fh_name,
+                area=area,
+            )
+            for (fh_name, if_name), m in out.items()
+        )
 
     @staticmethod
     def _nbr_slot_cache(

@@ -137,6 +137,43 @@ def first_hop_matrix(
     return (on_spt & allowed).T  # [N, Vp]
 
 
+@jax.jit
+def lfa_matrix(
+    dist: jax.Array,  # [Vp, B]: col 0 = root, cols 1..N = its neighbors
+    my_id: jax.Array,  # scalar i32: the root's node id
+    neighbor_ids: jax.Array,  # [N] i32 node id of neighbor i
+    neighbor_overloaded: jax.Array,  # [N] bool
+) -> jax.Array:
+    """RFC 5286 loop-free alternates: lfa[n, d] ⇔ neighbor n's shortest
+    path to destination d provably avoids the root:
+
+        dist_n(d) < dist_n(root) + dist_root(d)
+
+    All three terms are rows/columns of the batched solve's distance
+    matrix, so LFA costs one elementwise compare — no extra SPF runs
+    (the reference's legacy LFA re-ran Dijkstra per neighbor †).
+    dist_n(root) is read at the root's row of the neighbor's own column
+    (direction-correct under asymmetric metrics). Overloaded neighbors
+    are excluded except when they ARE the destination; the guard against
+    n_to_root being INF (partitioned neighbor) is the reach mask plus
+    int32 saturation in the comparison.
+    """
+    d_root = dist[:, 0]  # [Vp] dist(root → d)
+    d_nbr = dist[:, 1 : 1 + neighbor_ids.shape[0]]  # [Vp, N] dist(n → d)
+    n_to_root = dist[my_id, 1 : 1 + neighbor_ids.shape[0]]  # [N] dist(n → root)
+    reach = (
+        (d_root < INF_DIST)[:, None]
+        & (d_nbr < INF_DIST)
+        & (n_to_root < INF_DIST)[None, :]
+    )
+    loop_free = d_nbr < jnp.minimum(
+        n_to_root[None, :] + d_root[:, None], INF_DIST
+    )
+    dest_is_nbr = jnp.arange(dist.shape[0])[:, None] == neighbor_ids[None, :]
+    allowed = ~neighbor_overloaded[None, :] | dest_is_nbr
+    return (reach & loop_free & allowed).T  # [N, Vp]
+
+
 def build_dense_tables(
     edge_src: np.ndarray,
     edge_dst: np.ndarray,

@@ -35,13 +35,50 @@ import numpy as np
 
 from openr_tpu.ops.spf import DIST_DTYPE, INF_DIST
 
-# dist must fit beside the streaming tile buffers in a ~16 MB core;
-# 14 MB admits the 100k-node × 32-root flagship case (12.8 MB)
-VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+# full working set must fit in a ~16 MB core (leave headroom for the
+# compiler's own temporaries)
+VMEM_BUDGET_BYTES = 15 * 1024 * 1024
 
 
-def fits_vmem(num_nodes_padded: int, batch: int) -> bool:
-    return num_nodes_padded * batch * 4 <= VMEM_BUDGET_BYTES
+def _footprint_bytes(
+    num_nodes_padded: int, batch: int, d_width: int, tile: int
+) -> int:
+    """dist + the per-tile working set: the [tile, D, B] gather/cand
+    intermediates (2 live copies) and the double-buffered streamed tile
+    inputs (nbr/wgt/over) and output."""
+    dist = num_nodes_padded * batch * 4
+    per_tile_3d = tile * d_width * batch * 4 * 2  # gathered + cand
+    streamed = tile * d_width * 4 * 3 * 2  # nbr/wgt/over, double-buffered
+    out = tile * batch * 4 * 2
+    return dist + per_tile_3d + streamed + out
+
+
+def fits_vmem(
+    num_nodes_padded: int, batch: int, d_width: int = 8, tile: int = 32
+) -> bool:
+    """Whether the kernel can run at SOME tile size ≥ `tile` (the caller
+    may still get a smaller tile than it asked for)."""
+    return (
+        _footprint_bytes(num_nodes_padded, batch, d_width, tile)
+        <= VMEM_BUDGET_BYTES
+    )
+
+
+def pick_tile(
+    num_nodes_padded: int, batch: int, d_width: int, want: int = 256
+) -> int | None:
+    """Largest power-of-two tile ≤ `want` whose working set fits; None
+    if even the smallest doesn't."""
+    t = min(want, num_nodes_padded)
+    while t >= 8:
+        if (
+            num_nodes_padded % t == 0
+            and _footprint_bytes(num_nodes_padded, batch, d_width, t)
+            <= VMEM_BUDGET_BYTES
+        ):
+            return t
+        t //= 2
+    return None
 
 
 def _relax_kernel(roots_ref, nbr_ref, wgt_ref, over_ref, dist_ref,
@@ -138,12 +175,13 @@ def batched_sssp_pallas(
         interpret = jax.default_backend() == "cpu"
     vp = nbr.shape[0]
     b = roots.shape[0]
-    if not fits_vmem(vp, b):
+    chosen = pick_tile(vp, b, nbr.shape[1], want=tile)
+    if chosen is None:
         raise ValueError(
-            f"dist {vp}x{b} exceeds the VMEM budget; use the XLA kernel"
+            f"dist {vp}x{b} (D={nbr.shape[1]}) exceeds the VMEM budget "
+            "at every tile size; use the XLA kernel"
         )
-    tile = min(tile, vp)
-    assert vp % tile == 0, (vp, tile)
+    tile = chosen
 
     dist = jnp.full((vp, b), INF_DIST, DIST_DTYPE)
     dist = dist.at[roots, jnp.arange(b)].set(0)

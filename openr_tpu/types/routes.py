@@ -29,6 +29,12 @@ class RibEntry:
     best_nodes: tuple[str, ...] = ()
     best_entry: PrefixEntry | None = None
     igp_cost: int = 0
+    # RFC 5286 loop-free alternates (neighbors whose shortest path to the
+    # destination provably avoids this node); computed when
+    # DecisionConfig.enable_lfa is set. Not programmed into the FIB —
+    # surfaced for fast-reroute consumers (reference: legacy LFA support
+    # in SpfSolver †).
+    backup_nexthops: tuple[NextHop, ...] = ()
 
     def to_unicast_route(self) -> UnicastRoute:
         return UnicastRoute(dest=self.prefix, nexthops=self.nexthops)

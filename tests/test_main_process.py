@@ -82,15 +82,19 @@ def test_two_process_convergence(tmp_path):
             "proc-b", ctrl_b, kv_b, udp_b, udp_a, "10.99.0.2/32")))
 
         procs = []
+        logs = []
         try:
             for cfg in (cfg_a, cfg_b):
+                # log to files, not PIPEs: an unread full pipe buffer
+                # would deadlock a chatty/failing daemon
+                lf = open(str(cfg) + ".log", "wb")  # noqa: SIM115
+                logs.append(lf)
                 procs.append(
                     await asyncio.create_subprocess_exec(
                         sys.executable, "-m", "openr_tpu",
                         "--config", str(cfg), "--log-level", "WARNING",
                         "--jax-platform", "cpu",
-                        stdout=asyncio.subprocess.PIPE,
-                        stderr=asyncio.subprocess.PIPE,
+                        stdout=lf, stderr=lf,
                     )
                 )
             # each node learns the other's loopback through the full
@@ -118,5 +122,7 @@ def test_two_process_convergence(tmp_path):
                     await asyncio.wait_for(p.wait(), 10)
                 except asyncio.TimeoutError:
                     p.kill()
+            for lf in logs:
+                lf.close()
 
     asyncio.new_event_loop().run_until_complete(main())
