@@ -139,6 +139,12 @@ class OpenrNode:
             self.kv_client,
             prefix_events_reader=self.prefix_events.get_reader(),
             fib_updates_reader=self.fib_updates.get_reader(),
+            # only ABRs (>1 area) consume this stream — creating the
+            # reader unconditionally would buffer RouteUpdates forever
+            route_updates_reader=(
+                self.route_updates.get_reader()
+                if len(config.area_ids()) > 1 else None
+            ),
             policy=origination_policy,
             counters=self.counters,
         )

@@ -79,6 +79,7 @@ class PrefixManager(OpenrModule):
         kv_client: KvStoreClient,
         prefix_events_reader: RQueue | None = None,
         fib_updates_reader: RQueue | None = None,
+        route_updates_reader: RQueue | None = None,
         policy=None,  # openr_tpu.policy.PolicyManager (origination policy)
         counters=None,
     ):
@@ -89,6 +90,9 @@ class PrefixManager(OpenrModule):
         self.kv_client = kv_client
         self.events_reader = prefix_events_reader
         self.fib_reader = fib_updates_reader
+        # Decision RIB stream for cross-area redistribution (ABR role);
+        # only consumed when >1 area is configured
+        self.route_reader = route_updates_reader
         # (source, prefix) -> (entry, dest_areas)
         self._entries: dict[
             tuple[PrefixSource, IpPrefix], tuple[PrefixEntry, tuple[str, ...]]
@@ -105,6 +109,8 @@ class PrefixManager(OpenrModule):
             self.spawn(self._event_loop(), name=f"{self.name}.events")
         if self.fib_reader is not None:
             self.spawn(self._fib_loop(), name=f"{self.name}.fib")
+        if self.route_reader is not None and len(self.config.area_ids()) > 1:
+            self.spawn(self._rib_loop(), name=f"{self.name}.rib")
         self._sync_originations()
         self._sync_advertisements()
 
@@ -164,6 +170,75 @@ class PrefixManager(OpenrModule):
                     orig.supporting.add(p)
             for p in upd.unicast_to_delete:
                 orig.supporting.discard(p)
+
+    # ------------------------------------------- cross-area redistribution
+
+    async def _rib_loop(self) -> None:
+        while True:
+            try:
+                upd: RouteUpdate = await self.route_reader.get()
+            except QueueClosedError:
+                return
+            self.fold_rib_update(upd)
+            self._sync_advertisements()
+
+    def fold_rib_update(self, upd: RouteUpdate) -> None:
+        """ABR role (reference: PrefixManager route redistribution across
+        areas †): a prefix learned in area X is re-advertised by this
+        node into every other configured area, with `distance`
+        incremented and X appended to `area_stack`. Loop prevention is
+        the area_stack: never redistribute into an area the prefix has
+        already traversed.
+        """
+        import dataclasses
+
+        all_areas = set(self.config.area_ids())
+        if upd.type == RouteUpdateType.FULL_SYNC:
+            for key in [
+                k for k in self._entries if k[0] == PrefixSource.RIB
+            ]:
+                del self._entries[key]
+        # prefixes this node originates itself (hoisted: a per-prefix
+        # scan of the entry book would make full syncs quadratic)
+        owned = {
+            k[1] for k in self._entries if k[0] != PrefixSource.RIB
+        }
+        for prefix, rib in upd.unicast_to_update.items():
+            best = rib.best_entry
+            if best is None:
+                continue
+            if prefix in owned:  # never shadow our own origination
+                continue
+            learned = {nh.area for nh in rib.nexthops if nh.area}
+            dest = tuple(
+                sorted(
+                    all_areas - learned - set(best.area_stack)
+                )
+            )
+            if not dest:
+                self._entries.pop((PrefixSource.RIB, prefix), None)
+                continue
+            entry = dataclasses.replace(
+                best,
+                metrics=dataclasses.replace(
+                    best.metrics, distance=best.metrics.distance + 1
+                ),
+                area_stack=tuple(best.area_stack) + tuple(sorted(learned)),
+            )
+            if self.policy is not None:
+                entry = self.policy.apply(entry)
+                if entry is None:
+                    if self.counters:
+                        self.counters.increment("prefixmgr.policy_denied")
+                    # a previously-accepted version must not linger with
+                    # stale attributes once the policy rejects the update
+                    self._entries.pop((PrefixSource.RIB, prefix), None)
+                    continue
+            self._entries[(PrefixSource.RIB, prefix)] = (entry, dest)
+            if self.counters:
+                self.counters.increment("prefixmgr.redistributed")
+        for prefix in upd.unicast_to_delete:
+            self._entries.pop((PrefixSource.RIB, prefix), None)
 
     def _sync_originations(self) -> None:
         """Fold ready config originations into the entry book."""

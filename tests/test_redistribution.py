@@ -1,0 +1,208 @@
+"""Cross-area route redistribution (ABR role) tests.
+
+reference: PrefixManager route redistribution across areas † — a prefix
+learned in area A is re-advertised into area B with distance+1 and the
+learned area appended to area_stack; the stack prevents loops.
+"""
+
+import asyncio
+
+from openr_tpu.config import (
+    AreaConfig,
+    Config,
+    KvstoreConfig,
+    NodeConfig,
+    OriginatedPrefix,
+)
+from openr_tpu.emulator.cluster import (
+    Cluster,
+    ClusterNodeSpec,
+    FAST_SPARK,
+    LinkSpec,
+)
+from openr_tpu.prefixmgr.prefix_manager import PrefixManager, PrefixSource
+from openr_tpu.types.network import IpPrefix, NextHop
+from openr_tpu.types.routes import RibEntry, RouteUpdate, RouteUpdateType
+from openr_tpu.types.topology import PrefixEntry, PrefixMetrics
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class _RecordingKv:
+    def __init__(self):
+        self.persisted = {}  # (area, key) -> payload
+        self.unset = []
+
+    def persist_key(self, area, key, value, ttl_ms=0):
+        self.persisted[(area, key)] = value
+
+    def unset_key(self, area, key):
+        self.unset.append((area, key))
+
+
+def _mk_pm(areas=("A", "B")):
+    cfg = Config(
+        NodeConfig(
+            node_name="abr",
+            areas=tuple(AreaConfig(area_id=a) for a in areas),
+        )
+    )
+    kv = _RecordingKv()
+    pm = PrefixManager(cfg, kv)
+    return pm, kv
+
+
+def _rib_entry(prefix, area, area_stack=(), distance=0):
+    p = IpPrefix.make(prefix)
+    return RibEntry(
+        prefix=p,
+        nexthops=(NextHop(address="n1", if_name="if1", area=area),),
+        best_node="n1",
+        best_entry=PrefixEntry(
+            prefix=p,
+            metrics=PrefixMetrics(distance=distance),
+            area_stack=tuple(area_stack),
+        ),
+    )
+
+
+def test_fold_redistributes_into_other_area():
+    pm, kv = _mk_pm()
+    p = IpPrefix.make("10.5.0.0/24")
+    pm.fold_rib_update(
+        RouteUpdate(unicast_to_update={p: _rib_entry("10.5.0.0/24", "A")})
+    )
+    entry, dest = pm._entries[(PrefixSource.RIB, p)]
+    assert dest == ("B",)
+    assert entry.area_stack == ("A",)
+    assert entry.metrics.distance == 1
+    pm._sync_advertisements()
+    assert any(area == "B" for (area, _k) in kv.persisted)
+    assert not any(area == "A" for (area, _k) in kv.persisted)
+
+
+def test_area_stack_prevents_loops():
+    pm, kv = _mk_pm()
+    p = IpPrefix.make("10.6.0.0/24")
+    # learned in A but already traversed B → nowhere left to go
+    pm.fold_rib_update(
+        RouteUpdate(
+            unicast_to_update={
+                p: _rib_entry("10.6.0.0/24", "A", area_stack=("B",))
+            }
+        )
+    )
+    assert (PrefixSource.RIB, p) not in pm._entries
+
+
+def test_withdraw_on_route_delete():
+    pm, kv = _mk_pm()
+    p = IpPrefix.make("10.7.0.0/24")
+    pm.fold_rib_update(
+        RouteUpdate(unicast_to_update={p: _rib_entry("10.7.0.0/24", "A")})
+    )
+    pm._sync_advertisements()
+    pm.fold_rib_update(RouteUpdate(unicast_to_delete=[p]))
+    pm._sync_advertisements()
+    assert (PrefixSource.RIB, p) not in pm._entries
+    assert any(area == "B" for (area, _k) in kv.unset)
+
+
+def test_full_sync_replaces_rib_entries():
+    pm, _ = _mk_pm()
+    p1 = IpPrefix.make("10.8.0.0/24")
+    p2 = IpPrefix.make("10.8.1.0/24")
+    pm.fold_rib_update(
+        RouteUpdate(unicast_to_update={p1: _rib_entry("10.8.0.0/24", "A")})
+    )
+    pm.fold_rib_update(
+        RouteUpdate(
+            type=RouteUpdateType.FULL_SYNC,
+            unicast_to_update={p2: _rib_entry("10.8.1.0/24", "A")},
+        )
+    )
+    assert (PrefixSource.RIB, p1) not in pm._entries
+    assert (PrefixSource.RIB, p2) in pm._entries
+
+
+def test_abr_end_to_end():
+    """n1(area A) — abr(A|B) — n2(area B): n1's loopback reaches n2's
+    RIB through redistribution, with the area recorded in the stack."""
+
+    async def main():
+        specs = [
+            ClusterNodeSpec(
+                name="n1",
+                config=NodeConfig(
+                    node_name="n1", spark=FAST_SPARK,
+                    kvstore=KvstoreConfig(initial_sync_grace_s=0.5),
+                    areas=(AreaConfig(area_id="A"),),
+                    originated_prefixes=(
+                        OriginatedPrefix(prefix="10.91.0.1/32"),
+                    ),
+                ),
+            ),
+            ClusterNodeSpec(
+                name="abr",
+                config=NodeConfig(
+                    node_name="abr", spark=FAST_SPARK,
+                    kvstore=KvstoreConfig(initial_sync_grace_s=0.5),
+                    areas=(
+                        AreaConfig(area_id="A", neighbor_regexes=("n1",)),
+                        AreaConfig(area_id="B", neighbor_regexes=("n2",)),
+                    ),
+                    originated_prefixes=(
+                        OriginatedPrefix(prefix="10.91.0.2/32"),
+                    ),
+                ),
+            ),
+            ClusterNodeSpec(
+                name="n2",
+                config=NodeConfig(
+                    node_name="n2", spark=FAST_SPARK,
+                    kvstore=KvstoreConfig(initial_sync_grace_s=0.5),
+                    areas=(AreaConfig(area_id="B"),),
+                    originated_prefixes=(
+                        OriginatedPrefix(prefix="10.91.0.3/32"),
+                    ),
+                ),
+            ),
+        ]
+        links = [LinkSpec(a="n1", b="abr"), LinkSpec(a="abr", b="n2")]
+        c = Cluster.build(specs, links)
+        await c.start()
+        try:
+            target = IpPrefix.make("10.91.0.1/32")
+
+            def n2_has_route():
+                rib = c.nodes["n2"].decision.rib
+                return target in rib.unicast_routes
+
+            for _ in range(300):
+                if n2_has_route():
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"n2 never learned n1's loopback: "
+                    f"{sorted(map(str, c.nodes['n2'].decision.rib.unicast_routes))}"
+                )
+            entry = c.nodes["n2"].decision.rib.unicast_routes[target]
+            # route goes via the ABR, carrying the redistribution marks
+            assert entry.best_node == "abr"
+            assert entry.best_entry.area_stack == ("A",)
+            assert entry.best_entry.metrics.distance == 1
+            # and the reverse direction works too
+            rev = IpPrefix.make("10.91.0.3/32")
+            for _ in range(300):
+                if rev in c.nodes["n1"].decision.rib.unicast_routes:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("n1 never learned n2's loopback")
+        finally:
+            await c.stop()
+
+    run(main())
