@@ -252,6 +252,20 @@ class TpuSpfSolver:
         # prefix made RIB assembly O(P·B·V) and dominated churn rebuilds
         fh_any = fh.any(axis=0)  # [Vp]
         slot_cache = self._nbr_slot_cache(csr, my_id, nbr_ids)
+        # unweighted nexthop sets repeat across prefixes anycast to the
+        # same originator set and again in the MPLS node-segment loop —
+        # memoize by (targets, igp)
+        mk_memo: dict[tuple, tuple[NextHop, ...]] = {}
+
+        def mk_nexthops_cached(targets: np.ndarray, igp: int):
+            key = (targets.tobytes(), igp)
+            got = mk_memo.get(key)
+            if got is None:
+                got = mk_memo[key] = self._mk_nexthops(
+                    csr, my_id, nbr_ids, fh, targets, igp, ls.area,
+                    slot_cache=slot_cache,
+                )
+            return got
 
         # ---- unicast ------------------------------------------------------
         adjmap = None  # lazy host adjacency for KSP2 prefixes only
@@ -302,12 +316,15 @@ class TpuSpfSolver:
             chosen = ids[igps == min_igp]
             chosen_names = sorted(csr.node_names[i] for i in chosen)
             weights = ucmp_weights({n: reachable[n] for n in chosen_names})
-            nexthops = self._mk_nexthops(
-                csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area,
-                weights=weights,
-                target_names=csr.node_names,
-                slot_cache=slot_cache,
-            )
+            if weights is None:
+                nexthops = mk_nexthops_cached(chosen, min_igp)
+            else:
+                nexthops = self._mk_nexthops(
+                    csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area,
+                    weights=weights,
+                    target_names=csr.node_names,
+                    slot_cache=slot_cache,
+                )
             if not nexthops:
                 continue
             best_entry = reachable[chosen_names[0]]
@@ -338,10 +355,7 @@ class TpuSpfSolver:
             if d_root[nid] >= INF_DIST or not fh_any[nid]:
                 continue
             igp = int(d_root[nid])
-            base = self._mk_nexthops(
-                csr, my_id, nbr_ids, fh, np.array([nid]), igp, ls.area,
-                slot_cache=slot_cache,
-            )
+            base = mk_nexthops_cached(np.array([nid]), igp)
             nhs = tuple(
                 NextHop(
                     address=nh.address,

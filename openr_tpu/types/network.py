@@ -89,12 +89,19 @@ class NextHop:
     neighbor_node: str = ""
 
     def _key(self):
+        a = self.mpls_action
         return (
             self.address,
             self.if_name,
             self.metric,
             self.weight,
-            str(self.mpls_action),
+            # tuple, not str(...): this runs once per nexthop in every
+            # route-canonicalization sort on the rebuild hot path
+            (-1, 0, ()) if a is None else (
+                int(a.action),
+                a.swap_label if a.swap_label is not None else -1,
+                a.push_labels,
+            ),
             self.area,
             self.neighbor_node,
         )
@@ -120,5 +127,7 @@ class MplsRoute:
 
 
 def sorted_nexthops(nhs) -> tuple[NextHop, ...]:
-    """Canonical ordering so route equality is set-equality."""
-    return tuple(sorted(nhs))
+    """Canonical ordering so route equality is set-equality. Explicit
+    sort key: `sorted(nhs)` would recompute _key twice per comparison
+    through __lt__ (measured hot in 10k-route rebuilds)."""
+    return tuple(sorted(nhs, key=NextHop._key))
