@@ -37,9 +37,72 @@ PYTHON_ORACLE_MS = 53_903.0  # heapq Dijkstra, same graph/roots (see docstring)
 WARMUP = 3
 ITERS = 20
 
+import os as _os
+
+PROBE_ATTEMPTS = int(_os.environ.get("OPENR_BENCH_PROBE_ATTEMPTS", "3"))
+# first TPU compile/init can take 20-40s
+PROBE_TIMEOUT_S = int(_os.environ.get("OPENR_BENCH_PROBE_TIMEOUT", "120"))
+PROBE_RETRY_DELAY_S = int(_os.environ.get("OPENR_BENCH_PROBE_DELAY", "10"))
+
+
+def _probe_default_backend() -> bool:
+    """Check the default (axon/TPU) backend initializes, in a subprocess.
+
+    Backend init can HANG (not just raise) when the TPU tunnel is down —
+    round 1 lost its bench slot to exactly this. A subprocess with a hard
+    timeout is the only reliable guard; retries cover transient tunnel
+    failures.
+    """
+    import subprocess
+
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; d=jax.devices()[0]; print(d.platform)",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            if r.returncode == 0:
+                return True
+            print(
+                f"# backend probe {attempt + 1}/{PROBE_ATTEMPTS} failed "
+                f"(rc={r.returncode}): {r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ''}",
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"# backend probe {attempt + 1}/{PROBE_ATTEMPTS} timed out "
+                f"after {PROBE_TIMEOUT_S}s",
+                file=sys.stderr,
+            )
+        if attempt + 1 < PROBE_ATTEMPTS:
+            time.sleep(PROBE_RETRY_DELAY_S)
+    return False
+
 
 def main() -> None:
+    global WARMUP, ITERS
+    tpu_ok = _probe_default_backend()
+    if not tpu_ok:
+        # fall back to cpu so the driver still records a real measurement
+        # (flagged in detail.platform) instead of a raw traceback
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        WARMUP, ITERS = 1, 5
+
     import jax
+
+    if not tpu_ok:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     import jax.numpy as jnp
 
     from openr_tpu.ops.spf import (
@@ -84,6 +147,9 @@ def main() -> None:
         t0 = time.perf_counter()
         float(step(d_roots))
         times.append((time.perf_counter() - t0) * 1e3)
+        # cpu fallback: stay well inside the driver's slot
+        if not tpu_ok and len(times) >= 3 and sum(times) > 120_000:
+            break
     times.sort()
     p50 = times[len(times) // 2]
     p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
@@ -106,6 +172,8 @@ def main() -> None:
                     "speedup_vs_python_oracle": round(PYTHON_ORACLE_MS / p50, 1),
                     "device": str(dev),
                     "platform": dev.platform,
+                    "tpu_probe_ok": tpu_ok,
+                    "iters": len(times),
                 },
             }
         )
@@ -113,4 +181,24 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — always emit one JSON line
+        import traceback
+
+        tb = traceback.format_exc().strip().splitlines()
+        print(
+            json.dumps(
+                {
+                    "metric": "full_spf_recompute_p50_100k_node_1m_edge",
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "detail": {
+                        "error": f"{type(e).__name__}: {e}",
+                        "traceback_tail": tb[-5:],
+                    },
+                }
+            )
+        )
+        sys.exit(0)

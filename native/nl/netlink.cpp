@@ -355,21 +355,28 @@ int Socket::route_request(const Route& r, bool del, bool replace) {
 
 int Socket::route_batch(const Route* rs, size_t n, bool del, bool replace,
                         int32_t* errs) {
-  // pipeline: send every request, then drain every ACK by sequence
-  // (reference: NetlinkProtocolSocket keeps a seq→request map and a
-  // pending-message budget †)
+  // windowed pipeline: at most kWindow requests outstanding, ACKs
+  // drained as we go. An unbounded send-then-drain lets NLMSG_ERROR
+  // replies pile up in the socket receive buffer (bounded by
+  // net.core.rmem_max without SO_RCVBUFFORCE) and a multi-thousand
+  // route sync overflows it with ENOBUFS. (reference:
+  // NetlinkProtocolSocket keeps a seq→request map and a pending-message
+  // budget for exactly this †)
+  constexpr size_t kWindow = 256;
   uint32_t seq0 = seq_;
-  for (size_t i = 0; i < n; i++) {
-    auto msg = build_route_msg(rs[i], del, replace, seq_++);
-    int rc = send_msg(msg);
-    if (rc) {
-      for (size_t j = i; j < n; j++) errs[j] = rc;
-      return -1;
+  for (size_t j = 0; j < n; j++) errs[j] = 1;  // pending
+  size_t sent = 0, acked = 0;
+  while (acked < n) {
+    while (sent < n && sent - acked < kWindow) {
+      auto msg = build_route_msg(rs[sent], del, replace, seq_++);
+      int rc = send_msg(msg);
+      if (rc) {
+        for (size_t j = 0; j < n; j++)
+          if (errs[j] == 1) errs[j] = rc;
+        return -1;
+      }
+      sent++;
     }
-    errs[i] = 1;  // pending
-  }
-  size_t outstanding = n;
-  while (outstanding > 0) {
     ssize_t rn = recv(fd_, rcvbuf_.data(), rcvbuf_.size(), 0);
     if (rn < 0) {
       if (errno == EINTR) continue;
@@ -382,11 +389,11 @@ int Socket::route_batch(const Route* rs, size_t n, bool del, bool replace,
          NLMSG_OK(h, static_cast<size_t>(rn)); h = NLMSG_NEXT(h, rn)) {
       if (h->nlmsg_type != NLMSG_ERROR) continue;
       uint32_t s = h->nlmsg_seq;
-      if (s < seq0 || s >= seq0 + n) continue;
+      if (s < seq0 || s >= seq0 + sent) continue;
       const nlmsgerr* e = reinterpret_cast<const nlmsgerr*>(NLMSG_DATA(h));
       if (errs[s - seq0] == 1) {
         errs[s - seq0] = e->error;
-        outstanding--;
+        acked++;
       }
     }
   }

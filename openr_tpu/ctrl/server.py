@@ -273,7 +273,8 @@ class CtrlServer(OpenrModule):
             f"desired={fibstate['desired_unicast']}u/"
             f"{fibstate['desired_mpls']}m "
             + (
-                f"stale={fibstate['stale']}{fibstate['stale_mpls']} "
+                f"stale={fibstate['stale']} "
+                f"stale_mpls={fibstate['stale_mpls']} "
                 f"pending={fibstate['pending']}"
                 if not fibstate["converged"] else "programmed-ok"
             ),

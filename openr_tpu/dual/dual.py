@@ -35,6 +35,10 @@ from typing import Callable
 
 DUAL_INF = 1 << 30
 
+# every Nth DualNode.tick re-advertises PASSIVE distances to ALL
+# neighbors (heal backstop); other ticks refresh only rd==INF peers
+FULL_REFRESH_EVERY = 6
+
 PASSIVE = "PASSIVE"
 ACTIVE = "ACTIVE"
 
@@ -234,7 +238,7 @@ class _RootState:
                 return
         self.on_event()
 
-    def tick(self, max_sia_probes: int) -> None:
+    def tick(self, max_sia_probes: int, full_refresh: bool = False) -> None:
         """Periodic liveness pass (lost-message self-healing).
 
         ACTIVE: retransmit queries to still-pending neighbors (a lost
@@ -260,7 +264,17 @@ class _RootState:
                 self.dead_ticks += 1
                 return
             self.dead_ticks = 0
-            self._send_all("update", self.dist)
+            # steady-state: refresh only neighbors whose reported distance
+            # for this root is still INF (they can have missed the
+            # introduction) — a full _send_all every tick is
+            # O(num_roots × degree) cluster-wide. A lost update toward a
+            # neighbor with finite rd is healed by the periodic
+            # full_refresh tick below, just less often.
+            for n in self.node.costs:
+                if full_refresh or self.rd.get(n, DUAL_INF) >= DUAL_INF:
+                    self.node._enqueue(
+                        n, DualMsg(self.root, "update", self.dist)
+                    )
 
     def status(self) -> RootStatus:
         return RootStatus(
@@ -292,6 +306,7 @@ class DualNode:
         self.roots: dict[str, _RootState] = {}
         self._outbox: dict[str, list[DualMsg]] = {}
         self._depth = 0
+        self._tick_count = 0
         if is_root:
             self.roots[node_name] = _RootState(node_name, self)
 
@@ -382,9 +397,15 @@ class DualNode:
         would stay in the dict (and on the wire) for the cluster's
         lifetime (see _RootState.tick)."""
 
+        self._tick_count += 1
+        # every Nth tick is a full PASSIVE re-advertisement to ALL
+        # neighbors — the backstop that heals a dropped update toward a
+        # neighbor whose rd is finite (targeted refresh can't see those)
+        full_refresh = self._tick_count % FULL_REFRESH_EVERY == 0
+
         def go():
             for rs in self.roots.values():
-                rs.tick(max_sia_probes)
+                rs.tick(max_sia_probes, full_refresh=full_refresh)
             for root in [
                 r for r, rs in self.roots.items()
                 if rs.dead_ticks >= dead_root_ticks and not rs.i_am_root

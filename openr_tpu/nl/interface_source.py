@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.messaging import ReplicateQueue
@@ -41,6 +42,10 @@ class NetlinkInterfaceSource(OpenrModule):
         self.queue = interface_events_queue
         self.poll_ms = poll_ms
         self._sock: NetlinkSocket | None = None
+        # serializes native socket use between the poll worker thread and
+        # close(): cancelling the awaiting task does NOT stop the thread
+        # blocked in poll/recv, so close() must wait for it to drain
+        self._io_lock = threading.Lock()
         # name -> InterfaceInfo (current view)
         self.interfaces: dict[str, InterfaceInfo] = {}
 
@@ -56,16 +61,37 @@ class NetlinkInterfaceSource(OpenrModule):
         self.spawn(self._event_loop(), name=f"{self.name}.events")
 
     async def cleanup(self) -> None:
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None
+        # detach first so the poll loop exits at its next iteration, then
+        # close under the io lock once any in-flight next_events (blocked
+        # for up to poll_ms) has returned — avoids a use-after-free on the
+        # native Socket
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            await asyncio.to_thread(self._locked_close, sock)
+
+    def _locked_close(self, sock: NetlinkSocket) -> None:
+        with self._io_lock:
+            sock.close()
+
+    def _next_events(self, poll_ms: int) -> list:
+        with self._io_lock:
+            sock = self._sock  # bind once: cleanup() nulls it lock-free
+            if sock is None:
+                return []
+            return sock.next_events(poll_ms)
 
     def _snapshot(self) -> None:
-        assert self._sock is not None
+        with self._io_lock:
+            sock = self._sock
+            if sock is None:
+                return
+            self._snapshot_locked(sock)
+
+    def _snapshot_locked(self, sock: NetlinkSocket) -> None:
         addrs_by_if: dict[int, list[str]] = {}
-        for a in self._sock.addrs_dump():
+        for a in sock.addrs_dump():
             addrs_by_if.setdefault(a["ifindex"], []).append(a["addr"])
-        for link in self._sock.links_dump():
+        for link in sock.links_dump():
             self.interfaces[link["name"]] = InterfaceInfo(
                 name=link["name"],
                 is_up=bool(link["up"]),
@@ -74,11 +100,10 @@ class NetlinkInterfaceSource(OpenrModule):
             )
 
     async def _event_loop(self) -> None:
-        assert self._sock is not None
         while not self.stopped:
-            evs = await asyncio.to_thread(
-                self._sock.next_events, self.poll_ms
-            )
+            if self._sock is None:
+                return
+            evs = await asyncio.to_thread(self._next_events, self.poll_ms)
             if not evs:
                 continue
             changed: dict[str, InterfaceInfo] = {}
@@ -120,10 +145,13 @@ class NetlinkInterfaceSource(OpenrModule):
                 )
 
     def _resync_addrs(self, changed: dict[str, InterfaceInfo]) -> None:
-        assert self._sock is not None
-        addrs_by_if: dict[int, list[str]] = {}
-        for a in self._sock.addrs_dump():
-            addrs_by_if.setdefault(a["ifindex"], []).append(a["addr"])
+        with self._io_lock:
+            sock = self._sock
+            if sock is None:
+                return
+            addrs_by_if: dict[int, list[str]] = {}
+            for a in sock.addrs_dump():
+                addrs_by_if.setdefault(a["ifindex"], []).append(a["addr"])
         for name, info in list(self.interfaces.items()):
             new_addrs = tuple(addrs_by_if.get(info.ifindex, ()))
             if new_addrs != info.addrs:

@@ -111,9 +111,11 @@ class NetlinkFibService:
         )
 
     def _mpls_to_nl(self, route: MplsRoute) -> NetlinkRoute:
+        # the kernel rejects AF_MPLS RTM_NEWROUTE unless rtm_table is
+        # RT_TABLE_MAIN (net/mpls/af_mpls.c rtm_to_route_config)
         return NetlinkRoute(
             mpls_label=route.top_label,
-            table=0,  # AF_MPLS lives in the platform label table
+            table=RT_TABLE_MAIN,
             protocol=self.protocol,
             nexthops=[
                 _nh_to_nl(nh, self._resolve_ifindex(nh.if_name))
@@ -168,7 +170,9 @@ class NetlinkFibService:
         self, client_id: int, labels: list[int]
     ) -> None:
         nl = [
-            NetlinkRoute(mpls_label=lbl, protocol=self.protocol)
+            NetlinkRoute(
+                mpls_label=lbl, table=RT_TABLE_MAIN, protocol=self.protocol
+            )
             for lbl in labels
         ]
         await asyncio.to_thread(self._batch, nl, True, "mpls_deleted")

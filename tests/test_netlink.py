@@ -151,7 +151,9 @@ def test_kernel_route_add_dump_del():
 
 @KERNEL
 def test_kernel_route_batch():
-    n = 256
+    # > the native send window (256) so the batch exercises the windowed
+    # pipeline: ACKs must drain mid-batch without rcvbuf overflow
+    n = 600
     routes = [
         NetlinkRoute(
             dst=f"10.249.{i >> 8 & 0xFF}.{i & 0xFF}/32", table=TEST_TABLE,
@@ -210,6 +212,56 @@ def test_kernel_event_subscription():
             )
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _mpls_available() -> bool:
+    """mpls_router loaded (or loadable) with platform_labels raised.
+
+    Called lazily from inside the tests (NOT at collection time — the
+    probe mutates global kernel state: modprobe + a sysctl write)."""
+    try:
+        subprocess.run(["modprobe", "mpls_router"], capture_output=True)
+        p = "/proc/sys/net/mpls/platform_labels"
+        if not os.path.exists(p):
+            return False
+        with open(p) as f:
+            cur = int(f.read())
+        if cur < 1_048_575:
+            with open(p, "w") as f:
+                f.write("1048575")
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _require_mpls() -> None:
+    if not _mpls_available():
+        pytest.skip("kernel mpls_router unavailable")
+
+
+@KERNEL
+def test_kernel_mpls_add_dump_del():
+    """AF_MPLS RTM_NEWROUTE requires rtm_table == RT_TABLE_MAIN
+    (net/mpls/af_mpls.c rtm_to_route_config rejects anything else) —
+    regression test for programming label routes with table=0."""
+    _require_mpls()
+    with NetlinkSocket() as s:
+        r = NetlinkRoute(
+            mpls_label=1007, table=254,
+            nexthops=[Nexthop(ifindex=1)],  # PHP out lo
+        )
+        s.route_add(r)
+        try:
+            got = s.routes_dump(family=28, protocol=nl_mod.RTPROT_OPENR)
+            assert any(x.mpls_label == 1007 for x in got), got
+        finally:
+            s.route_del(r)
+        got = s.routes_dump(family=28, protocol=nl_mod.RTPROT_OPENR)
+        assert not any(x.mpls_label == 1007 for x in got)
+
+
 # ---- 3. NetlinkFibService (platform layer) --------------------------------
 
 
@@ -246,6 +298,45 @@ def test_fib_service_add_sync_delete():
             await svc.sync_fib(0, [])  # cleanup: flush our table
             have = await svc.get_route_table_by_client(0)
             assert not have
+            svc.close()
+
+    run(main())
+
+
+@KERNEL
+def test_fib_service_mpls_kernel():
+    """add_mpls_routes / sync_mpls_fib program the real kernel label FIB
+    (regression: _mpls_to_nl used table=0, rejected by the kernel)."""
+    _require_mpls()
+    from openr_tpu.platform import NetlinkFibService
+    from openr_tpu.types.network import (
+        MplsAction,
+        MplsActionType,
+        MplsRoute,
+        NextHop,
+    )
+
+    svc = NetlinkFibService(table=TEST_TABLE)
+    route = MplsRoute(
+        top_label=1009,
+        nexthops=(
+            NextHop(
+                address="",
+                if_name="lo",
+                mpls_action=MplsAction(action=MplsActionType.PHP),
+            ),
+        ),
+    )
+
+    async def main():
+        try:
+            await svc.add_mpls_routes(0, [route])
+            have = await svc.get_mpls_route_table_by_client(0)
+            assert 1009 in {r.top_label for r in have}, have
+            await svc.sync_mpls_fib(0, [])
+            have = await svc.get_mpls_route_table_by_client(0)
+            assert not have, have
+        finally:
             svc.close()
 
     run(main())
