@@ -1,0 +1,130 @@
+"""KvStore flood throughput under churn (rate limiter + coalescing).
+
+Drives one store pair at a target key-update rate and reports what the
+flood limiter put on the wire: messages sent, keys coalesced, max
+pending-queue depth, backpressure drops, and time-to-convergence after
+the churn stops.
+
+Run: python benchmarks/bench_kvstore_flood.py [--updates-per-sec 1000]
+     [--keys 100] [--seconds 5]
+Prints one JSON line (same contract as bench.py).
+
+reference analogue: openr/kvstore/tests/KvStoreBenchmark.cpp † (flood
+fan-out measurement); the rate limiter mirrors KvStore.cpp's
+floodLimiter_ + pending-publication buffering †.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+async def churn(updates_per_sec: int, n_keys: int, seconds: float) -> dict:
+    from openr_tpu.config import Config
+    from openr_tpu.kvstore import InProcKvTransport, KvStore
+    from openr_tpu.kvstore.kvstore import PeerSpec
+    from openr_tpu.messaging import ReplicateQueue
+    from openr_tpu.monitor import Counters
+    from openr_tpu.types.kvstore import Value
+
+    t = InProcKvTransport()
+    stores, counters = {}, {}
+    for name in ("a", "b"):
+        q = ReplicateQueue(name=f"{name}.pubs")
+        c = Counters()
+        s = KvStore(Config.default(name), t, q, counters=c)
+        t.register(name, s)
+        stores[name], counters[name] = s, c
+        await s.start()
+    stores["a"].add_peer_sync(PeerSpec(node_name="b"))
+    stores["b"].add_peer_sync(PeerSpec(node_name="a"))
+    await asyncio.sleep(0.1)
+
+    peer = stores["a"].peers[("0", "b")]
+    loop = asyncio.get_event_loop()
+    batch = max(1, updates_per_sec // 100)  # 10ms pacing quantum
+    total, ver, max_depth = 0, 0, 0
+    t0 = loop.time()
+    while loop.time() - t0 < seconds:
+        ver += 1
+        for i in range(batch):
+            k = f"k{(total + i) % n_keys}"
+            stores["a"].set_key(
+                "0",
+                k,
+                Value(
+                    version=ver, originator_id="a", value=b"x" * 64
+                ).with_hash(),
+            )
+        total += batch
+        max_depth = max(max_depth, len(peer.pending_keys))
+        await asyncio.sleep(max(0.0, (total / updates_per_sec) - (loop.time() - t0)))
+    churn_elapsed = loop.time() - t0
+
+    # convergence: b holds the same (version, hash) for every key as a
+    tc0 = loop.time()
+    db_a = stores["a"].dbs["0"]
+    while True:
+        db_b = stores["b"].dbs["0"]
+        if all(
+            (vb := db_b.kv.get(k)) is not None
+            and (vb.version, vb.hash) == (va.version, va.hash)
+            for k, va in db_a.kv.items()
+        ):
+            break
+        if loop.time() - tc0 > 30:
+            raise TimeoutError("never converged")
+        await asyncio.sleep(0.005)
+    converge_ms = (loop.time() - tc0) * 1e3
+
+    ca = counters["a"]
+    out = {
+        "updates_pushed": total,
+        "updates_per_sec": round(total / churn_elapsed, 1),
+        "floods_sent": ca.get("kvstore.floods_sent"),
+        "keys_coalesced": ca.get("kvstore.flood_keys_coalesced"),
+        "rate_limited_waits": ca.get("kvstore.floods_rate_limited"),
+        "backpressure_drops": ca.get("kvstore.flood_backpressure_drops"),
+        "max_pending_depth": max_depth,
+        "pending_cap": stores["a"].config.node.kvstore.flood_pending_max_keys,
+        "converge_after_churn_ms": round(converge_ms, 1),
+    }
+    for s in stores.values():
+        await s.stop()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--updates-per-sec", type=int, default=1000)
+    ap.add_argument("--keys", type=int, default=100)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    detail = asyncio.new_event_loop().run_until_complete(
+        churn(args.updates_per_sec, args.keys, args.seconds)
+    )
+    detail["wall_s"] = round(time.perf_counter() - t0, 2)
+    print(
+        json.dumps(
+            {
+                "metric": "kvstore_flood_churn_converge_ms",
+                "value": detail["converge_after_churn_ms"],
+                "unit": "ms",
+                "vs_baseline": None,
+                "detail": detail,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ KVSTORE_TTL_DECREMENT_MS = 1  # min decrement applied when flooding
 KVSTORE_SYNC_INTERVAL_S = 60  # anti-entropy full-sync cadence
 KVSTORE_FLOOD_RATE_MSGS_PER_SEC = 600
 KVSTORE_FLOOD_RATE_BURST = 300
+KVSTORE_FLOOD_PENDING_MAX_KEYS = 8192
 TTL_REFRESH_FRACTION = 0.25  # originator refreshes at ttl * fraction left
 
 # ---- Decision debounce (reference: DecisionConfig † debounce_min/max_ms) ---

@@ -40,6 +40,9 @@ class KvstoreConfig:
     sync_interval_s: int = C.KVSTORE_SYNC_INTERVAL_S
     flood_rate_msgs_per_sec: int = C.KVSTORE_FLOOD_RATE_MSGS_PER_SEC
     flood_rate_burst_size: int = C.KVSTORE_FLOOD_RATE_BURST
+    # bound on a peer's coalesced pending-flood queue; overflow drops the
+    # backlog and schedules a FULL_SYNC (backpressure)
+    flood_pending_max_keys: int = C.KVSTORE_FLOOD_PENDING_MAX_KEYS
     enable_flood_optimization: bool = False
     # eligible to be a DUAL flood root (reference: is_flood_root †)
     is_flood_root: bool = True

@@ -52,6 +52,12 @@ class _Peer:
         self.backoff = ExponentialBackoff(100, 30_000)
         self.flood_failures = 0
         self.sync_task: "asyncio.Task | None" = None
+        # pending flood state (coalesced by key: versions only grow, so
+        # replacing an unsent value with a newer one is always correct)
+        self.pending_keys: dict[str, Value] = {}
+        self.pending_expired: set[str] = set()
+        self.flood_wake = asyncio.Event()
+        self.flood_task: "asyncio.Task | None" = None
 
 
 class KvStore(OpenrModule):
@@ -112,6 +118,8 @@ class KvStore(OpenrModule):
 
     async def cleanup(self) -> None:
         for peer in self.peers.values():
+            if peer.flood_task is not None and not peer.flood_task.done():
+                peer.flood_task.cancel()
             if peer.session is not None:
                 try:
                     await peer.session.close()
@@ -170,6 +178,8 @@ class KvStore(OpenrModule):
             return
         if peer.sync_task is not None and not peer.sync_task.done():
             peer.sync_task.cancel()  # no orphaned retry loops/sessions
+        if peer.flood_task is not None and not peer.flood_task.done():
+            peer.flood_task.cancel()
         if peer.session is not None:
             try:
                 await peer.session.close()
@@ -287,7 +297,14 @@ class KvStore(OpenrModule):
         floodPublication †: skip the sender and anyone in node_ids).
         With flood optimization on, restrict to the DUAL spanning-tree
         peers (parent + registered children) — O(V) network messages per
-        update instead of O(E) (reference: getFloodPeers †)."""
+        update instead of O(E) (reference: getFloodPeers †).
+
+        Delivery is via a per-peer pending queue drained by one ordered
+        task per peer with a token bucket (reference: floodLimiter_ +
+        pendingPublicationsToFlood_ buffering †): under churn, updates to
+        the same key coalesce while waiting, so the wire carries the
+        newest version at the allowed rate instead of every intermediate
+        one."""
         ft = self.flood_topos.get(area)
         spt: set[str] | None = ft.flood_peers() if ft is not None else None
         for (parea, pname), peer in self.peers.items():
@@ -297,24 +314,96 @@ class KvStore(OpenrModule):
                 continue
             if spt is not None and pname not in spt:
                 continue
-            self.spawn(self._flood_one(peer, pub))
+            self._enqueue_flood(peer, pub)
 
-    async def _flood_one(self, peer: _Peer, pub: Publication) -> None:
-        try:
-            await peer.session.flood(pub)
+    def _enqueue_flood(self, peer: _Peer, pub: Publication) -> None:
+        coalesced = 0
+        for k, v in pub.key_vals.items():
+            if k in peer.pending_keys:
+                coalesced += 1
+            peer.pending_keys[k] = v
+        peer.pending_expired.update(pub.expired_keys)
+        if coalesced and self.counters is not None:
+            self.counters.increment("kvstore.flood_keys_coalesced", coalesced)
+        # backpressure: a peer that can't drain fast enough gets a bounded
+        # queue; on overflow, drop the backlog and schedule a FULL_SYNC —
+        # one dump repairs everything the dropped floods carried
+        max_keys = self.config.node.kvstore.flood_pending_max_keys
+        if len(peer.pending_keys) > max_keys:
             if self.counters is not None:
-                self.counters.increment("kvstore.floods_sent")
-        except asyncio.CancelledError:
-            raise
-        except Exception:  # noqa: BLE001
-            peer.flood_failures += 1
+                self.counters.increment(
+                    "kvstore.flood_backpressure_drops", len(peer.pending_keys)
+                )
+            peer.pending_keys.clear()
+            peer.pending_expired.clear()
             peer.synced = False
-            peer.session = None
-            ft = self.flood_topos.get(peer.spec.area)
-            if ft is not None:
-                ft.peer_down(peer.spec.node_name)
-            # trigger re-sync (flood gap may have lost updates)
             self._spawn_sync(peer)
+            return
+        if peer.flood_task is None or peer.flood_task.done():
+            peer.flood_task = self.spawn(
+                self._flood_drain(peer),
+                name=f"{self.name}.flood.{peer.spec.node_name}",
+            )
+        peer.flood_wake.set()
+
+    async def _flood_drain(self, peer: _Peer) -> None:
+        """Single ordered flood pump for one peer: token bucket + batch
+        coalescing. All pending keys go out as ONE message per token."""
+        kvconf = self.config.node.kvstore
+        rate = kvconf.flood_rate_msgs_per_sec
+        burst = max(1.0, float(kvconf.flood_rate_burst_size))
+        tokens = burst
+        last = asyncio.get_running_loop().time()
+        key = (peer.spec.area, peer.spec.node_name)
+        while not self.stopped and self.peers.get(key) is peer:
+            if not peer.pending_keys and not peer.pending_expired:
+                peer.flood_wake.clear()
+                await peer.flood_wake.wait()
+                continue
+            if rate > 0:
+                now = asyncio.get_running_loop().time()
+                tokens = min(burst, tokens + (now - last) * rate)
+                last = now
+                if tokens < 1.0:
+                    if self.counters is not None:
+                        self.counters.increment("kvstore.floods_rate_limited")
+                    await asyncio.sleep((1.0 - tokens) / rate)
+                    continue
+                tokens -= 1.0
+            kv, peer.pending_keys = peer.pending_keys, {}
+            exp, peer.pending_expired = peer.pending_expired, set()
+            # node_ids carries only us: per-key provenance is lost when
+            # coalescing across publications, and understating node_ids is
+            # safe — a duplicate delivery is rejected by merge() and never
+            # re-flooded, so loops still terminate
+            pub = Publication(
+                area=peer.spec.area,
+                key_vals=kv,
+                expired_keys=sorted(exp),
+                node_ids=[self.node_name],
+            )
+            session = peer.session
+            if session is None:
+                # session died while queued: the pending sync's FULL_SYNC
+                # supersedes this backlog
+                continue
+            try:
+                await session.flood(pub)
+                if self.counters is not None:
+                    self.counters.increment("kvstore.floods_sent")
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                peer.flood_failures += 1
+                peer.synced = False
+                peer.session = None
+                ft = self.flood_topos.get(peer.spec.area)
+                if ft is not None:
+                    ft.peer_down(peer.spec.node_name)
+                # re-sync repairs whatever the failed flood carried
+                peer.pending_keys.clear()
+                peer.pending_expired.clear()
+                self._spawn_sync(peer)
 
     # ---------------------------------------------------- transport handlers
 

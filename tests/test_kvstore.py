@@ -302,3 +302,120 @@ def test_grid_convergence_16_stores():
             await w.stop()
 
     run(main())
+
+
+# ---- flood rate-limiting / backpressure (reference: floodLimiter_ +
+# pendingPublicationsToFlood_ buffering in KvStore.cpp †) -------------------
+
+
+def test_flood_rate_limit_coalesces_same_key():
+    """Under rapid same-key churn a rate-limited peer link carries the
+    newest version in few messages, not every intermediate version."""
+
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a", "b"])
+        # throttle a's flooding hard BEFORE the first write (the drain
+        # task snapshots the rate when it spawns on first flood)
+        kv = ws["a"].config.node.kvstore
+        kv.flood_rate_msgs_per_sec = 20
+        kv.flood_rate_burst_size = 1
+        ws["a"].store.add_peer_sync(PeerSpec(node_name="b"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="a"))
+        await asyncio.sleep(0.05)
+
+        n = 50
+        for ver in range(1, n + 1):
+            ws["a"].store.set_key("0", "churny", V(ver, "a", b"v%d" % ver))
+        ok = await _settle(
+            lambda: (v := ws["b"].store.get_key("0", "churny")) is not None
+            and v.version == n,
+            timeout=5.0,
+        )
+        assert ok, "rate-limited flood never converged"
+        sent = ws["a"].counters.get("kvstore.floods_sent")
+        coalesced = ws["a"].counters.get("kvstore.flood_keys_coalesced")
+        # 50 versions must NOT mean 50 messages on the throttled link
+        assert sent <= 10, f"sent {sent} floods for {n} coalescable updates"
+        assert coalesced > 0
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
+def test_flood_backpressure_overflow_resyncs():
+    """A peer whose pending queue overflows gets its backlog dropped and
+    repaired by one FULL_SYNC — bounded memory under any churn rate."""
+
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a", "b"])
+        kv = ws["a"].config.node.kvstore
+        kv.flood_rate_msgs_per_sec = 1  # slow enough to pile up
+        kv.flood_rate_burst_size = 1
+        kv.flood_pending_max_keys = 8
+        ws["a"].store.add_peer_sync(PeerSpec(node_name="b"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="a"))
+        await asyncio.sleep(0.05)
+
+        peer = ws["a"].store.peers[("0", "b")]
+        n = 100
+        for i in range(n):
+            ws["a"].store.set_key("0", f"k{i}", V(1, "a", b"x"))
+            assert len(peer.pending_keys) <= kv.flood_pending_max_keys
+        assert ws["a"].counters.get("kvstore.flood_backpressure_drops") > 0
+        # the scheduled FULL_SYNC repairs everything the drops carried
+        ok = await _settle(
+            lambda: all(
+                ws["b"].store.get_key("0", f"k{i}") is not None
+                for i in range(n)
+            ),
+            timeout=5.0,
+        )
+        assert ok, "backpressure resync did not converge"
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
+def test_flood_churn_1k_updates_per_sec_bounded():
+    """Sustained 1k key-updates/sec against the default limiter: queue
+    depth stays bounded and the peer converges to final state."""
+
+    async def main():
+        t = InProcKvTransport()
+        ws = await _mk_stores(t, ["a", "b"])
+        ws["a"].store.add_peer_sync(PeerSpec(node_name="b"))
+        ws["b"].store.add_peer_sync(PeerSpec(node_name="a"))
+        await asyncio.sleep(0.05)
+
+        peer = ws["a"].store.peers[("0", "b")]
+        kv = ws["a"].config.node.kvstore
+        n_keys, rounds = 100, 10  # 1,000 updates over ~1s
+        max_depth = 0
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        ver = 0
+        for r in range(rounds):
+            ver += 1
+            for i in range(n_keys):
+                ws["a"].store.set_key("0", f"c{i}", V(ver, "a", b"r%d" % r))
+            max_depth = max(max_depth, len(peer.pending_keys))
+            # pace to ~100 updates per 100ms
+            await asyncio.sleep(max(0.0, (r + 1) * 0.1 - (loop.time() - t0)))
+        assert max_depth <= kv.flood_pending_max_keys
+        ok = await _settle(
+            lambda: all(
+                (v := ws["b"].store.get_key("0", f"c{i}")) is not None
+                and v.version == rounds
+                for i in range(n_keys)
+            ),
+            timeout=5.0,
+        )
+        assert ok, "churn did not converge to final versions"
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
