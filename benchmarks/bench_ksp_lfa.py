@@ -73,6 +73,7 @@ def main() -> None:
     ap.add_argument("--rings", type=int, default=8)
     ap.add_argument("--ring-size", type=int, default=16)
     ap.add_argument("--ksp-frac", type=float, default=0.1)
+    ap.add_argument("--ksp-k", type=int, default=16)  # BASELINE config 4
     ap.add_argument("--backend", choices=("auto", "cpu"), default="auto")
     args = ap.parse_args()
     if args.backend == "cpu":
@@ -128,7 +129,7 @@ def main() -> None:
         )
 
     me = "bb1"
-    solver = TpuSpfSolver(enable_lfa=True)
+    solver = TpuSpfSolver(enable_lfa=True, ksp_k=args.ksp_k)
     rib = solver.compute_routes(ls, ps, me)  # warm (compile)
     ts = []
     for _ in range(10):
@@ -138,7 +139,7 @@ def main() -> None:
     ts = np.array(ts)
 
     # correctness vs oracle, both features on
-    ora = oracle_routes(ls, ps, me, enable_lfa=True)
+    ora = oracle_routes(ls, ps, me, enable_lfa=True, ksp_k=args.ksp_k)
     rib_diff = sum(
         1 for p in set(rib.unicast_routes) | set(ora.unicast_routes)
         if rib.unicast_routes.get(p) != ora.unicast_routes.get(p)
@@ -188,6 +189,7 @@ def main() -> None:
         "detail": {
             "config": 4,
             "nodes": n,
+            "ksp_k": args.ksp_k,
             "ksp_prefixes": n_ksp,
             "routes_with_lfa_backups": n_backup,
             "p99_ms": round(float(np.percentile(ts, 99)), 3),

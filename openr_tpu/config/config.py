@@ -75,6 +75,9 @@ class DecisionConfig:
     # dense kernel when the distance matrix exceeds the VMEM budget)
     use_pallas_kernel: bool = False
     enable_lfa: bool = False
+    # edge-disjoint paths per SR-MPLS KSP prefix (reference hardwires 2
+    # in KSP2_ED_ECMP †; BASELINE config 4 exercises k=16)
+    ksp_paths: int = 2
 
 
 @dataclass

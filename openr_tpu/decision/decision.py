@@ -145,6 +145,7 @@ class Decision(OpenrModule):
                 use_dense=dcfg.use_dense_kernel,
                 use_pallas=dcfg.use_pallas_kernel,
                 enable_lfa=dcfg.enable_lfa,
+                ksp_k=dcfg.ksp_paths,
             )
         self.debounce = AsyncDebounce(
             dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes
@@ -250,6 +251,7 @@ class Decision(OpenrModule):
         return oracle_compute_routes(
             ls, ps, self.node_name,
             enable_lfa=self.config.node.decision.enable_lfa,
+            ksp_k=self.config.node.decision.ksp_paths,
         )
 
     def _snapshot_states(self) -> dict[str, tuple[LinkState, PrefixState]]:

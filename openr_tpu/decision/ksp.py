@@ -106,34 +106,56 @@ def path_links(path: list[str]) -> frozenset[Link]:
     return frozenset(links)
 
 
+def nearest_dest(
+    dist: dict[str, int], dests: Iterable[str]
+) -> str | None:
+    """The destination KSP pins all paths to: min distance, then name
+    (deterministic — shared by the oracle and the device batcher)."""
+    reachable = [d for d in dests if d in dist]
+    if not reachable:
+        return None
+    best = min(dist[d] for d in reachable)
+    return min(d for d in reachable if dist[d] == best)
+
+
+def k_edge_disjoint_paths(
+    adj: dict[str, dict[str, int]],
+    root: str,
+    dests: Iterable[str],
+    overloaded: set[str],
+    k: int = 2,
+) -> list[tuple[int, list[str]]]:
+    """Up to k edge-disjoint shortest paths from root to the nearest of
+    `dests` (reference computes k=2: SPF, prune path-1 links, SPF again
+    †; generalized here by successive pruning for BASELINE config 4's
+    k=16). Returns [(cost, path), ...] sorted by (cost, path)."""
+    dist = dijkstra(adj, root, overloaded)
+    dest = nearest_dest(dist, dests)
+    if dest is None:
+        return []
+    out: list[tuple[int, list[str]]] = []
+    banned: frozenset[Link] = frozenset()
+    for _ in range(k):
+        if dest not in dist:
+            break
+        p = extract_path(adj, dist, root, dest, overloaded, banned=banned)
+        if p is None:
+            break
+        out.append((dist[dest], p))
+        banned = banned | path_links(p)
+        dist = dijkstra(adj, root, overloaded, banned=banned)
+    out.sort(key=lambda cp: (cp[0], cp[1]))
+    return out
+
+
 def two_edge_disjoint_paths(
     adj: dict[str, dict[str, int]],
     root: str,
     dests: Iterable[str],
     overloaded: set[str],
 ) -> list[tuple[int, list[str]]]:
-    """Up to 2 edge-disjoint shortest paths from root to the nearest of
-    `dests` (reference: KSP2 — SPF, prune path-1 links, SPF again †).
-    Returns [(cost, path), ...] sorted by (cost, path)."""
-    dist1 = dijkstra(adj, root, overloaded)
-    reachable = [d for d in dests if d in dist1]
-    if not reachable:
-        return []
-    best = min(dist1[d] for d in reachable)
-    # nearest dest, deterministic tie-break by name
-    dest = min(d for d in reachable if dist1[d] == best)
-    p1 = extract_path(adj, dist1, root, dest, overloaded)
-    if p1 is None:
-        return []
-    out = [(dist1[dest], p1)]
-    banned = path_links(p1)
-    dist2 = dijkstra(adj, root, overloaded, banned=banned)
-    if dest in dist2:
-        p2 = extract_path(adj, dist2, root, dest, overloaded, banned=banned)
-        if p2 is not None:
-            out.append((dist2[dest], p2))
-    out.sort(key=lambda cp: (cp[0], cp[1]))
-    return out
+    """KSP2 (reference behavior): k_edge_disjoint_paths with k=2."""
+    return k_edge_disjoint_paths(adj, root, dests, overloaded, k=2)
 
 
 def ksp2_nexthops(
@@ -194,13 +216,33 @@ def ksp2_route(
     best_nodes: list[str],
     adjmap: dict[str, dict[str, int]],
     overloaded: set[str],
+    k: int = 2,
 ):
-    """Full KSP2 RibEntry construction, shared verbatim by both backends
-    (oracle + TPU) so their KSP2 RIBs cannot drift. Returns None when no
-    usable path survives or the min_nexthop floor isn't met."""
+    """Full KSP RibEntry construction via the host path solver (the
+    oracle path; the TPU backend computes the same paths with
+    ops/ksp.ksp_edge_disjoint_dense and calls ksp_route_from_paths)."""
+    paths = k_edge_disjoint_paths(
+        adjmap, my_node, best_nodes, overloaded, k=k
+    )
+    return ksp_route_from_paths(
+        ls, my_node, prefix, reachable, best_nodes, paths
+    )
+
+
+def ksp_route_from_paths(
+    ls,  # LinkState
+    my_node: str,
+    prefix,
+    reachable: dict[str, "object"],  # node -> PrefixEntry
+    best_nodes: list[str],
+    paths: list[tuple[int, list[str]]],
+):
+    """RibEntry from precomputed (cost, path) list, shared verbatim by
+    both backends (oracle + TPU) so their KSP RIBs cannot drift. Returns
+    None when no usable path survives or the min_nexthop floor isn't
+    met."""
     from openr_tpu.types.routes import RibEntry
 
-    paths = two_edge_disjoint_paths(adjmap, my_node, best_nodes, overloaded)
     nhs = ksp2_nexthops(ls, my_node, paths)
     if not nhs:
         return None

@@ -252,6 +252,7 @@ def compute_routes(
     ps: PrefixState,
     my_node: str,
     enable_lfa: bool = False,
+    ksp_k: int = 2,
 ) -> RouteDatabase:
     """Full RIB for `my_node` (reference: SpfSolver::buildRouteDb †)."""
     rdb = RouteDatabase(this_node_name=my_node)
@@ -292,7 +293,8 @@ def compute_routes(
                     n for n in ls.nodes if ls.is_node_overloaded(n)
                 }
             ksp_entry = ksp2_route(
-                ls, my_node, prefix, reachable, best_nodes, adj, overloaded_set
+                ls, my_node, prefix, reachable, best_nodes, adj,
+                overloaded_set, k=ksp_k,
             )
             if ksp_entry is not None:
                 rdb.unicast_routes[prefix] = ksp_entry

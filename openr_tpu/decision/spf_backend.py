@@ -22,12 +22,11 @@ import numpy as np
 
 from openr_tpu.common.constants import MPLS_LABEL_MIN
 from openr_tpu.decision.ksp import (
-    ksp2_route,
     normalize_weights,
     ucmp_weights,
 )
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
-from openr_tpu.decision.oracle import build_adjacency, metric_key
+from openr_tpu.decision.oracle import metric_key
 from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
     INF_DIST,
@@ -62,11 +61,13 @@ class TpuSpfSolver:
         dense_waste_limit: int = 8,
         use_pallas: bool = False,
         enable_lfa: bool = False,
+        ksp_k: int = 2,
     ):
         self.use_dense = use_dense
         self.dense_waste_limit = dense_waste_limit
         self.use_pallas = use_pallas
         self.enable_lfa = enable_lfa
+        self.ksp_k = ksp_k
         # device-resident LSDB arrays keyed by the CSR's base version
         # (one entry per area's topology; small LRU): metric-only churn
         # arrives as a patch journal (linkstate.py MetricPatch) and is
@@ -268,8 +269,7 @@ class TpuSpfSolver:
             return got
 
         # ---- unicast ------------------------------------------------------
-        adjmap = None  # lazy host adjacency for KSP2 prefixes only
-        overloaded: set[str] = set()
+        ksp_jobs: list[tuple] = []  # (prefix, reachable, best_nodes)
         for prefix, per_node in sorted(ps.prefixes.items()):
             reachable = {}
             for n, e in per_node.items():
@@ -294,19 +294,10 @@ class TpuSpfSolver:
                 reachable[best_nodes[0]].forwarding_algorithm
                 == ForwardingAlgorithm.KSP2_ED_ECMP
             ):
-                # host-side masked re-solve, shared with the oracle (KSP2
-                # prefixes are SR-rare; see decision/ksp.py docstring)
-                if adjmap is None:
-                    adjmap = build_adjacency(ls)
-                    overloaded = {
-                        n for n in ls.nodes if ls.is_node_overloaded(n)
-                    }
-                ksp_entry = ksp2_route(
-                    ls, my_node, prefix, reachable, best_nodes,
-                    adjmap, overloaded,
-                )
-                if ksp_entry is not None:
-                    rdb.unicast_routes[prefix] = ksp_entry
+                # batched on device after the loop: ONE vectorized
+                # k-disjoint-paths solve for every KSP prefix at once
+                # (the reference re-runs Dijkstra per prefix per path †)
+                ksp_jobs.append((prefix, reachable, best_nodes))
                 continue
             ids = np.array(
                 [csr.name_to_id[n] for n in best_nodes], dtype=np.int64
@@ -345,6 +336,9 @@ class TpuSpfSolver:
                 igp_cost=min_igp,
                 backup_nexthops=backups,
             )
+
+        if ksp_jobs:
+            self._ksp_batch(csr, ls, my_node, my_id, d_root, ksp_jobs, rdb)
 
         # ---- MPLS node segments ------------------------------------------
         for node in ls.nodes:
@@ -398,6 +392,68 @@ class TpuSpfSolver:
                     ),
                 )
         return rdb
+
+    def _ksp_batch(
+        self,
+        csr: CsrGraph,
+        ls: LinkState,
+        my_node: str,
+        my_id: int,
+        d_root: np.ndarray,
+        jobs: list[tuple],
+        rdb: RouteDatabase,
+    ) -> None:
+        """All KSP prefixes in ONE vectorized device call (BASELINE
+        config 4): k edge-disjoint paths per job via k successive masked
+        batched solves, per-job edge bans as data (ops/ksp.py). Byte-equal
+        to the oracle's per-prefix host re-solve (tests/test_ksp_kernel.py
+        + the backend-vs-oracle RIB equality suite)."""
+        from openr_tpu.ops.ksp import (
+            build_ksp_blocked,
+            ksp_edge_disjoint_dense,
+            paths_to_host,
+        )
+        from openr_tpu.decision.ksp import ksp_route_from_paths
+
+        nbr, wgt = csr.dense_tables()
+        blocked = jnp.asarray(
+            build_ksp_blocked(nbr, csr.node_overloaded, my_id)
+        )
+        d_nbr = jnp.asarray(nbr)
+        d_wgt = jnp.asarray(wgt)
+        # destination per job: nearest best node, tie-break by name —
+        # name order IS id order (sorted interning), so (dist, id) works
+        dests = np.empty(len(jobs), dtype=np.int32)
+        for j, (_prefix, _reachable, best_nodes) in enumerate(jobs):
+            ids = np.array(
+                [csr.name_to_id[n] for n in best_nodes], dtype=np.int64
+            )
+            dests[j] = ids[np.argmin(d_root[ids])]  # ids ascending: first min
+        chunk = 256
+        max_hops = csr.padded_nodes - 1
+        for start in range(0, len(jobs), chunk):
+            sub = dests[start : start + chunk]
+            b = pad_batch(len(sub))
+            dsts = np.full(b, my_id, dtype=np.int32)  # padding: dest==root
+            dsts[: len(sub)] = sub
+            costs, paths, _hops = ksp_edge_disjoint_dense(
+                d_nbr,
+                d_wgt,
+                blocked,
+                jnp.int32(my_id),
+                jnp.asarray(dsts),
+                k=self.ksp_k,
+                max_hops=max_hops,
+            )
+            costs, paths = np.asarray(costs), np.asarray(paths)
+            for j in range(len(sub)):
+                prefix, reachable, best_nodes = jobs[start + j]
+                host_paths = paths_to_host(costs, paths, csr.node_names, j)
+                entry = ksp_route_from_paths(
+                    ls, my_node, prefix, reachable, best_nodes, host_paths
+                )
+                if entry is not None:
+                    rdb.unicast_routes[prefix] = entry
 
     @staticmethod
     def _mk_backup_nexthops(

@@ -1,0 +1,141 @@
+"""Device KSP kernel vs host oracle equivalence.
+
+ops/ksp.ksp_edge_disjoint_dense must produce byte-identical
+(cost, path) lists to decision/ksp.k_edge_disjoint_paths — same
+deterministic predecessor rule, same both-direction link bans — on
+random graphs with asymmetric metrics, overloaded nodes, unreachable
+destinations, and k up to 16 (reference analogue: DecisionTest KSP2
+cases †, generalized to BASELINE config 4's k=16)."""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.ksp import k_edge_disjoint_paths
+from openr_tpu.ops.ksp import (
+    build_ksp_blocked,
+    ksp_edge_disjoint_dense,
+    paths_to_host,
+)
+from openr_tpu.ops.spf import INF_DIST, build_dense_tables
+
+
+def random_graph(rng, n, p=0.25, max_metric=10):
+    """Random symmetric-connectivity digraph with asymmetric metrics.
+
+    Returns (adj dict for the oracle, dense nbr/wgt tables, names)."""
+    names = [f"n{i:03d}" for i in range(n)]
+    adj = {nm: {} for nm in names}
+    edges = []  # (src, dst, metric)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if rng.random() < p:
+                w_ij = int(rng.integers(1, max_metric + 1))
+                w_ji = int(rng.integers(1, max_metric + 1))
+                adj[names[i]][names[j]] = w_ij
+                adj[names[j]][names[i]] = w_ji
+                edges.append((i, j, w_ij))
+                edges.append((j, i, w_ji))
+    edges.sort(key=lambda e: (e[1], e[0]))
+    src = np.array([e[0] for e in edges], dtype=np.int32)
+    dst = np.array([e[1] for e in edges], dtype=np.int32)
+    met = np.array([e[2] for e in edges], dtype=np.int32)
+    nbr, wgt = build_dense_tables(src, dst, met, n)
+    return adj, nbr, wgt, names
+
+
+@pytest.mark.parametrize("k", [2, 4, 16])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ksp_kernel_matches_oracle(k, seed):
+    rng = np.random.default_rng(seed)
+    n = 24
+    adj, nbr, wgt, names = random_graph(rng, n)
+    overloaded_ids = sorted(rng.choice(n, size=2, replace=False))
+    overloaded = {names[i] for i in overloaded_ids}
+    over_mask = np.zeros(n, dtype=bool)
+    over_mask[overloaded_ids] = True
+
+    root_id = 0
+    dests = np.array(
+        sorted(rng.choice(np.arange(1, n), size=8, replace=False)),
+        dtype=np.int32,
+    )
+    blocked = build_ksp_blocked(nbr, over_mask, root_id)
+    costs, paths, _hops = ksp_edge_disjoint_dense(
+        nbr, wgt, blocked, np.int32(root_id), dests, k=k, max_hops=n - 1
+    )
+    costs, paths = np.asarray(costs), np.asarray(paths)
+
+    for b, dest_id in enumerate(dests):
+        want = k_edge_disjoint_paths(
+            adj, names[root_id], [names[dest_id]], overloaded, k=k
+        )
+        got = paths_to_host(costs, paths, names, b)
+        assert got == want, (
+            f"k={k} seed={seed} dest={names[dest_id]}:\n"
+            f"device={got}\noracle={want}"
+        )
+
+
+def test_ksp_kernel_root_and_unreachable():
+    """dest == root and unreachable dest both yield zero paths."""
+    rng = np.random.default_rng(7)
+    # two disconnected components: 0..5 and 6..11
+    names = [f"n{i:03d}" for i in range(12)]
+    adj = {nm: {} for nm in names}
+    edges = []
+    for base in (0, 6):
+        for i in range(base, base + 5):
+            adj[names[i]][names[i + 1]] = 1
+            adj[names[i + 1]][names[i]] = 1
+            edges.append((i, i + 1, 1))
+            edges.append((i + 1, i, 1))
+    edges.sort(key=lambda e: (e[1], e[0]))
+    nbr, wgt = build_dense_tables(
+        np.array([e[0] for e in edges], np.int32),
+        np.array([e[1] for e in edges], np.int32),
+        np.array([e[2] for e in edges], np.int32),
+        12,
+    )
+    blocked = build_ksp_blocked(nbr, np.zeros(12, bool), 0)
+    dests = np.array([0, 8], dtype=np.int32)  # root itself; other component
+    costs, paths, hops = ksp_edge_disjoint_dense(
+        nbr, wgt, blocked, np.int32(0), dests, k=4, max_hops=11
+    )
+    costs = np.asarray(costs)
+    assert (costs >= int(INF_DIST)).all()
+    assert paths_to_host(costs, np.asarray(paths), names, 0) == []
+    assert paths_to_host(costs, np.asarray(paths), names, 1) == []
+
+
+def test_ksp_kernel_parallel_capacity_line():
+    """A 4-node ladder: exactly 2 edge-disjoint paths exist; rounds 3+
+    must report no path (bans exhausted the cut)."""
+    # 0-1-3 and 0-2-3
+    names = ["a", "b", "c", "d"]
+    adj = {
+        "a": {"b": 1, "c": 1},
+        "b": {"a": 1, "d": 1},
+        "c": {"a": 1, "d": 1},
+        "d": {"b": 1, "c": 1},
+    }
+    edges = []
+    idx = {nm: i for i, nm in enumerate(names)}
+    for u, nbrs in adj.items():
+        for v, w in nbrs.items():
+            edges.append((idx[u], idx[v], w))
+    edges.sort(key=lambda e: (e[1], e[0]))
+    nbr, wgt = build_dense_tables(
+        np.array([e[0] for e in edges], np.int32),
+        np.array([e[1] for e in edges], np.int32),
+        np.array([e[2] for e in edges], np.int32),
+        4,
+    )
+    blocked = build_ksp_blocked(nbr, np.zeros(4, bool), 0)
+    costs, paths, _ = ksp_edge_disjoint_dense(
+        nbr, wgt, blocked, np.int32(0), np.array([3], np.int32),
+        k=4, max_hops=3,
+    )
+    got = paths_to_host(np.asarray(costs), np.asarray(paths), names, 0)
+    assert got == [(2, ["a", "b", "d"]), (2, ["a", "c", "d"])]
+    want = k_edge_disjoint_paths(adj, "a", ["d"], set(), k=4)
+    assert got == want

@@ -255,3 +255,76 @@ def test_ksp2_unlabeled_interior_hop_rejected():
     e = rdb.unicast_routes[IpPrefix.make("10.9.0.0/16")]
     # only the 0→5→4→3 path survives (all its stack hops are labeled)
     assert {nh.neighbor_node for nh in e.nexthops} == {"node-5"}
+
+
+def test_k16_backend_matches_oracle_fat_tree():
+    """BASELINE config 4 shape: k=16 edge-disjoint paths per SR prefix.
+    A fat-tree core has many disjoint paths; the TPU batched KSP and the
+    oracle's successive host re-solves must agree exactly."""
+    adj_dbs, prefix_dbs = topogen.fat_tree(4)
+    nodes = [db.this_node_name for db in adj_dbs]
+    extra = [
+        PrefixDatabase(
+            this_node_name=n,
+            prefix_entries=(ksp2_entry(f"10.{90 + i}.0.0/16"),),
+        )
+        for i, n in enumerate(nodes[::3])
+    ]
+    ls, ps = _state(adj_dbs, list(prefix_dbs) + extra)
+    solver = TpuSpfSolver(ksp_k=16)
+    for root in (nodes[0], nodes[len(nodes) // 2], nodes[-1]):
+        cpu = compute_routes(ls, ps, root, ksp_k=16)
+        tpu = solver.compute_routes(ls, ps, root)
+        assert cpu.unicast_routes == tpu.unicast_routes, f"root {root}"
+        assert cpu.mpls_routes == tpu.mpls_routes, f"root {root}"
+
+
+def test_k16_multipath_count_on_rich_graph():
+    """On a ring with chords there really are >2 disjoint paths; k=16
+    emits one SR nexthop per surviving path (up to min-cut many)."""
+    adj_dbs, _ = topogen.ring(6)
+    ls, ps = _state(
+        adj_dbs,
+        [
+            PrefixDatabase(
+                this_node_name="node-3",
+                prefix_entries=(ksp2_entry("10.70.0.0/16"),),
+            )
+        ],
+    )
+    rdb2 = compute_routes(ls, ps, "node-0", ksp_k=2)
+    rdb16 = compute_routes(ls, ps, "node-0", ksp_k=16)
+    e2 = rdb2.unicast_routes[IpPrefix.make("10.70.0.0/16")]
+    e16 = rdb16.unicast_routes[IpPrefix.make("10.70.0.0/16")]
+    # ring min-cut is 2: k=16 finds the same two paths, no phantom extras
+    assert len(e16.nexthops) == len(e2.nexthops) == 2
+    tpu = TpuSpfSolver(ksp_k=16).compute_routes(ls, ps, "node-0")
+    assert tpu.unicast_routes == rdb16.unicast_routes
+
+
+def test_ksp_k_overload_respected_both_backends():
+    """Overloaded transit nodes are avoided identically by the batched
+    device KSP and the oracle at k=4."""
+    adj_dbs, _ = topogen.grid(3, 3)
+    adj_dbs = [
+        replace(db, is_overloaded=(db.this_node_name == "node-4"))
+        for db in adj_dbs
+    ]
+    ls, ps = _state(
+        adj_dbs,
+        [
+            PrefixDatabase(
+                this_node_name="node-8",
+                prefix_entries=(ksp2_entry("10.71.0.0/16"),),
+            )
+        ],
+    )
+    cpu = compute_routes(ls, ps, "node-0", ksp_k=4)
+    tpu = TpuSpfSolver(ksp_k=4).compute_routes(ls, ps, "node-0")
+    assert cpu.unicast_routes == tpu.unicast_routes
+    e = cpu.unicast_routes[IpPrefix.make("10.71.0.0/16")]
+    # node-4 (center) may not appear as an interior hop in any label stack
+    lbl4 = ls.node_label("node-4")
+    for nh in e.nexthops:
+        if nh.mpls_action is not None and nh.mpls_action.push_labels:
+            assert lbl4 not in nh.mpls_action.push_labels
