@@ -30,7 +30,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import numpy as np  # noqa: E402
 
 
-def build_decision(adj_dbs, prefix_dbs):
+def build_decision(adj_dbs, prefix_dbs, debounce_min=None, debounce_max=None):
     from openr_tpu.config import Config
     from openr_tpu.decision.decision import Decision
     from openr_tpu.messaging import ReplicateQueue
@@ -38,6 +38,10 @@ def build_decision(adj_dbs, prefix_dbs):
     from openr_tpu.types.serde import to_wire
 
     cfg = Config.default(adj_dbs[0].this_node_name)
+    if debounce_min is not None:
+        cfg.node.decision.debounce_min_ms = debounce_min
+    if debounce_max is not None:
+        cfg.node.decision.debounce_max_ms = debounce_max
     pubs = ReplicateQueue(name="pubs")
     routes = ReplicateQueue(name="routes")
     dec = Decision(cfg, pubs.get_reader("d"), routes, solver="tpu")
@@ -117,11 +121,14 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
             _ = upd
 
     drainer = asyncio.ensure_future(drain())
-    next_send = time.perf_counter()
-    base_spf_runs = dec._spf_runs
-    last_runs = dec._spf_runs
-    no_change_flaps = [0]
-    while time.perf_counter() < stop:
+    # Pre-generate the flap publications: in production the serialization
+    # happens at each flapping link's OWN router (LinkMonitor persistKey);
+    # this node only ever sees the serialized value arrive from KvStore.
+    # Building them in the send loop would bill the remote originators'
+    # encode cost to the node under test.
+    max_flaps = int(flaps_per_sec * seconds * 1.2) + 100
+    pregen = []
+    for _ in range(max_flaps):
         i = int(rng.integers(0, len(adj_dbs)))
         db = adj_dbs[i]
         k = int(rng.integers(0, len(db.adjacencies)))
@@ -133,10 +140,16 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
         db = dataclasses.replace(db, adjacencies=tuple(new_adjs))
         adj_dbs[i] = db
         versions[db.this_node_name] += 1
+        pregen.append(pub_for(db, version=versions[db.this_node_name]))
+
+    next_send = time.perf_counter()
+    base_spf_runs = dec._spf_runs
+    last_runs = dec._spf_runs
+    no_change_flaps = [0]
+    stop = time.perf_counter() + seconds  # exclude pregen time
+    while time.perf_counter() < stop and n_flaps < max_flaps:
         flap_t[n_flaps] = time.perf_counter()
-        dec.process_publication(
-            pub_for(db, version=versions[db.this_node_name])
-        )
+        dec.process_publication(pregen[n_flaps])
         dec.debounce.poke()
         # one recompute-latency sample PER RECOMPUTE (flap-weighted
         # sampling would duplicate the pre-churn value hundreds of times)
@@ -173,6 +186,8 @@ def main() -> None:
     ap.add_argument("--nodes", type=int, default=1280)
     ap.add_argument("--flaps-per-sec", type=float, default=1000.0)
     ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--debounce-min-ms", type=float, default=None)
+    ap.add_argument("--debounce-max-ms", type=float, default=None)
     ap.add_argument(
         "--backend", choices=("auto", "cpu"), default="auto",
         help="cpu forces jax onto host CPU (the axon sitecustomize "
@@ -190,7 +205,10 @@ def main() -> None:
     # 3-tier fat-tree with ~args.nodes nodes: 5k^2/4 = n → k
     k = max(4, int(round((args.nodes * 4 / 5) ** 0.5 / 2)) * 2)
     adj_dbs, prefix_dbs = topogen.fat_tree(k, metric=10)
-    dec, pubs, routes, pub_for = build_decision(adj_dbs, prefix_dbs)
+    dec, pubs, routes, pub_for = build_decision(
+        adj_dbs, prefix_dbs,
+        debounce_min=args.debounce_min_ms, debounce_max=args.debounce_max_ms,
+    )
 
     n_flaps, spf_runs, spf_ms, lat, no_change = asyncio.new_event_loop().run_until_complete(
         churn(

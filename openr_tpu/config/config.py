@@ -88,6 +88,11 @@ class FibConfig:
     max_retry_ms: int = C.FIB_MAX_RETRY_MS
     sync_interval_s: int = C.FIB_SYNC_INTERVAL_S
     dry_run: bool = False
+    # warm boot (graceful restart dataplane continuity): read the
+    # previous incarnation's programmed routes at start and program only
+    # the delta against the first computed RIB — never flush (reference:
+    # Fib warm-boot sync †, SURVEY §5.3/5.4)
+    enable_warm_boot: bool = True
 
 
 @dataclass

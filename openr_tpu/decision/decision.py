@@ -127,12 +127,19 @@ class Decision(OpenrModule):
         self.node_name = config.node_name
         self.pub_reader = kvstore_pub_reader
         self.route_updates = route_updates_queue
-        self.link_states: dict[str, LinkState] = {
+        self._link_states: dict[str, LinkState] = {
             a: LinkState(a) for a in config.area_ids()
         }
-        self.prefix_states: dict[str, PrefixState] = {
+        self._prefix_states: dict[str, PrefixState] = {
             a: PrefixState(a) for a in config.area_ids()
         }
+        # raw publication buffer, coalesced by key (last value wins —
+        # KvStore delivers versions in increasing order): the hot pub
+        # loop only appends; decode + LSDB apply happen once per rebuild
+        # via _drain_pending, so 300 coalesced flaps cost ~1 decode per
+        # flapping key instead of one per publication, off the per-pub
+        # path (config-5 churn measured this as the top host cost)
+        self._pending_kvs: dict[tuple[str, str], Value | None] = {}
         dcfg = config.node.decision
         backend = solver or ("tpu" if dcfg.use_tpu_solver else "cpu")
         self.backend = backend
@@ -182,24 +189,65 @@ class Decision(OpenrModule):
             if self.process_publication(pub):
                 self.debounce.poke()
 
-    def process_publication(self, pub: Publication) -> bool:
-        """Fold one publication into the LSDB; True if topology or prefix
-        state changed (reference: Decision::processPublication †)."""
-        area = pub.area
-        ls = self.link_states.get(area)
-        ps = self.prefix_states.get(area)
+    @property
+    def link_states(self) -> dict[str, LinkState]:
+        """Live LSDB view: draining first keeps every external reader
+        (ctrl dumps, validate, tests) consistent with buffered pubs."""
+        self._drain_pending()
+        return self._link_states
+
+    @property
+    def prefix_states(self) -> dict[str, PrefixState]:
+        self._drain_pending()
+        return self._prefix_states
+
+    def _get_area(self, area: str) -> tuple[LinkState, PrefixState]:
+        ls = self._link_states.get(area)
         if ls is None:
             # unknown area: learn it dynamically (reference requires areas
             # pre-configured; we accept them to ease emulation)
-            ls = self.link_states[area] = LinkState(area)
-            ps = self.prefix_states[area] = PrefixState(area)
-        changed = False
+            ls = self._link_states[area] = LinkState(area)
+            self._prefix_states[area] = PrefixState(area)
+        return ls, self._prefix_states[area]
+
+    def process_publication(self, pub: Publication) -> bool:
+        """Buffer one publication for the next rebuild; True if it can
+        affect routing (reference: Decision::processPublication †, minus
+        the eager decode — see _pending_kvs)."""
+        area = pub.area
+        buffered = False
         for key, val in pub.key_vals.items():
             if val.value is None:
                 continue  # ttl refresh — no payload change
-            changed |= self._apply_key(ls, ps, key, val)
+            if (
+                C.parse_adj_key(key) is not None
+                or C.parse_prefix_key(key) is not None
+            ):
+                self._pending_kvs[(area, key)] = val
+                buffered = True
         for key in pub.expired_keys:
-            changed |= self._expire_key(ls, ps, key)
+            if (
+                C.parse_adj_key(key) is not None
+                or C.parse_prefix_key(key) is not None
+            ):
+                self._pending_kvs[(area, key)] = None  # tombstone
+                buffered = True
+        return buffered
+
+    def _drain_pending(self) -> bool:
+        """Decode + apply the coalesced publication buffer. Idempotent,
+        cheap when empty; called from every LSDB reader and at rebuild
+        start."""
+        if not self._pending_kvs:
+            return False
+        batch, self._pending_kvs = self._pending_kvs, {}
+        changed = False
+        for (area, key), val in batch.items():
+            ls, ps = self._get_area(area)
+            if val is None:
+                changed |= self._expire_key(ls, ps, key)
+            else:
+                changed |= self._apply_key(ls, ps, key, val)
         if changed:
             self.counters and self.counters.increment("decision.lsdb_changes")
         return changed

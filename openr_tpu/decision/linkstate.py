@@ -85,6 +85,7 @@ class CsrGraph:
     name_to_id: dict[str, int]
     _dense: tuple[np.ndarray, np.ndarray] | None = None
     _dense_width: int | None = None
+    _row_start: np.ndarray | None = None
     # --- incremental-churn support ------------------------------------
     # (src_id, dst_id) -> edge-array slot (built once per base)
     edge_index: dict[tuple[int, int], int] = field(default_factory=dict)
@@ -131,16 +132,25 @@ class CsrGraph:
             )
         return self._dense
 
+    def row_start(self) -> np.ndarray:
+        """First dst-sorted edge index per destination node (cached —
+        CsrGraph is immutable). O(E) once instead of a searchsorted per
+        dense_col call on the churn path."""
+        if self._row_start is None:
+            counts = np.bincount(
+                self.edge_dst[: self.num_edges].astype(np.int64),
+                minlength=self.padded_nodes,
+            )
+            self._row_start = np.concatenate(
+                ([0], np.cumsum(counts))
+            ).astype(np.int64)
+        return self._row_start
+
     def dense_col(self, edge_idx: int, dst: int) -> int:
         """Dense-table column of edge slot `edge_idx` (the dense layout
         follows the dst-sorted edge order, so the column is the rank of
         the edge within its destination's run)."""
-        first = int(
-            np.searchsorted(
-                self.edge_dst[: self.num_edges], dst, side="left"
-            )
-        )
-        return edge_idx - first
+        return edge_idx - int(self.row_start()[dst])
 
 
 def _metric_only_delta(
@@ -202,6 +212,7 @@ class LinkState:
         # Rebound (never mutated in place) so snapshots stay consistent.
         self._pending: list[tuple[str, Adjacency]] = []
         self._patched: CsrGraph | None = None
+        self._patched_upto = 0  # prefix of _pending baked into _patched
 
     # ---- mutation ---------------------------------------------------------
 
@@ -225,11 +236,12 @@ class LinkState:
                 self._pending = self._pending + [
                     (db.this_node_name, a) for a in delta
                 ]
-                self._patched = None
+                # _patched stays: to_csr applies only the new suffix
                 return True
         self._csr_cell = [None]
         self._pending = []
         self._patched = None
+        self._patched_upto = 0
         return True
 
     def delete_adjacency_db(self, node: str) -> bool:
@@ -238,6 +250,7 @@ class LinkState:
             self._csr_cell = [None]
             self._pending = []
             self._patched = None
+            self._patched_upto = 0
             return True
         return False
 
@@ -253,6 +266,7 @@ class LinkState:
         # sharing the current references is race-free
         snap._pending = self._pending
         snap._patched = self._patched
+        snap._patched_upto = self._patched_upto
         return snap
 
     # ---- queries ----------------------------------------------------------
@@ -286,11 +300,21 @@ class LinkState:
             self._csr_cell[0] = self._build_csr()
             self._pending = []
             self._patched = None
+            self._patched_upto = 0
         base = self._csr_cell[0]
         if not self._pending:
             return base
         if self._patched is None:
             self._patched = self._apply_pending(base, self._pending)
+        elif self._patched_upto < len(self._pending):
+            # incremental: patch only the suffix that arrived since the
+            # last materialization — under sustained metric churn this
+            # keeps per-rebuild host cost O(new flaps), not O(all
+            # accumulated flaps since the last structural rebuild)
+            self._patched = self._apply_pending(
+                self._patched, self._pending[self._patched_upto :]
+            )
+        self._patched_upto = len(self._pending)
         return self._patched
 
     def _apply_pending(

@@ -76,6 +76,10 @@ class TpuSpfSolver:
         # updated by scatter")
         self._dev: dict[int, dict] = {}
         self._dev_lru_cap = 4
+        # cross-rebuild MPLS RibMplsEntry cache: {slot_fingerprint:
+        # {(label, node, fh_col_bytes, igp): RibMplsEntry}} — see the
+        # MPLS section of compute_routes
+        self._mpls_cache: dict = {}
 
     def _device_arrays(self, csr, use_dense: bool):
         """Cached (and incrementally patched) device copies of the LSDB."""
@@ -89,35 +93,51 @@ class TpuSpfSolver:
             and csr.version >= cache["version"]
         ):
             if cache["version"] != csr.version:
-                # journal entries are idempotent .set()s and cumulative
-                # per base, so applying the full journal is always correct
-                if csr.patches:
+                # the journal is cumulative per base and the cache knows
+                # how much of it is already applied — scatter only the
+                # suffix (the full journal grows without bound under
+                # sustained churn until the LinkState compaction cap)
+                done = cache.get("journal_len", 0)
+                if len(csr.patches) > done:
+                    new_patches = list(csr.patches[done:])
+                    # pad the patch arrays to a bucket (repeating the
+                    # last patch — duplicate .set of the same value is a
+                    # no-op): without this, every distinct patch COUNT is
+                    # a new traced shape and the scatter re-compiles on
+                    # every churn rebuild (~130 ms/cycle measured)
+                    n = len(new_patches)
+                    nb = pad_batch(n)
+                    patches = new_patches + [new_patches[-1]] * (nb - n)
                     if use_dense:
                         rows = jnp.asarray(
-                            [p.dense_row for p in csr.patches], jnp.int32
+                            [p.dense_row for p in patches], jnp.int32
                         )
                         cols = jnp.asarray(
-                            [p.dense_col for p in csr.patches], jnp.int32
+                            [p.dense_col for p in patches], jnp.int32
                         )
                         vals = jnp.asarray(
-                            [p.metric for p in csr.patches], jnp.int32
+                            [p.metric for p in patches], jnp.int32
                         )
                         cache["wgt"] = cache["wgt"].at[rows, cols].set(vals)
                     else:
                         idxs = jnp.asarray(
-                            [p.edge_idx for p in csr.patches], jnp.int32
+                            [p.edge_idx for p in patches], jnp.int32
                         )
                         vals = jnp.asarray(
-                            [p.metric for p in csr.patches], jnp.int32
+                            [p.metric for p in patches], jnp.int32
                         )
                         cache["metric"] = (
                             cache["metric"].at[idxs].set(vals)
                         )
+                    cache["journal_len"] = len(csr.patches)
                 cache["version"] = csr.version
             return cache
         cache = {
             "version": csr.version,
             "dense": use_dense,
+            # arrays below are uploaded from the (possibly patched) csr,
+            # so its whole journal is already reflected
+            "journal_len": len(csr.patches),
         }
         if use_dense:
             nbr, wgt = csr.dense_tables()
@@ -255,16 +275,25 @@ class TpuSpfSolver:
         slot_cache = self._nbr_slot_cache(csr, my_id, nbr_ids)
         # unweighted nexthop sets repeat across prefixes anycast to the
         # same originator set and again in the MPLS node-segment loop —
-        # memoize by (targets, igp)
+        # memoize by the UNION FIRST-HOP COLUMN, not the target ids: in a
+        # fat-tree every far destination shares the same up-link set, so
+        # thousands of distinct dest sets collapse into a handful of
+        # (first-hop set, igp) classes and NextHop construction runs once
+        # per class instead of once per prefix
         mk_memo: dict[tuple, tuple[NextHop, ...]] = {}
 
+        def fh_union_col(targets: np.ndarray) -> np.ndarray:
+            if len(targets) == 1:
+                return fh[:, int(targets[0])]
+            return fh[:, targets].any(axis=1)
+
         def mk_nexthops_cached(targets: np.ndarray, igp: int):
-            key = (targets.tobytes(), igp)
+            col = fh_union_col(targets)
+            key = (col.tobytes(), igp)
             got = mk_memo.get(key)
             if got is None:
-                got = mk_memo[key] = self._mk_nexthops(
-                    csr, my_id, nbr_ids, fh, targets, igp, ls.area,
-                    slot_cache=slot_cache,
+                got = mk_memo[key] = self._mk_nexthops_union(
+                    slot_cache, col, igp, ls.area
                 )
             return got
 
@@ -341,6 +370,18 @@ class TpuSpfSolver:
             self._ksp_batch(csr, ls, my_node, my_id, d_root, ksp_jobs, rdb)
 
         # ---- MPLS node segments ------------------------------------------
+        # cross-rebuild cache: under churn most nodes keep the same
+        # (first-hop set, igp), so the per-node SWAP/PHP NextHop
+        # construction — the single hottest host loop in a steady-state
+        # rebuild — is skipped for every unchanged destination. The slot
+        # fingerprint keys my own adjacency details (interface names,
+        # min-metric parallel links), which the fh column alone can't see.
+        slot_gen = (ls.area, tuple(tuple(s) for s in slot_cache))
+        mpls_cache = self._mpls_cache.setdefault(slot_gen, {})
+        if len(self._mpls_cache) > 8:  # new slot fingerprints evict old
+            self._mpls_cache = {slot_gen: mpls_cache}
+        if len(mpls_cache) > max(4096, 4 * len(csr.node_names)):
+            mpls_cache.clear()
         for node in ls.nodes:
             label = ls.node_label(node)
             nid = csr.name_to_id[node]
@@ -349,26 +390,33 @@ class TpuSpfSolver:
             if d_root[nid] >= INF_DIST or not fh_any[nid]:
                 continue
             igp = int(d_root[nid])
-            base = mk_nexthops_cached(np.array([nid]), igp)
-            nhs = tuple(
-                NextHop(
-                    address=nh.address,
-                    if_name=nh.if_name,
-                    metric=nh.metric,
-                    neighbor_node=nh.neighbor_node,
-                    area=nh.area,
-                    mpls_action=(
-                        MplsAction(action=MplsActionType.PHP)
-                        if csr.name_to_id[nh.neighbor_node] == nid
-                        else MplsAction(
-                            action=MplsActionType.SWAP, swap_label=label
-                        )
-                    ),
+            col = fh[:, nid]
+            key = (label, node, col.tobytes(), igp)
+            entry = mpls_cache.get(key)
+            if entry is None:
+                base = mk_nexthops_cached(np.array([nid]), igp)
+                nhs = tuple(
+                    NextHop(
+                        address=nh.address,
+                        if_name=nh.if_name,
+                        metric=nh.metric,
+                        neighbor_node=nh.neighbor_node,
+                        area=nh.area,
+                        mpls_action=(
+                            MplsAction(action=MplsActionType.PHP)
+                            if nh.neighbor_node == node
+                            else MplsAction(
+                                action=MplsActionType.SWAP, swap_label=label
+                            )
+                        ),
+                    )
+                    for nh in base
                 )
-                for nh in base
-            )
-            if nhs:
-                rdb.mpls_routes[label] = RibMplsEntry(label=label, nexthops=nhs)
+                if not nhs:
+                    continue
+                entry = RibMplsEntry(label=label, nexthops=nhs)
+                mpls_cache[key] = entry
+            rdb.mpls_routes[label] = entry
 
         # ---- MPLS adjacency labels ---------------------------------------
         my_db = ls.adjacency_db(my_node)
@@ -522,6 +570,29 @@ class TpuSpfSolver:
                 ]
             )
         return cache
+
+    @staticmethod
+    def _mk_nexthops_union(
+        slot_cache: list[list[tuple[str, str]]],
+        valid_rows: np.ndarray,  # [N] bool: union first-hop column
+        igp: int,
+        area: str,
+    ) -> tuple[NextHop, ...]:
+        """Unweighted nexthop construction from a precomputed union
+        first-hop column (the fast path; the weighted/UCMP path keeps
+        the per-target accumulation in _mk_nexthops)."""
+        nhs = [
+            NextHop(
+                address=fh_name,
+                if_name=if_name,
+                metric=igp,
+                neighbor_node=fh_name,
+                area=area,
+            )
+            for n_idx in np.nonzero(valid_rows)[0]
+            for (fh_name, if_name) in slot_cache[int(n_idx)]
+        ]
+        return sorted_nexthops(nhs)
 
     @staticmethod
     def _mk_nexthops(

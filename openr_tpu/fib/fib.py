@@ -48,6 +48,30 @@ class FibProgramError(RuntimeError):
     pass
 
 
+def _dataplane_key_nh(nh) -> tuple:
+    """The fields of a nexthop the kernel actually stores — a route
+    dumped back from the kernel matches its original on exactly these."""
+    act = nh.mpls_action
+    labels: tuple = ()
+    if act is not None:
+        if act.push_labels:
+            labels = ("push", tuple(act.push_labels))
+        elif act.swap_label is not None:
+            labels = ("swap", act.swap_label)
+    return (nh.address, nh.if_name, max(1, nh.weight), labels)
+
+
+def _dataplane_key_unicast(r: UnicastRoute) -> tuple:
+    return (r.dest, tuple(sorted(_dataplane_key_nh(n) for n in r.nexthops)))
+
+
+def _dataplane_key_mpls(r: MplsRoute) -> tuple:
+    return (
+        r.top_label,
+        tuple(sorted(_dataplane_key_nh(n) for n in r.nexthops)),
+    )
+
+
 class MockFibHandler:
     """In-memory FibService with injectable failures.
 
@@ -161,18 +185,51 @@ class Fib(OpenrModule):
         self.synced = asyncio.Event()  # FIB_SYNCED init gate
         self._need_full_sync = True
         self._have_rib = False  # AWAITING state: no RIB from Decision yet
+        self._warm_booted = False  # programmed_* adopted from the kernel
         self._dirty = asyncio.Event()
         self.backoff = ExponentialBackoff(
             config.node.fib.initial_retry_ms, config.node.fib.max_retry_ms
         )
 
     async def main(self) -> None:
+        if self.config.node.fib.enable_warm_boot and not self.dry_run:
+            # BEFORE consuming any RIB: the dump must reflect the
+            # previous incarnation's routes, untouched
+            await self._warm_boot()
         self.spawn(self._update_loop(), name=f"{self.name}.updates")
         self.spawn(self._program_loop(), name=f"{self.name}.program")
         self.run_every(
             self.config.node.fib.sync_interval_s,
             self._mark_full_sync,
             name=f"{self.name}.resync",
+        )
+
+    async def _warm_boot(self) -> None:
+        """Graceful-restart dataplane continuity (reference: Fib
+        warm-boot sync †): adopt the kernel's surviving routes as the
+        programmed state, so the first RIB programs only the delta and
+        forwarding never gaps. The adopted routes lack control-plane-only
+        fields (metric, area), so the first-delta comparison uses the
+        dataplane projection (_dataplane_key)."""
+        try:
+            u = await self.handler.get_route_table_by_client(CLIENT_ID_OPENR)
+            m = await self.handler.get_mpls_route_table_by_client(
+                CLIENT_ID_OPENR
+            )
+        except Exception as exc:  # noqa: BLE001 — cold boot on any failure
+            log.info("%s: warm-boot dump unavailable (%s)", self.name, exc)
+            return
+        if not u and not m:
+            return
+        self.programmed_unicast = {r.dest: r for r in u}
+        self.programmed_mpls = {r.top_label: r for r in m}
+        self._warm_booted = True
+        self._need_full_sync = False  # first program = incremental delta
+        if self.counters:
+            self.counters.set("fib.warm_boot_routes", len(u) + len(m))
+        log.info(
+            "%s: warm boot adopted %d unicast / %d mpls routes",
+            self.name, len(u), len(m),
         )
 
     def _mark_full_sync(self) -> None:
@@ -195,7 +252,11 @@ class Fib(OpenrModule):
         if upd.type == RouteUpdateType.FULL_SYNC:
             self.desired_unicast = dict(upd.unicast_to_update)
             self.desired_mpls = dict(upd.mpls_to_update)
-            self._need_full_sync = True
+            # after a warm boot the incremental diff against the adopted
+            # kernel state IS the full sync (it deletes stale routes
+            # too) — sync_fib here would defeat dataplane continuity
+            if not self._warm_booted:
+                self._need_full_sync = True
             return
         for prefix, entry in upd.unicast_to_update.items():
             self.desired_unicast[prefix] = entry
@@ -261,15 +322,38 @@ class Fib(OpenrModule):
             self.programmed_mpls = desired_m
             self._publish_programmed(snap_u, snap_m, full=True)
             return
-        # incremental: diff desired vs programmed
+        # incremental: diff desired vs programmed. After a warm boot the
+        # programmed side came from a kernel dump, which can't carry
+        # control-plane-only fields (metric, area, neighbor name) — the
+        # first delta compares the dataplane projection instead, so
+        # surviving routes aren't pointlessly reprogrammed.
+        warm = self._warm_booted
+        if warm:
+            def same_u(a: UnicastRoute | None, b: UnicastRoute) -> bool:
+                return a is not None and (
+                    _dataplane_key_unicast(a) == _dataplane_key_unicast(b)
+                )
+
+            def same_m(a: MplsRoute | None, b: MplsRoute) -> bool:
+                return a is not None and (
+                    _dataplane_key_mpls(a) == _dataplane_key_mpls(b)
+                )
+
+        else:
+            def same_u(a, b):
+                return a == b
+
+            def same_m(a, b):
+                return a == b
+
         u_add = [
             r for p, r in desired_u.items()
-            if self.programmed_unicast.get(p) != r
+            if not same_u(self.programmed_unicast.get(p), r)
         ]
         u_del = [p for p in self.programmed_unicast if p not in desired_u]
         m_add = [
             r for l, r in desired_m.items()
-            if self.programmed_mpls.get(l) != r
+            if not same_m(self.programmed_mpls.get(l), r)
         ]
         m_del = [l for l in self.programmed_mpls if l not in desired_m]
         if u_add:
@@ -280,7 +364,18 @@ class Fib(OpenrModule):
             await self.handler.add_mpls_routes(CLIENT_ID_OPENR, m_add)
         if m_del:
             await self.handler.delete_mpls_routes(CLIENT_ID_OPENR, m_del)
-        if u_add or u_del or m_add or m_del:
+        if warm:
+            # every surviving route is now accounted for in control-plane
+            # form; downstream (PrefixManager gating) sees the full state
+            self._warm_booted = False
+            self.programmed_unicast = desired_u
+            self.programmed_mpls = desired_m
+            if self.counters:
+                self.counters.set(
+                    "fib.warm_boot_reprogrammed", len(u_add) + len(m_add)
+                )
+            self._publish_programmed(snap_u, snap_m, full=True)
+        elif u_add or u_del or m_add or m_del:
             self.programmed_unicast = desired_u
             self.programmed_mpls = desired_m
             self._publish_programmed(

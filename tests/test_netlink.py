@@ -428,3 +428,68 @@ def test_fib_module_with_real_kernel():
             svc.close()
 
     run(main())
+
+
+@KERNEL
+def test_fib_warm_boot_real_kernel_zero_flush():
+    """Graceful restart against the real kernel: routes programmed by a
+    previous Fib incarnation survive the restart window untouched — the
+    new Fib adopts them and programs only the delta (reference: Fib
+    warm-boot sync †, SURVEY §5.3-5.4)."""
+    from openr_tpu.config import Config
+    from openr_tpu.fib.fib import CLIENT_ID_OPENR, Fib
+    from openr_tpu.messaging import ReplicateQueue
+    from openr_tpu.monitor import Counters
+    from openr_tpu.platform import NetlinkFibService
+    from openr_tpu.types.network import IpPrefix, NextHop
+    from openr_tpu.types.routes import RibEntry, RouteUpdate, RouteUpdateType
+
+    def entry(pfx):
+        return RibEntry(
+            prefix=IpPrefix.make(pfx),
+            nexthops=(NextHop(address="", if_name="lo"),),
+        )
+
+    def full(*entries):
+        return RouteUpdate(
+            type=RouteUpdateType.FULL_SYNC,
+            unicast_to_update={e.prefix: e for e in entries},
+        )
+
+    async def main():
+        # incarnation 1: program two routes, then die (no cleanup)
+        svc1 = NetlinkFibService(table=TEST_TABLE)
+        q1 = ReplicateQueue(name="routes1")
+        fib1 = Fib(Config.default("wb"), q1.get_reader("fib"), svc1)
+        await fib1.start()
+        q1.push(full(entry("10.252.1.0/24"), entry("10.252.2.0/24")))
+        await asyncio.wait_for(fib1.synced.wait(), 5)
+        await fib1.stop()
+        svc1.close()
+
+        # restart: new service + Fib; counters see every netlink op
+        counters = Counters()
+        svc2 = NetlinkFibService(table=TEST_TABLE, counters=counters)
+        q2 = ReplicateQueue(name="routes2")
+        fib2 = Fib(Config.default("wb"), q2.get_reader("fib"), svc2)
+        try:
+            await fib2.start()
+            assert fib2._warm_booted, "kernel routes not adopted"
+            # RIB after restart: one surviving, one stale→new swap
+            q2.push(full(entry("10.252.1.0/24"), entry("10.252.3.0/24")))
+            await asyncio.wait_for(fib2.synced.wait(), 5)
+            # zero flush: the surviving route was never re-added...
+            assert counters.get("platform.routes_added") == 1
+            # ...and exactly the stale one was deleted
+            assert counters.get("platform.routes_deleted") == 1
+            have = {
+                str(r.dest)
+                for r in await svc2.get_route_table_by_client(CLIENT_ID_OPENR)
+            }
+            assert have == {"10.252.1.0/24", "10.252.3.0/24"}, have
+        finally:
+            await fib2.stop()
+            await svc2.sync_fib(0, [])
+            svc2.close()
+
+    run(main())
