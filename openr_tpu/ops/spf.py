@@ -280,8 +280,15 @@ def all_sources_sssp(
 ) -> np.ndarray:
     """Distances from every node (BASELINE config 3), chunked over sources to
     bound the [Ep, B] relax intermediate in HBM. Returns [V, V] (row = src).
+
+    Pipelined: each chunk's solve is dispatched asynchronously and the
+    PREVIOUS chunk's device→host transfer happens while the current one
+    computes — the host loop never serializes launch → compute → copy
+    (the full [V, V] result can't live on device at 100k nodes, so a
+    single fused lax.map is not an option; double-buffering is).
     """
     rows = []
+    pending = None
     for start in range(0, num_nodes, chunk):
         b = min(chunk, num_nodes - start)
         roots = jnp.arange(start, start + b, dtype=jnp.int32)
@@ -290,5 +297,9 @@ def all_sources_sssp(
         d = batched_sssp(
             edge_src, edge_dst, edge_metric, edge_blocked, roots, num_nodes
         )
-        rows.append(np.asarray(d[:, :b]).T)
+        if pending is not None:
+            rows.append(np.asarray(pending[0][:, : pending[1]]).T)
+        pending = (d, b)
+    if pending is not None:
+        rows.append(np.asarray(pending[0][:, : pending[1]]).T)
     return np.concatenate(rows, axis=0)

@@ -16,4 +16,7 @@ initialises the same mesh across hosts (see `mesh.py`).
 """
 
 from openr_tpu.parallel.mesh import make_mesh  # noqa: F401
-from openr_tpu.parallel.sharded_spf import sharded_sssp  # noqa: F401
+from openr_tpu.parallel.sharded_spf import (  # noqa: F401
+    sharded_sssp,
+    sharded_sssp_padded,
+)

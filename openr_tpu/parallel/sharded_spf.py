@@ -63,7 +63,14 @@ def _local_sssp(edge_src, edge_dst, edge_metric, edge_blocked, roots, num_nodes)
         _dist, changed, it = state
         return changed & (it < num_nodes)
 
-    dist, _, _ = jax.lax.while_loop(cond, relax, (dist, jnp.bool_(True), 0))
+    # initial `changed` must carry the same varying-manual-axes type as
+    # the loop output (jnp.any over the sources-sharded dist): a literal
+    # True is unvarying and check_vma rightly rejects it. Each sources
+    # shard may run a different trip count — safe, because shards in the
+    # same graph-axis group share the same root slice, so the pmin
+    # collectives inside the loop stay aligned.
+    changed0 = jnp.any(dist <= INF_DIST)  # always True, correctly varying
+    dist, _, _ = jax.lax.while_loop(cond, relax, (dist, changed0, 0))
     return dist
 
 
@@ -91,6 +98,50 @@ def sharded_sssp(
             P(SOURCES_AXIS),
         ),
         out_specs=P(None, SOURCES_AXIS),
-        check_vma=False,
+        check_vma=True,
     )
     return fn(edge_src, edge_dst, edge_metric, edge_blocked, roots)
+
+
+def sharded_sssp_padded(
+    edge_src,
+    edge_dst,
+    edge_metric,
+    edge_blocked,
+    roots,
+    mesh: Mesh,
+    num_nodes: int,
+) -> jax.Array:
+    """`sharded_sssp` for arbitrary sizes: pads roots to a multiple of
+    the sources axis (repeating the first root — duplicate columns are
+    dropped from the result) and the edge arrays to a multiple of the
+    graph axis (dead slots: INF metric, blocked). Returns [Vp, len(roots)].
+    """
+    s = mesh.shape[SOURCES_AXIS]
+    g = mesh.shape[GRAPH_AXIS]
+    b = roots.shape[0]
+    bp = -(-b // s) * s
+    if bp != b:
+        roots = jnp.concatenate(
+            [roots, jnp.broadcast_to(roots[0], (bp - b,))]
+        )
+    e = edge_src.shape[0]
+    ep = -(-e // g) * g
+    if ep != e:
+        pad = ep - e
+        edge_src = jnp.concatenate(
+            [edge_src, jnp.zeros(pad, edge_src.dtype)]
+        )
+        edge_dst = jnp.concatenate(
+            [edge_dst, jnp.full(pad, num_nodes - 1, edge_dst.dtype)]
+        )
+        edge_metric = jnp.concatenate(
+            [edge_metric, jnp.full(pad, INF_DIST, edge_metric.dtype)]
+        )
+        edge_blocked = jnp.concatenate(
+            [edge_blocked, jnp.ones(pad, edge_blocked.dtype)]
+        )
+    dist = sharded_sssp(
+        edge_src, edge_dst, edge_metric, edge_blocked, roots, mesh, num_nodes
+    )
+    return dist[:, :b]
