@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# CI: native build + lint (when ruff is installed) + full test suite.
+# Mirrors the reference's CI shape (build deps, compile, ctest) for this
+# repo: make -C native, ruff, pytest on the virtual 8-device CPU mesh.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+make -C native
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check openr_tpu tests benchmarks
+else
+    echo "== ruff not installed; skipping lint =="
+fi
+
+echo "== pytest =="
+python -m pytest tests/ -q
+
+echo "== driver contract =="
+python __graft_entry__.py 8
+
+echo "CI OK"

@@ -24,4 +24,4 @@ reference mount was empty at survey time (see SURVEY.md §0), so citations are
 path-level into the upstream tree layout, not file:line.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -57,14 +57,22 @@ async def run_node(config: Config, dataplane: str, store_path: str | None):
     # KvStore peering listener FIRST: its bound port (ephemeral-capable)
     # is what Spark advertises to neighbors (reference: the thrift
     # server carrying KvStore peer sessions †)
+    from openr_tpu.rpc.tls import client_ssl_context, server_ssl_context
+
     kv_rpc = RpcServer(f"{config.node_name}.kv")
-    kv_port = await kv_rpc.start(host, config.node.kvstore_port)
-    log.info("kvstore peering on %s:%d", host, kv_port)
+    kv_port = await kv_rpc.start(
+        host, config.node.kvstore_port,
+        ssl=server_ssl_context(config.node.tls),
+    )
+    log.info(
+        "kvstore peering on %s:%d%s", host, kv_port,
+        " (tls)" if config.node.tls.enabled else "",
+    )
 
     node = OpenrNode(
         config,
         io,
-        TcpKvTransport(),
+        TcpKvTransport(ssl=client_ssl_context(config.node.tls)),
         fib_handler=fib_handler,
         kvstore_port=kv_port,
         endpoint_host=host,

@@ -33,7 +33,7 @@ def _run(ctx: click.Context, method: str, params: dict | None = None):
     port = ctx.obj["port"]
 
     async def go():
-        cli_ = RpcClient(host=host, port=port)
+        cli_ = RpcClient(host=host, port=port, ssl=ctx.obj.get("ssl"))
         await cli_.connect(timeout=ctx.obj["timeout"])
         try:
             return await cli_.call(method, params or {}, timeout=ctx.obj["timeout"])
@@ -92,11 +92,24 @@ def _nh_str(nh: dict) -> str:
 @click.option("--port", default=CTRL_PORT, show_default=True, type=int,
               help="ctrl server port")
 @click.option("--timeout", default=10.0, show_default=True, type=float)
+@click.option("--cacert", default="", help="CA bundle for a TLS ctrl server")
+@click.option("--cert", default="", help="client certificate (mutual TLS)")
+@click.option("--key", default="", help="client key (mutual TLS)")
 @click.pass_context
-def cli(ctx, host, port, timeout):
+def cli(ctx, host, port, timeout, cacert, cert, key):
     """breeze — query and control a running openr_tpu node."""
     ctx.ensure_object(dict)
-    ctx.obj.update(host=host, port=port, timeout=timeout)
+    ssl_ctx = None
+    if cacert:
+        from openr_tpu.config.config import TlsConfig
+        from openr_tpu.rpc.tls import client_ssl_context
+
+        ssl_ctx = client_ssl_context(
+            TlsConfig(
+                enabled=True, ca_path=cacert, cert_path=cert, key_path=key
+            )
+        )
+    ctx.obj.update(host=host, port=port, timeout=timeout, ssl=ssl_ctx)
 
 
 @cli.command()

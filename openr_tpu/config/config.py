@@ -183,6 +183,21 @@ class UdpInterfaceConfig:
 
 
 @dataclass
+class TlsConfig:
+    """Control-plane TLS (reference: thrift server TLS knobs †, the
+    ctrl-server's optional secure thrift). Applied to the ctrl listener
+    and the KvStore RPC mesh; contexts built by openr_tpu.rpc.tls."""
+
+    enabled: bool = False
+    cert_path: str = ""
+    key_path: str = ""
+    ca_path: str = ""  # trust anchor for peer verification (both sides)
+    # require a verified client certificate (router-to-router mutual
+    # auth); operator CLIs without certs need this off on ctrl
+    require_client_cert: bool = True
+
+
+@dataclass
 class NodeConfig:
     """Root config document (reference: OpenrConfig.thrift † OpenrConfig)."""
 
@@ -216,6 +231,8 @@ class NodeConfig:
     udp_interfaces: tuple[UdpInterfaceConfig, ...] = ()
     # host to bind kvstore/ctrl listeners + advertise to neighbors
     endpoint_host: str = "127.0.0.1"
+    # optional control-plane TLS (ctrl + kvstore RPC listeners/dialers)
+    tls: TlsConfig = field(default_factory=TlsConfig)
 
 
 class Config:

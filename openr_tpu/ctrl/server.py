@@ -46,7 +46,13 @@ class CtrlServer(OpenrModule):
     # ------------------------------------------------------------ lifecycle
 
     async def main(self) -> None:
-        self.port = await self.server.start(self.host, self._requested_port)
+        from openr_tpu.rpc.tls import server_ssl_context
+
+        tls = getattr(self.node.config.node, "tls", None)
+        ssl_ctx = server_ssl_context(tls) if tls is not None else None
+        self.port = await self.server.start(
+            self.host, self._requested_port, ssl=ssl_ctx
+        )
         self.spawn(self._fanout(self._kv_reader, self._kv_subs, self._encode_pub),
                    name=f"{self.name}.kvfan")
         self.spawn(self._fanout(self._fib_reader, self._fib_subs, self._encode_fib),

@@ -106,11 +106,15 @@ class _InProcSession:
 
 
 class TcpKvTransport:
-    """RPC-over-TCP sessions to peers' KvStore servers."""
+    """RPC-over-TCP sessions to peers' KvStore servers. Pass a client
+    `ssl.SSLContext` (rpc.tls.client_ssl_context) for a TLS mesh."""
+
+    def __init__(self, ssl=None):
+        self.ssl = ssl
 
     async def connect(self, peer_id: str, endpoint: tuple[str, int]):
         host, port = endpoint
-        client = RpcClient(host, port)
+        client = RpcClient(host, port, ssl=self.ssl)
         await client.connect()
         return _TcpSession(client, peer_id)
 

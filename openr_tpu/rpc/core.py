@@ -73,10 +73,13 @@ class RpcServer:
     def register_stream(self, method: str, fn: Handler) -> None:
         self._streams[method] = fn
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
-        """Bind and serve; returns the bound port (0 → ephemeral)."""
+    async def start(
+        self, host: str = "127.0.0.1", port: int = 0, ssl=None
+    ) -> int:
+        """Bind and serve; returns the bound port (0 → ephemeral).
+        Pass an `ssl.SSLContext` (see rpc.tls) for a TLS listener."""
         self._server = await asyncio.start_server(
-            self._on_conn, host, port, limit=MAX_LINE
+            self._on_conn, host, port, limit=MAX_LINE, ssl=ssl
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -161,9 +164,10 @@ class RpcServer:
 class RpcClient:
     """One connection; concurrent calls multiplexed by request id."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, ssl=None):
         self.host = host
         self.port = port
+        self.ssl = ssl  # ssl.SSLContext (rpc.tls) or None for plaintext
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._next_id = 1
@@ -177,7 +181,9 @@ class RpcClient:
 
     async def connect(self, timeout: float = 5.0) -> None:
         self._reader, self._writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port, limit=MAX_LINE),
+            asyncio.open_connection(
+                self.host, self.port, limit=MAX_LINE, ssl=self.ssl
+            ),
             timeout,
         )
         self._rx_task = asyncio.ensure_future(self._rx_loop())

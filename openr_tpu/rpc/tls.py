@@ -1,0 +1,44 @@
+"""Optional TLS for the control plane (ctrl server + KvStore RPC mesh).
+
+reference: openr/ctrl-server/ † runs its thrift service with optional
+TLS (secure thrift via fizz/wangle; cert/key/CA paths in config, with
+mutual auth between routers). The rebuild's equivalent: `ssl.SSLContext`
+on the asyncio listeners/dialers of `openr_tpu.rpc.core`, built from the
+same cert/key/CA triple, with mutual auth on by default — a router mesh
+is exactly the peer-to-peer case client-cert verification exists for.
+"""
+
+from __future__ import annotations
+
+import ssl
+
+# cfg is openr_tpu.config.TlsConfig (duck-typed here so the config
+# package stays import-light)
+
+
+def server_ssl_context(cfg) -> ssl.SSLContext | None:
+    if not cfg.enabled:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cfg.cert_path, cfg.key_path)
+    if cfg.ca_path:
+        ctx.load_verify_locations(cfg.ca_path)
+    if cfg.require_client_cert:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(cfg) -> ssl.SSLContext | None:
+    if not cfg.enabled:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    if cfg.ca_path:
+        ctx.load_verify_locations(cfg.ca_path)
+    # routers dial each other by IP; identity comes from the CA-signed
+    # cert (and mutual auth), not DNS hostnames
+    ctx.check_hostname = False
+    if cfg.cert_path:
+        ctx.load_cert_chain(cfg.cert_path, cfg.key_path)
+    return ctx

@@ -118,3 +118,172 @@ def test_kvstore_peering_over_tcp():
             await servers[name].stop()
 
     run(main())
+
+
+# ---- TLS (reference: optional secure thrift on the ctrl server †) ---------
+
+
+import subprocess
+
+
+@pytest.fixture(scope="module")
+def tls_pki(tmp_path_factory):
+    """Self-signed CA + one server/client cert pair signed by it."""
+    d = tmp_path_factory.mktemp("pki")
+
+    def sh(*args):
+        subprocess.run(args, check=True, capture_output=True)
+
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    sh("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+       "-keyout", str(ca_key), "-out", str(ca_crt),
+       "-days", "1", "-subj", "/CN=openr-test-ca")
+    for name in ("server", "client"):
+        key, csr, crt = d / f"{name}.key", d / f"{name}.csr", d / f"{name}.crt"
+        sh("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+           "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={name}")
+        sh("openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+           "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
+           "-out", str(crt))
+    return d
+
+
+def _tls_cfg(d, who, require_client=True):
+    from openr_tpu.config.config import TlsConfig
+
+    return TlsConfig(
+        enabled=True,
+        cert_path=str(d / f"{who}.crt"),
+        key_path=str(d / f"{who}.key"),
+        ca_path=str(d / "ca.crt"),
+        require_client_cert=require_client,
+    )
+
+
+def test_tls_round_trip(tls_pki):
+    """Mutual-TLS RPC: call + streaming subscribe over an encrypted
+    listener, with both ends verifying against the shared CA."""
+    from openr_tpu.rpc.tls import client_ssl_context, server_ssl_context
+
+    async def main():
+        server = RpcServer("tls-test")
+
+        async def echo(params):
+            return {"echo": params["x"]}
+
+        async def counter(params, stream):
+            for i in range(3):
+                await stream.send(i)
+
+        server.register("echo", echo)
+        server.register_stream("count", counter)
+        port = await server.start(
+            "127.0.0.1", 0, ssl=server_ssl_context(_tls_cfg(tls_pki, "server"))
+        )
+        client = RpcClient(
+            "127.0.0.1", port,
+            ssl=client_ssl_context(_tls_cfg(tls_pki, "client")),
+        )
+        await client.connect()
+        assert await client.call("echo", {"x": 42}) == {"echo": 42}
+        got = [i async for i in await client.subscribe("count")]
+        assert got == [0, 1, 2]
+        await client.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_tls_rejects_plaintext_and_unverified(tls_pki):
+    """A plaintext client can't talk to a TLS listener, and a client
+    without a certificate is rejected when mutual auth is required."""
+    import ssl as ssl_mod
+
+    from openr_tpu.rpc.tls import client_ssl_context, server_ssl_context
+
+    async def main():
+        server = RpcServer("tls-reject")
+
+        async def echo(params):
+            return params
+
+        server.register("echo", echo)
+        port = await server.start(
+            "127.0.0.1", 0, ssl=server_ssl_context(_tls_cfg(tls_pki, "server"))
+        )
+        # plaintext client: the call must fail, not hang
+        plain = RpcClient("127.0.0.1", port)
+        await plain.connect()
+        with pytest.raises(RpcError):
+            await plain.call("echo", {"x": 1}, timeout=2)
+        await plain.close()
+        # certless TLS client against require_client_cert
+        anon_cfg = _tls_cfg(tls_pki, "client")
+        anon_cfg.cert_path = ""
+        anon_cfg.key_path = ""
+        anon = RpcClient(
+            "127.0.0.1", port, ssl=client_ssl_context(anon_cfg)
+        )
+        with pytest.raises((RpcError, ssl_mod.SSLError, ConnectionError)):
+            await anon.connect()
+            await anon.call("echo", {"x": 1}, timeout=2)
+        await anon.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_tls_kv_transport_end_to_end(tls_pki):
+    """Two KvStores peer over the TLS TCP transport and converge."""
+    from openr_tpu.config import Config
+    from openr_tpu.kvstore import KvStore
+    from openr_tpu.kvstore.kvstore import PeerSpec
+    from openr_tpu.kvstore.transport import TcpKvTransport
+    from openr_tpu.messaging import ReplicateQueue
+    from openr_tpu.rpc.tls import client_ssl_context, server_ssl_context
+    from openr_tpu.types.kvstore import Value
+
+    async def main():
+        stores, servers, ports = {}, {}, {}
+        for name in ("a", "b"):
+            cfg = Config.default(name)
+            q = ReplicateQueue(name=f"{name}.pubs")
+            s = KvStore(
+                cfg,
+                TcpKvTransport(
+                    ssl=client_ssl_context(_tls_cfg(tls_pki, "client"))
+                ),
+                q,
+            )
+            rpc = RpcServer(f"{name}.kv")
+            s.register_rpc(rpc)
+            ports[name] = await rpc.start(
+                "127.0.0.1", 0,
+                ssl=server_ssl_context(_tls_cfg(tls_pki, "server")),
+            )
+            stores[name], servers[name] = s, rpc
+            await s.start()
+        stores["a"].add_peer_sync(
+            PeerSpec(node_name="b", endpoint=("127.0.0.1", ports["b"]))
+        )
+        stores["b"].add_peer_sync(
+            PeerSpec(node_name="a", endpoint=("127.0.0.1", ports["a"]))
+        )
+        await asyncio.sleep(0.2)
+        stores["a"].set_key(
+            "0", "k",
+            Value(version=1, originator_id="a", value=b"tls").with_hash(),
+        )
+        for _ in range(100):
+            if (v := stores["b"].get_key("0", "k")) is not None:
+                assert v.value == b"tls"
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise AssertionError("no convergence over TLS")
+        for s in stores.values():
+            await s.stop()
+        for r in servers.values():
+            await r.stop()
+
+    run(main())
