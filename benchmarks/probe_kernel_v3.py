@@ -1,0 +1,192 @@
+"""Micro-probes for SPF kernel v3 design choices (v5e).
+
+  1. d-loop gather with 1/2/4 independent min-chains (ILP)
+  2. batch width B=8/16/32 effect on the d-loop gather
+  3. degree-bucketed sweep: realistic widths (half nodes D=32, rest D=16/64)
+  4. sparse-tail round: compact frontier (sort VP keys) + small gather +
+     sort-based scatter — the cleanup-phase building block
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+rng = np.random.default_rng(0)
+K = 12
+VP = 100352
+D = 64
+
+def _leaf(out):
+    return float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+
+
+def timed(fn, *args, n=4):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    _leaf(out)
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _leaf(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def bench(name, make_body, init, rows):
+    try:
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def run(init, k):
+            return jax.lax.fori_loop(0, k, lambda i, c: make_body(c), init)
+
+        t1 = timed(lambda a: run(a, 1), init)
+        tk = timed(lambda a: run(a, K), init)
+        per = (tk - t1) / (K - 1)
+        rate = rows / (per / 1e3) / 1e9 if per > 0.005 else float("inf")
+        print(f"  {name:44s} {per:8.2f} ms   {rate:6.3f} Grows/s")
+    except Exception as e:  # noqa: BLE001
+        lines = [l for l in str(e).splitlines() if l.strip()] or [repr(e)]
+        print(f"  {name:44s} FAIL {lines[0][:140]}")
+    finally:
+        gc.collect()
+
+
+print(f"# device: {jax.devices()[0]}")
+
+nbr_h = rng.integers(0, VP, size=(VP, D), dtype=np.int32)
+wgt_h = rng.integers(1, 64, size=(VP, D), dtype=np.int32)
+nbr = jnp.asarray(nbr_h)
+wgt = jnp.asarray(wgt_h)
+INF = np.int32(1 << 30)
+
+
+def mk_dloop(nchains, b):
+    dist0 = jnp.asarray(
+        rng.integers(0, 1 << 20, size=(VP, b), dtype=np.int32)
+    )
+
+    def body(c):
+        dist, = c
+        accs = [dist] + [
+            jnp.full((VP, b), INF, jnp.int32) for _ in range(nchains - 1)
+        ]
+        for d in range(D):
+            g = dist[nbr[:, d]]
+            cand = g + wgt[:, d][:, None]
+            i = d % nchains
+            accs[i] = jnp.minimum(accs[i], cand)
+        acc = accs[0]
+        for a in accs[1:]:
+            acc = jnp.minimum(acc, a)
+        return (jnp.minimum(acc, INF),)
+
+    return body, (dist0,)
+
+
+for nch, b in ((2, 32),):
+    body, init = mk_dloop(nch, b)
+    bench(f"d-loop B={b} chains={nch}", body, init, VP * D)
+
+
+# ---- bucketed: 50% of nodes D=16, 35% D=32, 15% D=64 -------------------
+splits = [(int(VP * 0.5) // 512 * 512, 16),
+          (int(VP * 0.35) // 512 * 512, 32)]
+splits.append((VP - sum(s for s, _ in splits), 64))
+tabs = []
+off = 0
+for cnt, dd in splits:
+    tabs.append((
+        jnp.asarray(rng.integers(0, VP, size=(cnt, dd), dtype=np.int32)),
+        jnp.asarray(rng.integers(1, 64, size=(cnt, dd), dtype=np.int32)),
+        off,
+    ))
+    off += cnt
+rows_bucketed = sum(cnt * dd for cnt, dd in splits)
+
+
+def body_bucket(c):
+    dist, = c
+    outs = []
+    for tnbr, twgt, _o in tabs:
+        cnt, dd = tnbr.shape
+        acc = jnp.full((cnt, 32), INF, jnp.int32)
+        acc2 = jnp.full((cnt, 32), INF, jnp.int32)
+        for d in range(dd):
+            g = dist[tnbr[:, d]]
+            cand = g + twgt[:, d][:, None]
+            if d % 2 == 0:
+                acc = jnp.minimum(acc, cand)
+            else:
+                acc2 = jnp.minimum(acc2, cand)
+        outs.append(jnp.minimum(acc, acc2))
+    new = jnp.concatenate(outs, axis=0)
+    return (jnp.minimum(new, dist),)
+
+
+dist0 = jnp.asarray(rng.integers(0, 1 << 20, size=(VP, 32), dtype=np.int32))
+bench(f"bucketed sweep ({rows_bucketed/1e6:.1f}M rows)", body_bucket,
+      (dist0,), rows_bucketed)
+
+
+# ---- sparse tail round --------------------------------------------------
+# frontier: ~2k changed nodes; compact via top_k on changed mask, gather
+# their out-rows (Dout=64), sort (dst,cand), segment-min via sorted ids
+FMAX = 4096
+out_nbr = jnp.asarray(rng.integers(0, VP, size=(VP, D), dtype=np.int32))
+out_wgt = jnp.asarray(rng.integers(1, 64, size=(VP, D), dtype=np.int32))
+
+
+def body_sparse(c):
+    dist, changed = c  # changed: [VP] bool mask (~2k true)
+    # compact: key = (not-changed)<<20 | id  -> sort -> first FMAX
+    key = jnp.where(changed, 0, 1 << 20) + jnp.arange(VP, dtype=jnp.int32)
+    ids = jnp.sort(key)[:FMAX] & ((1 << 20) - 1)
+    fnbr = out_nbr[ids]          # [FMAX, D] gather
+    fwgt = out_wgt[ids]
+    fdist = dist[ids]            # [FMAX, B]
+    cand = fdist[:, :1] + fwgt   # [FMAX, D] (B=1 tail for probe)
+    flat_dst = fnbr.reshape(-1)
+    flat_val = cand.reshape(-1)
+    ks, vs = jax.lax.sort([flat_dst, flat_val], num_keys=1)
+    upd = jax.ops.segment_min(
+        vs, ks, num_segments=VP, indices_are_sorted=True
+    )
+    nd = jnp.minimum(dist, upd[:, None])
+    return (nd, changed != (nd[:, 0] < dist[:, 0]))
+
+
+ch0 = jnp.asarray(rng.random(VP) < 0.02)
+bench(f"sparse round F={FMAX} (gather+sort+segmin)", body_sparse,
+      (dist0, ch0), FMAX * D)
+
+
+# ---- scatter via scatter_min with unique-ish small input ----------------
+def body_sc(c):
+    dist, changed = c
+    key = jnp.where(changed, 0, 1 << 20) + jnp.arange(VP, dtype=jnp.int32)
+    ids = jnp.sort(key)[:FMAX] & ((1 << 20) - 1)
+    fnbr = out_nbr[ids]
+    fwgt = out_wgt[ids]
+    fdist = dist[ids]
+    cand = fdist[:, :1] + fwgt
+    upd = jax.ops.segment_min(
+        cand.reshape(-1), fnbr.reshape(-1), num_segments=VP
+    )
+    nd = jnp.minimum(dist, upd[:, None])
+    return (nd, changed != (nd[:, 0] < dist[:, 0]))
+
+
+bench(f"sparse round F={FMAX} (unsorted segmin)", body_sc,
+      (dist0, ch0), FMAX * D)

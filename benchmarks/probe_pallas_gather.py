@@ -1,0 +1,151 @@
+"""Probe Mosaic dynamic_gather SPF-sweep formulations on real TPU.
+
+Mosaic constraint (jax 0.9 lowering.py:_gather_lowering_rule): 2D only,
+indices.shape == input.shape, out[i,j] = in[idx[i,j], j] (dims=[0]) or
+out[i,j] = in[i, idx[i,j]] (dims=[1]). So a full SPF relax sweep is D
+same-shape gathers accumulated with min:
+
+  B1: for d in 0..D-1:  acc = min(acc, dist[nbr[:,d], :] + wgt[:,d,None])
+  B2: lane-packed ×4: dist tiled to [VP, 4B] so each gather moves 128
+      lanes (full VPU width) and D/4 gathers suffice.
+
+Each variant is one pallas_call over the whole VMEM-resident arrays.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+VP = 131072
+B = 32
+D = 64
+INF = np.int32(1 << 30)
+
+rng = np.random.default_rng(0)
+dist_h = rng.integers(0, 1 << 20, size=(VP, B), dtype=np.int32)
+nbr_h = rng.integers(0, VP, size=(VP, D), dtype=np.int32)
+wgt_h = rng.integers(1, 64, size=(VP, D), dtype=np.int32)
+dist = jnp.asarray(dist_h)
+nbr = jnp.asarray(nbr_h)
+wgt = jnp.asarray(wgt_h)
+
+ref = np.minimum(
+    (dist_h[nbr_h.reshape(-1)].reshape(VP, D, B).astype(np.int64)
+     + wgt_h[:, :, None]).min(axis=1),
+    dist_h,
+).astype(np.int32)
+ref_sum = int(np.int32(ref.astype(np.int64).sum() & 0xFFFFFFFF))
+
+
+def sync(x):
+    return int(x)
+
+
+def bench(name, fn, *args):
+    try:
+        out = fn(*args)
+        out.block_until_ready()
+        s = int(np.int32(sync(out.sum()) & 0xFFFFFFFF))
+        tag = "ok" if s == ref_sum else f"WRONG sum {s} != {ref_sum}"
+        times = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            sync(out.sum())
+            times.append((time.perf_counter() - t0) * 1e3)
+        times.sort()
+        p50 = times[len(times) // 2]
+        gb = VP * D * B * 4 / 1e9  # logical gathered bytes
+        print(f"  {name}: p50 {p50:7.2f} ms "
+              f"({gb/(p50/1e3):6.0f} GB/s eff)  [{tag}]")
+    except Exception as e:  # noqa: BLE001
+        lines = str(e).splitlines() or [repr(e)]
+        print(f"  {name}: FAIL {type(e).__name__}: {lines[0][:160]}")
+        for line in lines[1:4]:
+            print(f"      {line[:160]}")
+
+
+# ---------------- B1: d-loop of [VP, B] gathers --------------------------
+def k_b1(nbr_ref, wgt_ref, dist_ref, out_ref):
+    d_arr = dist_ref[:]
+    acc = d_arr
+    for d in range(D):
+        idx = jnp.broadcast_to(nbr_ref[:, d][:, None], (VP, B))
+        g = jnp.take_along_axis(d_arr, idx, axis=0)
+        acc = jnp.minimum(acc, g + wgt_ref[:, d][:, None])
+    out_ref[:] = acc
+
+
+@jax.jit
+def sweep_b1(nbr, wgt, dist):
+    return pl.pallas_call(
+        k_b1,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((VP, B), jnp.int32),
+    )(nbr, wgt, dist)
+
+
+# ---------------- B2: lane-packed 4× ------------------------------------
+def k_b2(nbr_ref, wgt_ref, dist_ref, out_ref):
+    d_arr = dist_ref[:]
+    wide = jnp.concatenate([d_arr, d_arr, d_arr, d_arr], axis=1)  # [VP, 4B]
+    acc = jnp.full((VP, 4 * B), INF, jnp.int32)
+    for d0 in range(0, D, 4):
+        idx = jnp.concatenate(
+            [
+                jnp.broadcast_to(nbr_ref[:, d0 + k][:, None], (VP, B))
+                for k in range(4)
+            ],
+            axis=1,
+        )
+        w = jnp.concatenate(
+            [
+                jnp.broadcast_to(wgt_ref[:, d0 + k][:, None], (VP, B))
+                for k in range(4)
+            ],
+            axis=1,
+        )
+        g = jnp.take_along_axis(wide, idx, axis=0)
+        acc = jnp.minimum(acc, g + w)
+    a = jnp.minimum(
+        jnp.minimum(acc[:, 0:B], acc[:, B : 2 * B]),
+        jnp.minimum(acc[:, 2 * B : 3 * B], acc[:, 3 * B :]),
+    )
+    out_ref[:] = jnp.minimum(a, d_arr)
+
+
+@jax.jit
+def sweep_b2(nbr, wgt, dist):
+    return pl.pallas_call(
+        k_b2,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((VP, B), jnp.int32),
+    )(nbr, wgt, dist)
+
+
+# ---------------- X: XLA reference sweep --------------------------------
+@jax.jit
+def sweep_xla(nbr, wgt, dist):
+    d = dist[nbr]
+    cand = jnp.minimum(d + wgt[:, :, None], INF)
+    return jnp.minimum(cand.min(axis=1), dist)
+
+
+print(f"# device: {jax.devices()[0]}  VP={VP} D={D} B={B}")
+bench("X  xla sweep   ", sweep_xla, nbr, wgt, dist)
+bench("B1 d-loop 32ln ", sweep_b1, nbr, wgt, dist)
+bench("B2 packed 128ln", sweep_b2, nbr, wgt, dist)

@@ -74,9 +74,16 @@ class DecisionConfig:
     # VMEM-resident Pallas relax kernel (TPU only; falls back to the XLA
     # dense kernel when the distance matrix exceeds the VMEM budget)
     use_pallas_kernel: bool = False
+    # batched kernel implementation: "split" (v3 split-width tables +
+    # compacted tail — the default) or "dense" (the r2 kernel)
+    spf_kernel: str = "split"
+    # native C++ radix-heap solver (native/spf) for the single-root RIB
+    # path: "auto" (use when built and LFA off), "on", "off"
+    native_rib: str = "auto"
     enable_lfa: bool = False
     # edge-disjoint paths per SR-MPLS KSP prefix (reference hardwires 2
-    # in KSP2_ED_ECMP †; BASELINE config 4 exercises k=16)
+    # in KSP2_ED_ECMP †; BASELINE config 4 exercises k=16; the batched
+    # kernel supports k<=16 — validated)
     ksp_paths: int = 2
 
 
@@ -294,6 +301,17 @@ class Config:
         d = n.decision
         if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
             raise ConfigError("decision: debounce min must be <= max")
+        if not (1 <= d.ksp_paths <= 16):
+            raise ConfigError(
+                "decision: ksp_paths must be in 1..16 (the vectorized "
+                "k-disjoint-paths kernel bound — ops/ksp.py)"
+            )
+        if d.spf_kernel not in ("split", "dense"):
+            raise ConfigError("decision: spf_kernel must be split|dense")
+        if d.native_rib not in ("auto", "on", "off"):
+            raise ConfigError(
+                "decision: native_rib must be auto|on|off"
+            )
         k = n.kvstore
         if k.key_ttl_ms <= 0:
             raise ConfigError("kvstore: key_ttl_ms must be positive")

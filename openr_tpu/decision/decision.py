@@ -153,6 +153,8 @@ class Decision(OpenrModule):
                 use_pallas=dcfg.use_pallas_kernel,
                 enable_lfa=dcfg.enable_lfa,
                 ksp_k=dcfg.ksp_paths,
+                kernel_impl=dcfg.spf_kernel,
+                native_rib=dcfg.native_rib,
             )
         self.debounce = AsyncDebounce(
             dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes

@@ -35,9 +35,10 @@ import numpy as np
 
 from openr_tpu.ops.spf import DIST_DTYPE, INF_DIST
 
-# full working set must fit in a ~16 MB core (leave headroom for the
-# compiler's own temporaries)
-VMEM_BUDGET_BYTES = 15 * 1024 * 1024
+# v5e VMEM is 128 MiB (measured via pltpu.get_tpu_info():
+# vmem_capacity_bytes == 134_217_728); budget below leaves headroom for
+# the compiler's own temporaries and double-buffering
+VMEM_BUDGET_BYTES = 100 * 1024 * 1024
 
 
 def _footprint_bytes(

@@ -164,8 +164,13 @@ def test_solver_device_cache_incremental():
 
     dbs = ring_dbs(8)
     ls = fresh_ls(dbs)
-    for use_dense in (True, False):
-        solver = TpuSpfSolver(use_dense=use_dense)
+    engines = [
+        dict(use_dense=None, kernel_impl="split"),
+        dict(use_dense=True, kernel_impl="dense"),
+        dict(use_dense=False),
+    ]
+    for kw in engines:
+        solver = TpuSpfSolver(**kw)
         csr = ls.to_csr()
         # root at n3 so the n3→n4 metric bump changes its own distances
         roots = np.full(
@@ -180,7 +185,7 @@ def test_solver_device_cache_incremental():
         csr2 = ls2.to_csr()
         assert csr2.patches, "patch path not taken"
         d1 = np.asarray(solver._solve_dist(csr2, roots))
-        fresh = TpuSpfSolver(use_dense=use_dense)
+        fresh = TpuSpfSolver(**kw)
         d_ref = np.asarray(fresh._solve_dist(csr2, roots))
         np.testing.assert_array_equal(d1, d_ref)
         assert (d1 != d0).any()  # the metric change actually moved dists

@@ -1,0 +1,221 @@
+"""Tests for the native C++ SPF solver (native/spf + ops/native_spf.py).
+
+The native radix-heap Dijkstra + first-hop bitmask propagation must
+agree with the TPU kernel path on distances AND with the elementwise
+first-hop identity (ops.spf.first_hop_matrix) on ECMP first-hop sets —
+including overload semantics and parallel-link min-metrics.
+reference: openr/decision/LinkState.cpp † runSpf.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from openr_tpu.common.constants import DIST_INF
+from openr_tpu.ops.native_spf import OutCsr, native_available
+from openr_tpu.ops.spf import (
+    batched_sssp_dense,
+    build_dense_tables,
+    first_hop_matrix,
+    pad_batch,
+)
+from openr_tpu.utils import topogen
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="libopenr_spf.so not built"
+)
+
+
+def _tpu_reference(es, ed, em, vp, root, nbr_ids, nbr_metric, over):
+    """Distances + identity-based first-hop matrix via the jax path."""
+    n = len(nbr_ids)
+    b = pad_batch(1 + n)
+    dead = vp - 1
+    roots = np.full(b, root, dtype=np.int32)
+    roots[1 : 1 + n] = nbr_ids
+    nbr_ids_p = np.full(b - 1, dead, dtype=np.int32)
+    nbr_ids_p[:n] = nbr_ids
+    nbr_metric_p = np.full(b - 1, np.int32(DIST_INF - 1), dtype=np.int32)
+    nbr_metric_p[:n] = nbr_metric
+    nbr_over = np.ones(b - 1, dtype=bool)
+    nbr_over[:n] = over[nbr_ids]
+    tbl_nbr, tbl_wgt = build_dense_tables(es, ed, em, vp)
+    dist = batched_sssp_dense(
+        jnp.asarray(tbl_nbr), jnp.asarray(tbl_wgt), jnp.asarray(over),
+        jnp.asarray(roots), has_overloads=bool(over.any()),
+    )
+    fh = np.asarray(
+        first_hop_matrix(
+            dist, jnp.asarray(nbr_metric_p), jnp.asarray(nbr_ids_p),
+            jnp.asarray(nbr_over),
+        )
+    )
+    return np.asarray(dist), fh[:n]
+
+
+def _root_neighbors(es, ed, em, root):
+    valid = em < DIST_INF
+    mask = (es == root) & valid
+    ids = np.unique(ed[mask])
+    met = np.array(
+        [em[mask & (ed == d)].min() for d in ids], dtype=np.int32
+    )
+    return ids.astype(np.int32), met
+
+
+@pytest.mark.parametrize("n,deg,mw", [(300, 5, 16), (1500, 10, 64)])
+def test_native_rib_matches_identity(n, deg, mw):
+    es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
+        n, avg_degree=deg, seed=4, max_metric=mw
+    )
+    over = np.zeros(vp, bool)
+    oc = OutCsr.from_arrays(es, ed, em, vp, over)
+    root = 0
+    nbr_ids, nbr_met = _root_neighbors(es, ed, em, root)
+    dist, fh = oc.rib_solve(root, nbr_ids, nbr_met)
+    ref_dist, ref_fh = _tpu_reference(
+        es, ed, em, vp, root, nbr_ids, nbr_met, over
+    )
+    np.testing.assert_array_equal(dist[:nn], ref_dist[:nn, 0])
+    np.testing.assert_array_equal(fh[:, :nn], ref_fh[:, :nn])
+
+
+def test_native_overload_semantics():
+    es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
+        400, avg_degree=6, seed=9, max_metric=16
+    )
+    rng = np.random.default_rng(3)
+    over = np.zeros(vp, bool)
+    over[rng.integers(0, nn, 25)] = True
+    root = int(np.nonzero(over)[0][0])  # overloaded root: exemption path
+    oc = OutCsr.from_arrays(es, ed, em, vp, over)
+    nbr_ids, nbr_met = _root_neighbors(es, ed, em, root)
+    dist, fh = oc.rib_solve(root, nbr_ids, nbr_met)
+    ref_dist, ref_fh = _tpu_reference(
+        es, ed, em, vp, root, nbr_ids, nbr_met, over
+    )
+    np.testing.assert_array_equal(dist[:nn], ref_dist[:nn, 0])
+    np.testing.assert_array_equal(fh[:, :nn], ref_fh[:, :nn])
+
+
+def test_native_batch_matches_singles():
+    es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
+        500, avg_degree=5, seed=6, max_metric=8
+    )
+    oc = OutCsr.from_arrays(es, ed, em, vp)
+    roots = np.array([0, 7, 99, 250], dtype=np.int32)
+    batch = oc.dijkstra_batch(roots)
+    for i, r in enumerate(roots):
+        np.testing.assert_array_equal(batch[i], oc.dijkstra(int(r)))
+
+
+def test_native_many_neighbors_multiword_mask():
+    """>64 neighbors exercises the multi-word fh bitmask path."""
+    hub, leaves = 0, 80
+    edges = []
+    for i in range(1, leaves + 1):
+        edges.append((hub, i, 1 + (i % 5)))
+        edges.append((i, hub, 1 + (i % 5)))
+    # chain off leaf 1 so some dests are 2+ hops away
+    edges += [(1, leaves + 1, 2), (leaves + 1, 1, 2)]
+    n = leaves + 2
+    src = np.array([e[0] for e in edges], np.int32)
+    dst = np.array([e[1] for e in edges], np.int32)
+    met = np.array([e[2] for e in edges], np.int32)
+    vp = 128
+    pad = 256 - len(src)
+    es = np.concatenate([src, np.zeros(pad, np.int32)])
+    ed = np.concatenate([dst, np.full(pad, vp - 1, np.int32)])
+    em = np.concatenate([met, np.full(pad, DIST_INF, np.int32)])
+    order = np.argsort(ed, kind="stable")
+    es, ed, em = es[order], ed[order], em[order]
+    over = np.zeros(vp, bool)
+    oc = OutCsr.from_arrays(es, ed, em, vp, over)
+    nbr_ids, nbr_met = _root_neighbors(es, ed, em, hub)
+    assert len(nbr_ids) == leaves  # > 64 -> two mask words
+    dist, fh = oc.rib_solve(hub, nbr_ids, nbr_met)
+    ref_dist, ref_fh = _tpu_reference(
+        es, ed, em, vp, hub, nbr_ids, nbr_met, over
+    )
+    np.testing.assert_array_equal(dist[:n], ref_dist[:n, 0])
+    np.testing.assert_array_equal(fh[:, :n], ref_fh[:, :n])
+
+
+def test_native_incremental_patch_forwarding():
+    """The solver's cached OutCsr must absorb metric-only churn patches
+    and match a fresh solve (same contract as the device-array cache)."""
+    from openr_tpu.decision.linkstate import LinkState
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.types.topology import Adjacency, AdjacencyDatabase
+
+    def adj(other, ifn, metric):
+        return Adjacency(
+            other_node_name=other, if_name=ifn,
+            other_if_name=f"to-{ifn}", metric=metric,
+        )
+
+    def db(node, *adjs):
+        return AdjacencyDatabase(
+            this_node_name=node, adjacencies=tuple(adjs), node_label=0
+        )
+
+    ls = LinkState()
+    n = 8
+    for i in range(n):
+        lo, hi = (i - 1) % n, (i + 1) % n
+        ls.update_adjacency_db(
+            db(f"n{i}", adj(f"n{lo}", f"if{i}{lo}", 10),
+               adj(f"n{hi}", f"if{i}{hi}", 10))
+        )
+    solver = TpuSpfSolver(native_rib="on")
+    got0 = solver.solve(ls, "n3")
+    assert got0 is not None
+    ls.update_adjacency_db(
+        db("n3", adj("n2", "if32", 10), adj("n4", "if34", 70))
+    )
+    csr2 = ls.to_csr()
+    assert csr2.patches, "patch path not taken"
+    _csr, dist1, fh1, _nbrs, _ = solver.solve(ls, "n3")
+    fresh = TpuSpfSolver(native_rib="on")
+    _csr2, dist2, fh2, _n2, _ = fresh.solve(ls, "n3")
+    np.testing.assert_array_equal(dist1, dist2)
+    np.testing.assert_array_equal(fh1, fh2)
+
+
+def test_native_zero_metric_ties():
+    """Zero-metric links create tight edges between equal-distance
+    nodes; the fh propagation must still match the identity (fixpoint
+    iteration inside openr_spf_rib)."""
+    # root 0 -> {1, 2}; 2 -0-> 3; 1 -0-> 3 ... plus a chain beyond 3,
+    # with ids arranged so the zero-edge goes from HIGHER dist-rank-id
+    # to lower (the order a single pass gets wrong).
+    edges = [
+        (0, 1, 5), (1, 0, 5),
+        (0, 2, 5), (2, 0, 5),
+        (2, 1, 0), (1, 2, 0),     # zero-metric tie between equal-dist
+        (1, 3, 4), (3, 1, 4),
+        (3, 4, 2), (4, 3, 2),
+    ]
+    n = 5
+    src = np.array([e[0] for e in edges], np.int32)
+    dst = np.array([e[1] for e in edges], np.int32)
+    met = np.array([e[2] for e in edges], np.int32)
+    vp = 8
+    pad = 16 - len(src)
+    es = np.concatenate([src, np.zeros(pad, np.int32)])
+    ed = np.concatenate([dst, np.full(pad, vp - 1, np.int32)])
+    em = np.concatenate([met, np.full(pad, DIST_INF, np.int32)])
+    order = np.argsort(ed, kind="stable")
+    es, ed, em = es[order], ed[order], em[order]
+    over = np.zeros(vp, bool)
+    oc = OutCsr.from_arrays(es, ed, em, vp, over)
+    nbr_ids, nbr_met = _root_neighbors(es, ed, em, 0)
+    dist, fh = oc.rib_solve(0, nbr_ids, nbr_met)
+    ref_dist, ref_fh = _tpu_reference(es, ed, em, vp, 0, nbr_ids, nbr_met, over)
+    np.testing.assert_array_equal(dist[:n], ref_dist[:n, 0])
+    np.testing.assert_array_equal(fh[:, :n], ref_fh[:, :n])
+    # both neighbors must be ECMP first hops toward node 3 (via the
+    # 0-metric tie both 1 and 2 sit on shortest paths)
+    assert fh[:, 3].sum() == 2

@@ -45,12 +45,22 @@ def _overload(db: AdjacencyDatabase) -> AdjacencyDatabase:
 
 def _assert_rib_equal(ls, ps, node):
     want = oracle_routes(ls, ps, node)
-    # both kernel paths (dense in-neighbor table and edge-list segment-min)
-    # must match the oracle exactly
-    for use_dense in (True, False):
-        got = TpuSpfSolver(use_dense=use_dense).compute_routes(ls, ps, node)
-        assert got.unicast_routes == want.unicast_routes, (node, use_dense)
-        assert got.mpls_routes == want.mpls_routes, (node, use_dense)
+    # every engine must match the oracle exactly: the v3 split kernel,
+    # the r2 dense kernel, the edge-list segment-min kernel, and the
+    # native C++ radix-heap solver (skipped if the .so isn't built)
+    engines = [
+        dict(use_dense=None, kernel_impl="split", native_rib="off"),
+        dict(use_dense=True, kernel_impl="dense", native_rib="off"),
+        dict(use_dense=False, native_rib="off"),
+    ]
+    from openr_tpu.ops.native_spf import native_available
+
+    if native_available():
+        engines.append(dict(native_rib="on"))
+    for kw in engines:
+        got = TpuSpfSolver(**kw).compute_routes(ls, ps, node)
+        assert got.unicast_routes == want.unicast_routes, (node, kw)
+        assert got.mpls_routes == want.mpls_routes, (node, kw)
 
 
 TOPOLOGIES = {
