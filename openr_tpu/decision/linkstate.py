@@ -456,6 +456,15 @@ class PrefixState:
     def __init__(self, area: str = DEFAULT_AREA):
         self.area = area
         self._entries: dict[IpPrefix, dict[str, PrefixEntry]] = {}
+        # bumped on every mutation: keys the solver-view cache below.
+        # The cache lives in a shared one-cell list (same pattern as
+        # LinkState._csr_cell): per-rebuild snapshots share the cell, so
+        # a view built during an off-thread solve is visible to the live
+        # object and later snapshots — without sharing, the production
+        # path (Decision snapshots PrefixState per rebuild) would build
+        # the view on a throwaway copy every time.
+        self._rev = 0
+        self._view_cell: list = [None]
 
     def update_prefix_db(self, db: PrefixDatabase) -> set[IpPrefix]:
         """Apply a node's prefix advertisement; returns changed prefixes."""
@@ -471,13 +480,73 @@ class PrefixState:
             if per_node.get(node) != entry:
                 per_node[node] = entry
                 changed.add(entry.prefix)
+        if changed:
+            self._rev += 1
         return changed
 
     def snapshot(self) -> "PrefixState":
         """Consistent copy for off-thread solves (entries are frozen)."""
         snap = PrefixState(self.area)
         snap._entries = {p: dict(per) for p, per in self._entries.items()}
+        snap._rev = self._rev
+        snap._view_cell = self._view_cell  # shared cell, rev-keyed
         return snap
+
+    def solver_view(self, name_to_id: dict, base_version: int):
+        """Cached columnar classification for RIB assembly.
+
+        Splits prefixes into the overwhelmingly common "plain" shape —
+        exactly one advertiser known to the topology, SP_ECMP
+        forwarding, no min_nexthop/weight constraints — and everything
+        else. Plain prefixes get numpy originator-id arrays so the
+        solver assembles their routes vectorized (unique first-hop-
+        column classes) instead of a per-prefix python loop; the rest
+        keep the general path. Cached on (prefix rev, topology base):
+        under metric-only churn neither changes, so steady-state
+        rebuilds skip the O(P) classification entirely.
+
+        Returns (plain_prefixes, plain_nodes, plain_entries,
+        orig_ids [P] int64, complex_items).
+        """
+        key = (self._rev, base_version)
+        cached = self._view_cell[0]
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from openr_tpu.types.topology import ForwardingAlgorithm
+
+        plain_p: list = []
+        plain_n: list = []
+        plain_e: list = []
+        orig: list = []
+        complex_items: list = []
+        for prefix, per_node in sorted(self._entries.items()):
+            if len(per_node) == 1:
+                (node, entry), = per_node.items()
+                nid = name_to_id.get(node)
+                if (
+                    nid is not None
+                    and entry.forwarding_algorithm
+                    == ForwardingAlgorithm.SP_ECMP
+                    and not entry.min_nexthop
+                    and not entry.weight
+                ):
+                    plain_p.append(prefix)
+                    plain_n.append(node)
+                    plain_e.append(entry)
+                    orig.append(nid)
+                    continue
+            # copy: the live object mutates per_node dicts in place, and
+            # this view may outlive this instance via the shared cell
+            complex_items.append((prefix, dict(per_node)))
+        data = (
+            plain_p,
+            plain_n,
+            plain_e,
+            np.asarray(orig, dtype=np.int64),
+            complex_items,
+        )
+        self._view_cell[0] = (key, data)
+        return data
 
     def withdraw(self, node: str, prefix: IpPrefix) -> bool:
         per_node = self._entries.get(prefix)
@@ -485,6 +554,7 @@ class PrefixState:
             del per_node[node]
             if not per_node:
                 del self._entries[prefix]
+            self._rev += 1
             return True
         return False
 

@@ -234,23 +234,27 @@ class TpuSpfSolver:
         cache["version"] = csr.version
 
     def _pick_table(self, csr) -> str:
-        """Which table set the batched solve uses for this topology."""
+        """Which table set the batched solve uses for this topology.
+
+        Explicit knobs outrank the kernel_impl default: use_dense=False
+        forces the edge-list kernel, use_dense=True (or use_pallas,
+        which consumes the full dense tables) forces the r2 dense
+        kernel; only use_dense=None follows kernel_impl.
+        """
         if self.use_dense is False:
             return "edge"
-        if self.use_pallas:
-            # the Pallas VMEM kernel consumes the full dense tables —
-            # honor the explicit knob over the split default
+        if self.use_pallas or self.use_dense is True:
             return "dense"
         if self.kernel_impl == "split":
             # the split builder bounds hub waste by construction
             # (pick_base_width), so no edge-list escape hatch is needed
             return "split"
-        if self.use_dense is None:
-            # size check BEFORE materializing the tables (a single
-            # mega-hub node would make D ~ V and the tables ~ V^2)
-            table_slots = csr.padded_nodes * csr.dense_width()
-            if table_slots > self.dense_waste_limit * max(csr.num_edges, 1):
-                return "edge"
+        # kernel_impl == "dense", auto sizing: check BEFORE materializing
+        # the tables (a single mega-hub node would make D ~ V and the
+        # tables ~ V^2)
+        table_slots = csr.padded_nodes * csr.dense_width()
+        if table_slots > self.dense_waste_limit * max(csr.num_edges, 1):
+            return "edge"
         return "dense"
 
     def solve_vp(self, csr) -> int:
@@ -470,9 +474,73 @@ class TpuSpfSolver:
                 )
             return got
 
-        # ---- unicast ------------------------------------------------------
+        # ---- unicast: plain prefixes, vectorized --------------------------
+        # The dominant RIB shape is "one advertiser, SP_ECMP, no
+        # constraints" (every loopback in the fabric). PrefixState
+        # pre-classifies those (cached across churn), and their routes
+        # assemble here in bulk: reachability/IGP as numpy vectors, and
+        # NextHop construction deduplicated by unique (first-hop-column,
+        # igp) classes — in a fat-tree thousands of prefixes collapse to
+        # a handful of classes. The general per-prefix loop below keeps
+        # every other case (anycast, UCMP, KSP, min_nexthop, LFA).
+        plain_p, plain_n, plain_e, orig, complex_items = ps.solver_view(
+            csr.name_to_id, csr.base_version
+        )
+        if len(plain_p) and lfa is None:
+            reach = (
+                (d_root[orig] < INF_DIST) & fh_any[orig] & (orig != my_id)
+            )
+            igp = d_root[orig].astype(np.int32)
+            packed = np.packbits(fh, axis=0)  # [ceil(N/8), Vp]
+            idxs = np.nonzero(reach)[0]
+            key = np.concatenate(
+                [
+                    packed[:, orig[idxs]].T,
+                    np.ascontiguousarray(igp[idxs])
+                    .view(np.uint8)
+                    .reshape(len(idxs), 4),
+                ],
+                axis=1,
+            )
+            _ucls, uidx, inv = np.unique(
+                key, axis=0, return_index=True, return_inverse=True
+            )
+            class_nhs = []
+            for u in uidx:
+                i = idxs[int(u)]
+                class_nhs.append(
+                    self._mk_nexthops_union(
+                        slot_cache, fh[:, orig[i]], int(igp[i]), ls.area
+                    )
+                )
+            unicast = rdb.unicast_routes
+            for j, i in enumerate(idxs):
+                nhs = class_nhs[inv[j]]
+                if not nhs:
+                    continue
+                p = plain_p[i]
+                unicast[p] = RibEntry(
+                    prefix=p,
+                    nexthops=nhs,
+                    best_node=plain_n[i],
+                    best_nodes=(plain_n[i],),
+                    best_entry=plain_e[i],
+                    igp_cost=int(igp[i]),
+                )
+        elif len(plain_p):
+            # LFA backups are per-target, not per-class — use the
+            # general loop for everything when LFA is enabled
+            complex_items = sorted(
+                complex_items
+                + [
+                    (p, {plain_n[i]: plain_e[i]})
+                    for i, p in enumerate(plain_p)
+                ]
+            )
+
+        # ---- unicast: general path ---------------------------------------
         ksp_jobs: list[tuple] = []  # (prefix, reachable, best_nodes)
-        for prefix, per_node in sorted(ps.prefixes.items()):
+        for prefix, per_node in complex_items:
             reachable = {}
             for n, e in per_node.items():
                 nid = csr.name_to_id.get(n)

@@ -262,6 +262,10 @@ def batched_sssp_split(
     frontier = _compact_ids(
         jnp.where(changed_mask, iota, vp), vp, tail_cap, dead
     )
+    # the phase-1 exit set itself may exceed the static capacity
+    # (tail_threshold counts rows, tail_cap bounds the array): spill
+    # straight to the dense safety net rather than silently truncating
+    entry_spill = n_changed > tail_cap
 
     def cond2(state):
         _dist, frontier, spilled, it = state
@@ -307,7 +311,7 @@ def batched_sssp_split(
         return dist2, nf, spilled, it + 1
 
     dist, frontier, spilled, _ = jax.lax.while_loop(
-        cond2, body2, (dist, frontier, jnp.bool_(False), jnp.int32(0))
+        cond2, body2, (dist, frontier, entry_spill, jnp.int32(0))
     )
 
     # ---- phase 3: exactness net — dense to fixpoint if the tail bailed

@@ -216,3 +216,80 @@ def erdos_renyi(n: int, avg_degree: int = 10, seed: int = 0, max_metric: int = 1
             break
         add(int(u), int(v), int(m))
     return _mk_dbs(n, edges)
+
+
+class LsdbView:
+    """LinkState-compatible read surface over a directly-constructed
+    CsrGraph, for benchmark-scale topologies (erdos_renyi_lsdb): the
+    solver's RIB path only reads `to_csr()`, `area`, `nodes`,
+    `node_label()` and `adjacency_db()`, so 100k-node graphs can skip
+    building millions of Adjacency dataclasses."""
+
+    def __init__(self, csr, area: str = "0"):
+        self._csr = csr
+        self.area = area
+        self.nodes = list(csr.node_names)
+        self._labels = {
+            s: 101 + i for i, s in enumerate(csr.node_names)
+        }
+
+    def to_csr(self):
+        return self._csr
+
+    def node_label(self, node: str) -> int:
+        return self._labels[node]
+
+    def adjacency_db(self, node: str):
+        # adjacency MPLS labels are out of scope for the synthetic
+        # benchmark LSDB (no per-link label allocation)
+        return None
+
+
+def erdos_renyi_lsdb(
+    n: int, avg_degree: int = 20, seed: int = 0, max_metric: int = 64
+):
+    """Benchmark-scale LSDB: (ls_view, prefix_state, csr).
+
+    The CsrGraph is assembled directly from the `erdos_renyi_csr` arrays
+    (adj_details populated only for node-0, the benchmark vantage point
+    — the solver reads other nodes' details only for its own nexthop
+    slots); the PrefixState advertises one loopback per node, the same
+    shape the production PrefixManager floods.
+    """
+    from openr_tpu.decision import linkstate as _lsmod
+    from openr_tpu.decision.linkstate import CsrGraph, PrefixState
+    from openr_tpu.types.topology import PrefixEntry
+
+    edge_src, edge_dst, edge_metric, vp, nn, e = erdos_renyi_csr(
+        n, avg_degree=avg_degree, seed=seed, max_metric=max_metric
+    )
+    names = [node_name(i) for i in range(nn)]
+    name_to_id = {s: i for i, s in enumerate(names)}
+    valid = edge_metric < np.int32(1 << 30)
+    my = 0
+    adj_details: dict = {}
+    out_mask = (edge_src == my) & valid
+    for d, m in zip(edge_dst[out_mask], edge_metric[out_mask]):
+        adj_details.setdefault((my, int(d)), []).append(
+            (f"if_{my}_{int(d)}", int(m), 0, 0, f"if_{int(d)}_{my}")
+        )
+    ver = next(_lsmod._csr_version)
+    csr = CsrGraph(
+        num_nodes=nn,
+        num_edges=int(e),
+        edge_src=edge_src,
+        edge_dst=edge_dst,
+        edge_metric=edge_metric,
+        node_overloaded=np.zeros(vp, dtype=bool),
+        node_mask=np.arange(vp) < nn,
+        node_names=names,
+        adj_details=adj_details,
+        name_to_id=name_to_id,
+        version=ver,
+        base_version=ver,
+    )
+    ps = PrefixState()
+    for i, s in enumerate(names):
+        entry = PrefixEntry(prefix=loopback(i))
+        ps._entries[entry.prefix] = {s: entry}
+    return LsdbView(csr), ps, csr

@@ -207,8 +207,15 @@ def test_dense_selection_avoids_mega_hub_blowup():
     adj_dbs, prefix_dbs = topogen._mk_dbs(n, edges)
     ls, ps = _state(adj_dbs, prefix_dbs)
     csr = ls.to_csr()
-    solver = TpuSpfSolver(dense_waste_limit=1)  # force the size check to trip
+    # the size check guards the r2 dense kernel (the split builder bounds
+    # hub waste by construction, so it needs no escape hatch); force the
+    # dense kernel + a tripping limit, and keep native off so the batched
+    # path actually runs
+    solver = TpuSpfSolver(
+        dense_waste_limit=1, kernel_impl="dense", native_rib="off"
+    )
     assert csr.dense_width() >= 32
+    assert solver._pick_table(csr) == "edge"
     _ = solver.compute_routes(ls, ps, "node-1")
     assert csr._dense is None  # tables were never built
     _assert_rib_equal(ls, ps, "node-1")

@@ -98,6 +98,40 @@ def test_split_tail_and_spill_paths():
     np.testing.assert_array_equal(ref2, got_tail)
 
 
+def test_split_entry_spill_exceeding_tail_cap():
+    """Phase-1 can exit with MORE changed rows than tail_cap whenever
+    tail_threshold > tail_cap; the entry spill must route to the dense
+    safety net instead of truncating the frontier (review finding)."""
+    # star + chain: the hub's first sweep changes ~100 rows at once
+    n = 120
+    edges = []
+    for i in range(1, 100):
+        edges += [(0, i, 1 + i % 7), (i, 0, 1 + i % 7)]
+    for i in range(100, n):
+        edges += [(i - 1, i, 3), (i, i - 1, 3)]
+    src = np.array([e[0] for e in edges], np.int32)
+    dst = np.array([e[1] for e in edges], np.int32)
+    met = np.array([e[2] for e in edges], np.int32)
+    from openr_tpu.common.constants import DIST_INF
+
+    vp = 128
+    ep = 512
+    pad = ep - len(src)
+    es = np.concatenate([src, np.zeros(pad, np.int32)])
+    ed = np.concatenate([dst, np.full(pad, vp - 1, np.int32)])
+    em = np.concatenate([met, np.full(pad, DIST_INF, np.int32)])
+    order = np.argsort(ed, kind="stable")
+    es, ed, em = es[order], ed[order], em[order]
+    roots = np.zeros(8, dtype=np.int32)
+    ref, got = _solve_both(
+        es, ed, em, vp, n, roots,
+        # threshold bigger than cap: phase 1 exits immediately with a
+        # ~99-row changed set that cannot fit the 32-slot tail
+        tail_threshold=n, tail_cap=32, tail_rounds_cap=64,
+    )
+    np.testing.assert_array_equal(ref, got)
+
+
 def test_split_disconnected_and_line():
     # line graph: worst-case hop diameter exercises many sweeps
     n = 64
