@@ -98,6 +98,7 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
     flap_t: dict[int, float] = {}  # flap seq -> send time
     got_t: list[float] = []  # flap→update latencies
     spf_ms: list[float] = []
+    breakdown: dict[str, list[float]] = {}
     versions = {db.this_node_name: 1 for db in adj_dbs}
     n_flaps = 0
     stop = time.perf_counter() + seconds
@@ -156,6 +157,8 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
         if dec._spf_runs != last_runs:
             last_runs = dec._spf_runs
             spf_ms.append(dec._last_spf_ms)
+            for k, v in dec.last_breakdown_ms.items():
+                breakdown.setdefault(k, []).append(v)
         # flaps proven to have produced no route change (their rebuild
         # completed without emitting) are dropped, not timed forever
         emitted, completed = (
@@ -178,7 +181,7 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
     spf_runs = dec._spf_runs - base_spf_runs
     drainer.cancel()
     await dec.stop()
-    return n_flaps, spf_runs, spf_ms, got_t, no_change_flaps[0]
+    return n_flaps, spf_runs, spf_ms, got_t, no_change_flaps[0], breakdown
 
 
 def main() -> None:
@@ -210,7 +213,7 @@ def main() -> None:
         debounce_min=args.debounce_min_ms, debounce_max=args.debounce_max_ms,
     )
 
-    n_flaps, spf_runs, spf_ms, lat, no_change = asyncio.new_event_loop().run_until_complete(
+    n_flaps, spf_runs, spf_ms, lat, no_change, breakdown = asyncio.new_event_loop().run_until_complete(
         churn(
             dec, pubs, routes, pub_for, list(adj_dbs),
             args.flaps_per_sec, args.seconds,
@@ -235,6 +238,10 @@ def main() -> None:
             "spf_p99_ms": round(float(np.percentile(spf, 99)), 3),
             "flap_to_rib_p50_ms": round(float(np.percentile(latency, 50)), 3),
             "flap_to_rib_p99_ms": round(float(np.percentile(latency, 99)), 3),
+            "rebuild_breakdown_p50_ms": {
+                k: round(float(np.percentile(np.array(v), 50)), 2)
+                for k, v in breakdown.items()
+            },
             "backend": _backend(),
         },
     }

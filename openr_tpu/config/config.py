@@ -44,8 +44,15 @@ class KvstoreConfig:
     # backlog and schedules a FULL_SYNC (backpressure)
     flood_pending_max_keys: int = C.KVSTORE_FLOOD_PENDING_MAX_KEYS
     enable_flood_optimization: bool = False
-    # eligible to be a DUAL flood root (reference: is_flood_root †)
-    is_flood_root: bool = True
+    # DUAL flood-root eligibility (reference: is_flood_root †). The
+    # reference restricts root eligibility to a few well-connected
+    # nodes; every-node-a-root means O(V) root machines per node, so
+    # the default is False and deployments elect roots explicitly:
+    # either set is_flood_root on ~2 nodes, or list candidate node
+    # names in flood_root_candidates (same config on every node; a node
+    # is root iff its own name is listed — overrides is_flood_root).
+    is_flood_root: bool = False
+    flood_root_candidates: tuple[str, ...] = ()
     # grace before declaring KVSTORE_SYNCED with zero peers (covers the
     # window before LinkMonitor delivers the first PeerEvent)
     initial_sync_grace_s: float = 2.0
@@ -163,6 +170,27 @@ class PolicyStatementConfig:
 
 
 @dataclass
+class RouteMapTermConfig:
+    """Config mirror of policy.RouteMapTerm (ordered route-map term).
+    `match_prefixes` entries are "PREFIX [ge N] [le N]" strings, parsed
+    by OpenrNode at assembly. reference: openr/policy/ † ordered
+    statement evaluation."""
+
+    seq: int = 0
+    action: str = "permit"
+    match_tags_any: tuple[str, ...] = ()
+    match_tags_all: tuple[str, ...] = ()
+    match_not_tags: tuple[str, ...] = ()
+    match_prefixes: tuple[str, ...] = ()
+    set_path_preference: int | None = None
+    set_source_preference: int | None = None
+    set_distance_increment: int | None = None
+    set_tags: tuple[str, ...] | None = None
+    add_tags: tuple[str, ...] = ()
+    remove_tags: tuple[str, ...] = ()
+
+
+@dataclass
 class PrefixAllocationConfig:
     """reference: OpenrConfig.thrift † PrefixAllocationConfig — carve
     `seed_prefix` into /alloc_prefix_len blocks; each node elects a
@@ -225,6 +253,11 @@ class NodeConfig:
     # empty = accept everything
     prefix_policy_statements: tuple["PolicyStatementConfig", ...] = ()
     prefix_policy_default_accept: bool = True
+    # ordered route-map (numbered terms, first-match-wins, implicit
+    # deny unless prefix_route_map_default_accept) — takes precedence
+    # over prefix_policy_statements when non-empty
+    prefix_route_map: tuple["RouteMapTermConfig", ...] = ()
+    prefix_route_map_default_accept: bool = False
     prefix_allocation: PrefixAllocationConfig | None = None
     enable_v4: bool = True
     enable_best_route_selection: bool = True

@@ -164,6 +164,7 @@ class Decision(OpenrModule):
         self.rib_policy = None  # set via apply_rib_policy (openr_tpu.policy)
         self._spf_runs = 0
         self._last_spf_ms = 0.0
+        self.last_breakdown_ms: dict[str, float] = {}
         # perf_counter() of the snapshot behind the most recently
         # EMITTED RouteUpdate, and behind the most recently COMPLETED
         # rebuild (emitted or not) — benchmarks use the pair to attribute
@@ -236,10 +237,12 @@ class Decision(OpenrModule):
                 buffered = True
         return buffered
 
-    def _drain_pending(self) -> bool:
+    def _drain_pending(self, decoded: dict | None = None) -> bool:
         """Decode + apply the coalesced publication buffer. Idempotent,
         cheap when empty; called from every LSDB reader and at rebuild
-        start."""
+        start. `decoded` (from _decode_batch) lets the rebuild path run
+        the serde work in the solver thread — only the cheap LSDB apply
+        happens on the event loop."""
         if not self._pending_kvs:
             return False
         batch, self._pending_kvs = self._pending_kvs, {}
@@ -249,35 +252,69 @@ class Decision(OpenrModule):
             if val is None:
                 changed |= self._expire_key(ls, ps, key)
             else:
-                changed |= self._apply_key(ls, ps, key, val)
+                db = (decoded or {}).get((area, key, id(val)))
+                if db is not None:
+                    changed |= self._apply_decoded(ls, ps, key, db)
+                else:
+                    changed |= self._apply_key(ls, ps, key, val)
         if changed:
             self.counters and self.counters.increment("decision.lsdb_changes")
         return changed
 
+    @staticmethod
+    def _key_schema(key: str):
+        """Single source of key-type dispatch shared by the inline and
+        threaded decode paths: (expected origin node or None, schema)."""
+        node = C.parse_adj_key(key)
+        if node is not None:
+            return node, AdjacencyDatabase
+        parsed = C.parse_prefix_key(key)
+        if parsed is not None:
+            return parsed[0], PrefixDatabase
+        return None, None
+
+    def _decode_batch(self, batch: dict) -> dict:
+        """Pure serde decode of a pending-kv batch (thread-safe: touches
+        no Decision state). Keyed by (area, key, id(value)) so a value
+        superseded between capture and apply is never misapplied."""
+        out = {}
+        for (area, key), val in batch.items():
+            if val is None:
+                continue
+            _node, schema = self._key_schema(key)
+            if schema is None:
+                continue
+            try:
+                out[(area, key, id(val))] = from_wire(val.value, schema)
+            except Exception:  # noqa: BLE001 — fall to _apply_key's path
+                continue
+        return out
+
+    def _apply_decoded(self, ls, ps, key: str, db) -> bool:
+        if isinstance(db, AdjacencyDatabase):
+            node, _schema = self._key_schema(key)
+            if node is not None and db.this_node_name != node:
+                log.warning(
+                    "%s: adj key %s names node %s",
+                    self.name, key, db.this_node_name,
+                )
+            return ls.update_adjacency_db(db)
+        return bool(ps.update_prefix_db(db))
+
     def _apply_key(
         self, ls: LinkState, ps: PrefixState, key: str, val: Value
     ) -> bool:
-        node = C.parse_adj_key(key)
-        if node is not None:
-            try:
-                db = from_wire(val.value, AdjacencyDatabase)
-            except Exception:  # noqa: BLE001 — corrupt key: ignore
-                log.warning("%s: bad adj db in key %s", self.name, key)
-                return False
-            if db.this_node_name != node:
-                log.warning("%s: adj key %s names node %s", self.name, key, db.this_node_name)
-            return ls.update_adjacency_db(db)
-        parsed = C.parse_prefix_key(key)
-        if parsed is not None:
-            try:
-                db = from_wire(val.value, PrefixDatabase)
-            except Exception:  # noqa: BLE001
-                log.warning("%s: bad prefix db in key %s", self.name, key)
-                return False
-            # update_prefix_db handles delete_prefix tombstones too, keyed
-            # consistently by db.this_node_name
-            return bool(ps.update_prefix_db(db))
-        return False
+        _node, schema = self._key_schema(key)
+        if schema is None:
+            return False
+        try:
+            db = from_wire(val.value, schema)
+        except Exception:  # noqa: BLE001 — corrupt key: ignore
+            log.warning("%s: bad db in key %s", self.name, key)
+            return False
+        # update_prefix_db handles delete_prefix tombstones too, keyed
+        # consistently by db.this_node_name
+        return self._apply_decoded(ls, ps, key, db)
 
     def _expire_key(self, ls: LinkState, ps: PrefixState, key: str) -> bool:
         node = C.parse_adj_key(key)
@@ -327,11 +364,42 @@ class Decision(OpenrModule):
             self.rib_policy.apply(rdb)
         return rdb
 
+    def _compute_and_diff(self, states):
+        """Thread-side rebuild body: solve + assemble + diff against the
+        published RIB (self.rib is only rebound by the serialized
+        rebuild coroutine, so reading it here is race-free)."""
+        new_rib = self.compute_rib(states)
+        return new_rib, diff_route_dbs(self.rib, new_rib)
+
     async def _rebuild_routes(self) -> None:
         t0 = time.perf_counter()
-        states = self._snapshot_states()
         try:
-            new_rib = await asyncio.to_thread(self.compute_rib, states)
+            # serde decode of the coalesced flap backlog runs in the
+            # worker thread (pure; keyed by value identity so a key
+            # superseded mid-flight falls back to inline decode); the
+            # event loop only pays the cheap LSDB apply + snapshot, so
+            # publication processing never stalls behind a rebuild
+            t1 = t0
+            if self._pending_kvs:
+                batch_view = dict(self._pending_kvs)
+                decoded = await asyncio.to_thread(
+                    self._decode_batch, batch_view
+                )
+                t1 = time.perf_counter()
+                self._drain_pending(decoded)
+            states = self._snapshot_states()
+            t2 = time.perf_counter()
+            new_rib, update = await asyncio.to_thread(
+                self._compute_and_diff, states
+            )
+            t3 = time.perf_counter()
+            # published breakdown (round-2 verdict item 3): where a
+            # steady-state churn rebuild actually spends its time
+            self.last_breakdown_ms = {
+                "decode": (t1 - t0) * 1e3,
+                "apply_snapshot": (t2 - t1) * 1e3,
+                "compute_diff": (t3 - t2) * 1e3,
+            }
         except Exception:  # noqa: BLE001 — keep serving the old RIB
             log.exception("%s: route rebuild failed", self.name)
             return
@@ -341,7 +409,6 @@ class Decision(OpenrModule):
             self.counters.increment("decision.spf_runs")
             self.counters.set("decision.spf_ms", self._last_spf_ms)
         first = not self.rib_computed.is_set()
-        update = diff_route_dbs(self.rib, new_rib)
         self.rib = new_rib
         self._last_completed_snapshot_t0 = t0
         if first or not update.empty():

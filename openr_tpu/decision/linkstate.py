@@ -83,6 +83,11 @@ class CsrGraph:
     # (src_id, dst_id) -> list[(if_name, metric, weight, adj_label, other_if)]
     adj_details: dict[tuple[int, int], list[tuple[str, int, int, int, str]]]
     name_to_id: dict[str, int]
+    # metric-patched entries overriding adj_details (shared base stays
+    # untouched; the override dict holds only churned edges, so a 50-flap
+    # rebuild copies ~50 entries instead of the whole O(E) dict). Read
+    # through `details()` / `details_get()`.
+    adj_overrides: dict[tuple[int, int], list] = field(default_factory=dict)
     _dense: tuple[np.ndarray, np.ndarray] | None = None
     _dense_width: int | None = None
     _row_start: np.ndarray | None = None
@@ -95,6 +100,17 @@ class CsrGraph:
     version: int = 0
     base_version: int = 0
     patches: tuple["MetricPatch", ...] = ()
+
+    def details(self, u: int, v: int):
+        """Adjacency details for edge (u, v), override-aware."""
+        got = self.adj_overrides.get((u, v))
+        return got if got is not None else self.adj_details[(u, v)]
+
+    def details_get(self, u: int, v: int, default=None):
+        got = self.adj_overrides.get((u, v))
+        if got is not None:
+            return got
+        return self.adj_details.get((u, v), default)
 
     @property
     def padded_nodes(self) -> int:
@@ -321,7 +337,7 @@ class LinkState:
         self, base: CsrGraph, pending: list[tuple[str, Adjacency]]
     ) -> CsrGraph:
         new_metric = base.edge_metric.copy()
-        details = dict(base.adj_details)  # shallow; touched lists replaced
+        overrides = dict(base.adj_overrides)  # small: churned edges only
         dense = base._dense
         wgt = dense[1].copy() if dense is not None else None
         touched: dict[tuple[int, int], list[list]] = {}
@@ -335,13 +351,13 @@ class LinkState:
                 continue  # edge unusable in base (one-sided/overloaded)
             lst = touched.get(key)
             if lst is None:
-                lst = touched[key] = [list(d) for d in details[key]]
+                lst = touched[key] = [list(d) for d in base.details(*key)]
             for d in lst:
                 if d[0] == adj.if_name and d[4] == adj.other_if_name:
                     d[1] = int(adj.metric)
         journal = list(base.patches)
         for key, lst in touched.items():
-            details[key] = [tuple(d) for d in lst]
+            overrides[key] = [tuple(d) for d in lst]
             m = min(min(d[1] for d in lst), METRIC_MAX)
             idx = base.edge_index[key]
             new_metric[idx] = m
@@ -352,7 +368,7 @@ class LinkState:
         return replace(
             base,
             edge_metric=new_metric,
-            adj_details=details,
+            adj_overrides=overrides,
             _dense=(dense[0], wgt) if dense is not None else None,
             version=next(_csr_version),
             patches=tuple(journal),

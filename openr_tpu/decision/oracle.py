@@ -162,7 +162,7 @@ def _nexthops_to_nodes(
         igp = spf.dist[tgt]
         for fh in spf.first_hops.get(tgt, ()):
             fh_id = csr.name_to_id.get(fh)
-            details = csr.adj_details.get((my_id, fh_id), [])
+            details = csr.details_get(my_id, fh_id, [])
             best = min((d[1] for d in details), default=None)
             for if_name, metric, _w, _lbl, _oif in details:
                 if metric != best:
@@ -224,7 +224,7 @@ def _lfa_backups(
             continue
         via = min(vias)
         n_id = csr.name_to_id[n]
-        details = csr.adj_details.get((my_id, n_id), [])
+        details = csr.details_get(my_id, n_id, [])
         best = min((d[1] for d in details), default=None)
         if best is None:
             continue

@@ -378,7 +378,7 @@ class TpuSpfSolver:
             # same METRIC_MAX clamp as the CSR builder / oracle, or the
             # first-hop identity breaks for metrics above the clamp
             nbr_metric_real[i] = min(
-                min(det[1] for det in csr.adj_details[(my_id, d)]),
+                min(det[1] for det in csr.details(my_id, d)),
                 METRIC_MAX,
             )
 
@@ -776,7 +776,7 @@ class TpuSpfSolver:
                 if lfa[int(n_idx), int(t)]
             )
             link = min(
-                d[1] for d in csr.adj_details[(my_id, nbr_ids[int(n_idx)])]
+                d[1] for d in csr.details(my_id, nbr_ids[int(n_idx)])
             )
             m = link + via
             for key in slot_cache[int(n_idx)]:
@@ -802,7 +802,7 @@ class TpuSpfSolver:
         (it only depends on my own adjacencies, not the target)."""
         cache: list[list[tuple[str, str]]] = []
         for fh_id in nbr_ids:
-            details = csr.adj_details[(my_id, fh_id)]
+            details = csr.details(my_id, fh_id)
             best = min(d[1] for d in details)
             fh_name = csr.node_names[fh_id]
             cache.append(

@@ -86,8 +86,14 @@ class KvStore(OpenrModule):
         if config.node.kvstore.enable_flood_optimization:
             from openr_tpu.kvstore.floodtopo import FloodTopo
 
+            kcfg = config.node.kvstore
+            is_root = (
+                self.node_name in kcfg.flood_root_candidates
+                if kcfg.flood_root_candidates
+                else kcfg.is_flood_root
+            )
             self.flood_topos = {
-                a: FloodTopo(a, self, config.node.kvstore.is_flood_root)
+                a: FloodTopo(a, self, is_root)
                 for a in config.area_ids()
             }
 

@@ -119,7 +119,17 @@ class OpenrNode:
             counters=self.counters,
         )
         origination_policy = None
-        if (
+        if config.node.prefix_route_map:
+            from openr_tpu.policy import PolicyManager
+            from openr_tpu.policy.policy import build_route_map
+
+            origination_policy = PolicyManager(
+                route_map=build_route_map(
+                    config.node.prefix_route_map,
+                    config.node.prefix_route_map_default_accept,
+                )
+            )
+        elif (
             config.node.prefix_policy_statements
             or not config.node.prefix_policy_default_accept
         ):

@@ -1,0 +1,90 @@
+"""Multi-host compute plane: jax.distributed over DCN.
+
+SURVEY §5.8: the compute-plane equivalent of the reference's NCCL/MPI
+backend is XLA collectives over ICI within a host and DCN across hosts,
+stitched by `jax.distributed`. The control plane (KvStore flooding,
+Spark, thrift-equivalent RPC) stays host-side and needs none of this;
+only the batched/all-sources SPF shapes scale across hosts, by widening
+the `sources` mesh axis (no cross-host collective on the hot path) or
+the `graph` axis (pmin frontier exchange rides DCN between hosts).
+
+Wiring is env-driven so a deployment launches identical processes:
+
+  OPENR_COORDINATOR   host:port of process 0 (presence enables multi-host)
+  OPENR_NUM_PROCESSES total process count
+  OPENR_PROCESS_ID    this process's index
+
+`initialize()` is idempotent and a no-op when unset, so single-host
+users never pay for it. Proven by tests/test_multihost.py: two real
+processes x 4 virtual CPU devices each form one 8-device global mesh
+and run the sharded SPF with cross-process collectives.
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join (or skip) the multi-host jax.distributed service.
+
+    Returns True when running multi-host. Arguments default from the
+    OPENR_* environment; with no coordinator configured this is a
+    single-host no-op.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("OPENR_COORDINATOR")
+    if not coordinator:
+        return False
+    if num_processes is None:
+        num_processes = int(os.environ["OPENR_NUM_PROCESSES"])
+    if process_id is None:
+        process_id = int(os.environ["OPENR_PROCESS_ID"])
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def global_mesh(n_graph: int = 1):
+    """Mesh over ALL processes' devices (call after `initialize`).
+
+    Axis layout follows make_mesh: `sources` major (embarrassingly
+    parallel roots — put the DCN boundary here when possible), `graph`
+    minor (pmin all-reduce; keep it inside one host's ICI unless the
+    edge list outgrows a host).
+    """
+    import jax
+
+    from openr_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_graph=n_graph, devices=jax.devices())
+
+
+def shard_host_array(arr, mesh, spec):
+    """Place an identical host array onto a (possibly multi-host) mesh.
+
+    Every process passes the same full array; each device materializes
+    only its shard. This is the LSDB distribution path: the CSR arrays
+    are replicated host-side (every node owns the full LSDB — that is
+    what link-state routing IS), so cross-host scatter needs no data
+    exchange at all.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(arr, NamedSharding(mesh, spec))

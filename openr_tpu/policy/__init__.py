@@ -5,4 +5,6 @@ from openr_tpu.policy.policy import (  # noqa: F401
     PolicyStatement,
     RibPolicy,
     RibPolicyStatement,
+    RouteMap,
+    RouteMapTerm,
 )

@@ -71,20 +71,202 @@ class PolicyStatement:
         return replace(entry, metrics=m, tags=tags)
 
 
+@dataclass(frozen=True)
+class RouteMapTerm:
+    """One numbered term of an ordered route-map.
+
+    reference: openr/policy/ † PolicyStatement lists are evaluated in
+    order; this is the full route-map shape (numbered sequence,
+    permit/deny, AND-of-matchers, tag-set algebra) that network
+    operators expect from the policy layer.
+
+    Matching is the AND of every non-empty matcher:
+      match_tags_any   — entry carries at least one of these tags
+      match_tags_all   — entry carries every one of these tags
+      match_not_tags   — entry carries none of these tags
+      match_prefixes   — entry's prefix is a subnet of one listed, with
+                         optional [ge, le] prefix-length bounds per item
+                         ("10.0.0.0/8 ge 24 le 28" style, parsed form)
+    Transforms (permit only), applied in this order:
+      set_tags (replace) -> add_tags -> remove_tags, then preference /
+      distance rewrites.
+    """
+
+    seq: int
+    action: str = "permit"  # "permit" | "deny"
+    match_tags_any: tuple[str, ...] = ()
+    match_tags_all: tuple[str, ...] = ()
+    match_not_tags: tuple[str, ...] = ()
+    # (prefix, ge, le): ge/le = 0 means unconstrained
+    match_prefixes: tuple[tuple[str, int, int], ...] = ()
+    set_path_preference: int | None = None
+    set_source_preference: int | None = None
+    set_distance_increment: int | None = None
+    set_tags: tuple[str, ...] | None = None
+    add_tags: tuple[str, ...] = ()
+    remove_tags: tuple[str, ...] = ()
+
+    def __post_init__(self):
+        # parse + validate the prefix matchers ONCE (redistribution
+        # applies the map per RIB prefix — re-parsing per evaluation
+        # would be O(prefixes x terms x items) string parses, and a
+        # malformed prefix must fail at build time, not on the first
+        # matching entry inside PrefixManager's event loop)
+        object.__setattr__(
+            self,
+            "_nets",
+            tuple(
+                (IpPrefix.make(p).network, ge, le)
+                for p, ge, le in self.match_prefixes
+            ),
+        )
+
+    def matches(self, entry: PrefixEntry) -> bool:
+        tags = set(entry.tags)
+        if self.match_tags_any and not (set(self.match_tags_any) & tags):
+            return False
+        if self.match_tags_all and not (
+            set(self.match_tags_all) <= tags
+        ):
+            return False
+        if self.match_not_tags and (set(self.match_not_tags) & tags):
+            return False
+        if self.match_prefixes:
+            net = entry.prefix.network
+            for pn, ge, le in self._nets:
+                if pn.version != net.version or not net.subnet_of(pn):
+                    continue
+                if ge and net.prefixlen < ge:
+                    continue
+                if le and net.prefixlen > le:
+                    continue
+                return True
+            return False
+        return True
+
+    def transform(self, entry: PrefixEntry) -> PrefixEntry:
+        tags = list(self.set_tags) if self.set_tags is not None else list(
+            entry.tags
+        )
+        tags += [t for t in self.add_tags if t not in tags]
+        if self.remove_tags:
+            drop = set(self.remove_tags)
+            tags = [t for t in tags if t not in drop]
+        m = entry.metrics
+        if self.set_path_preference is not None:
+            m = replace(m, path_preference=self.set_path_preference)
+        if self.set_source_preference is not None:
+            m = replace(m, source_preference=self.set_source_preference)
+        if self.set_distance_increment is not None:
+            m = replace(m, distance=m.distance + self.set_distance_increment)
+        return replace(entry, metrics=m, tags=tuple(dict.fromkeys(tags)))
+
+
+@dataclass(frozen=True)
+class RouteMap:
+    """Ordered route-map: terms evaluated in ascending `seq`; the FIRST
+    matching term decides (permit -> transformed entry, deny -> None);
+    no match falls through to `default_accept` (route-map convention:
+    implicit deny).
+
+    Earlier broad terms SHADOW later ones — covered explicitly by
+    tests/test_policy.py along with fallthrough semantics.
+    """
+
+    name: str = ""
+    terms: tuple[RouteMapTerm, ...] = ()
+    default_accept: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "terms", tuple(sorted(self.terms, key=lambda t: t.seq))
+        )
+        seqs = [t.seq for t in self.terms]
+        if len(set(seqs)) != len(seqs):
+            raise ValueError(f"route-map {self.name!r}: duplicate seq")
+        for t in self.terms:
+            if t.action not in ("permit", "deny"):
+                raise ValueError(
+                    f"route-map {self.name!r} seq {t.seq}: bad action "
+                    f"{t.action!r}"
+                )
+
+    def apply(self, entry: PrefixEntry) -> PrefixEntry | None:
+        for t in self.terms:
+            if t.matches(entry):
+                if t.action == "deny":
+                    return None
+                return t.transform(entry)
+        return entry if self.default_accept else None
+
+
 @dataclass
 class PolicyManager:
-    """First-match-wins statement list (reference: PolicyManager †).
-    `default_accept` governs entries no statement matches."""
+    """Origination/redistribution policy engine (reference:
+    PolicyManager †). Either an ordered `route_map` (takes precedence)
+    or the simpler first-match statement list; `default_accept` governs
+    entries nothing matches on the statement path (the route-map has
+    its own default)."""
 
     statements: tuple[PolicyStatement, ...] = ()
     default_accept: bool = True
+    route_map: RouteMap | None = None
 
     def apply(self, entry: PrefixEntry) -> PrefixEntry | None:
         """None = denied (do not originate)."""
+        if self.route_map is not None:
+            return self.route_map.apply(entry)
         for st in self.statements:
             if st.matches(entry):
                 return st.apply(entry)
         return entry if self.default_accept else None
+
+
+def parse_prefix_match(spec: str) -> tuple[str, int, int]:
+    """Parse "PREFIX [ge N] [le N]" into the RouteMapTerm tuple form."""
+    parts = spec.split()
+    prefix, ge, le = parts[0], 0, 0
+    i = 1
+    while i < len(parts):
+        if i + 1 >= len(parts):
+            raise ValueError(f"bad prefix match {spec!r}")
+        kw, val = parts[i], int(parts[i + 1])
+        if kw == "ge":
+            ge = val
+        elif kw == "le":
+            le = val
+        else:
+            raise ValueError(f"bad prefix match {spec!r}")
+        i += 2
+    if ge and le and ge > le:
+        raise ValueError(f"bad prefix match {spec!r}: ge > le")
+    IpPrefix.make(prefix)  # validate now — not on first evaluation
+    return prefix, ge, le
+
+
+def build_route_map(term_configs, default_accept: bool) -> RouteMap:
+    """Assemble a RouteMap from config.RouteMapTermConfig entries
+    (OpenrNode's conversion seam; prefix matchers parsed here)."""
+    terms = tuple(
+        RouteMapTerm(
+            seq=t.seq,
+            action=t.action,
+            match_tags_any=tuple(t.match_tags_any),
+            match_tags_all=tuple(t.match_tags_all),
+            match_not_tags=tuple(t.match_not_tags),
+            match_prefixes=tuple(
+                parse_prefix_match(p) for p in t.match_prefixes
+            ),
+            set_path_preference=t.set_path_preference,
+            set_source_preference=t.set_source_preference,
+            set_distance_increment=t.set_distance_increment,
+            set_tags=tuple(t.set_tags) if t.set_tags is not None else None,
+            add_tags=tuple(t.add_tags),
+            remove_tags=tuple(t.remove_tags),
+        )
+        for t in term_configs
+    )
+    return RouteMap(terms=terms, default_accept=default_accept)
 
 
 # ------------------------------------------------------------------ RibPolicy

@@ -69,6 +69,14 @@ def _encode(obj: Any) -> Any:
 _DECODERS: dict[Any, Any] = {}
 
 
+def _identity(raw):
+    """Marker decoder for pass-through fields: _build_decoder returns
+    THIS object so dec_dc can skip the call entirely (identity fields
+    dominate real messages — all-primitive dataclasses like Adjacency
+    then decode with one dict-splat construction)."""
+    return raw
+
+
 def _decoder(hint: Any):
     try:
         d = _DECODERS.get(hint)
@@ -91,7 +99,7 @@ def _build_decoder(hint: Any):
                 return None if raw is None else inner(raw)
 
             return dec_opt
-        return lambda raw: raw  # heterogeneous unions: pass through
+        return _identity  # heterogeneous unions: pass through
     if hint is bytes:
 
         def dec_bytes(raw):
@@ -110,12 +118,32 @@ def _build_decoder(hint: Any):
             (f.name, _decoder(hints[f.name]))
             for f in dataclasses.fields(hint)
         ]
+        conv = [(n, fd) for n, fd in field_decs if fd is not _identity]
+        if not conv:
+            # every field decodes as-is: one dict-splat construction.
+            # Unknown keys (a newer peer's extra field) TypeError out of
+            # __init__ — fall back to the filtering path for those.
+            known = frozenset(n for n, _fd in field_decs)
+
+            def dec_dc_fast(raw):
+                if raw is None:
+                    return None
+                try:
+                    return hint(**raw)
+                except TypeError:
+                    return hint(
+                        **{k: v for k, v in raw.items() if k in known}
+                    )
+
+            return dec_dc_fast
+
+        ident = [n for n, fd in field_decs if fd is _identity]
 
         def dec_dc(raw):
             if raw is None:
                 return None
-            kwargs = {}
-            for name, fd in field_decs:
+            kwargs = {n: raw[n] for n in ident if n in raw}
+            for name, fd in conv:
                 if name in raw:
                     kwargs[name] = fd(raw[name])
             return hint(**kwargs)
@@ -133,9 +161,13 @@ def _build_decoder(hint: Any):
 
             return dec_htuple
         item = _decoder(args[0])
+        if item is _identity:
+            if origin is tuple:
+                return lambda raw: None if raw is None else tuple(raw)
+            return lambda raw: None if raw is None else list(raw)
         if origin is tuple:
             return lambda raw: (
-                None if raw is None else tuple(item(x) for x in raw)
+                None if raw is None else tuple([item(x) for x in raw])
             )
         return lambda raw: (
             None if raw is None else [item(x) for x in raw]
@@ -154,7 +186,7 @@ def _build_decoder(hint: Any):
             }
 
         return dec_dict
-    return lambda raw: raw
+    return _identity
 
 
 def _decode(raw: Any, hint: Any) -> Any:

@@ -227,11 +227,14 @@ def test_dual_partition_heals():
 
 
 class FloodWrapper:
-    def __init__(self, transport, name):
+    def __init__(self, transport, name, candidates=("s1", "s2")):
         self.q = ReplicateQueue(name=f"{name}.pubs")
         self.counters = Counters()
         self.config = Config.default(name)
         self.config.node.kvstore.enable_flood_optimization = True
+        # deployment-style elected root set (the default is_flood_root
+        # is False — every-node-a-root would mean O(V) DUAL machines)
+        self.config.node.kvstore.flood_root_candidates = tuple(candidates)
         self.store = KvStore(
             self.config, transport, self.q, counters=self.counters
         )
@@ -361,3 +364,33 @@ def test_kvstore_flood_tree_survives_node_loss():
             await ws[n].stop()
 
     run(main())
+
+
+def test_flood_root_machines_bounded_by_candidates():
+    """A default cluster runs O(1) DUAL root machines per area — one per
+    elected candidate — not one per node (round-2 verdict item 8)."""
+
+    async def main():
+        t = InProcKvTransport()
+        names = ["s1", "s2", "s3", "s4", "s5"]
+        ws = {n: FloodWrapper(t, n) for n in names}
+        for w in ws.values():
+            await w.start()
+        for a in names:
+            for b in names:
+                if a != b:
+                    ws[a].store.add_peer_sync(PeerSpec(node_name=b))
+        ok = await _settle(
+            lambda: all(
+                ws[n].store.get_flood_topo("0").get("flood_root") == "s1"
+                for n in names
+            )
+        )
+        assert ok, "root not elected"
+        for n in names:
+            machines = ws[n].store.flood_topos["0"].dual.roots
+            assert set(machines) <= {"s1", "s2"}, (n, set(machines))
+        for w in ws.values():
+            await w.stop()
+
+    asyncio.run(main())

@@ -87,11 +87,12 @@ def test_patch_path_taken_and_matches_full_rebuild():
     np.testing.assert_array_equal(patched.edge_metric, ref.edge_metric)
     np.testing.assert_array_equal(patched.edge_src, ref.edge_src)
     np.testing.assert_array_equal(patched.edge_dst, ref.edge_dst)
-    # details patched for solver nexthop construction
+    # details patched for solver nexthop construction (override layer —
+    # the shared base dict itself stays untouched)
     u, w = patched.name_to_id["n3"], patched.name_to_id["n4"]
-    assert patched.adj_details[(u, w)][0][1] == 77
-    # the shared base is untouched
-    assert base.adj_details[(u, w)][0][1] == 10
+    assert patched.details(u, w)[0][1] == 77
+    assert base.details(u, w)[0][1] == 10
+    assert patched.adj_details[(u, w)][0][1] == 10  # base dict shared
 
 
 def test_dense_tables_patched():

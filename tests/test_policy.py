@@ -3,6 +3,8 @@ DecisionTest RibPolicy cases †)."""
 
 import time
 
+import pytest
+
 from openr_tpu.decision.linkstate import LinkState, PrefixState
 from openr_tpu.decision.oracle import compute_routes
 from openr_tpu.policy import (
@@ -230,3 +232,211 @@ def test_origination_policy_wired_through_config():
         await c.stop()
 
     asyncio.new_event_loop().run_until_complete(body())
+
+
+# ------------------------------------------------------------ route-maps
+
+
+def _entry(prefix="10.1.0.0/24", tags=(), pp=1000, sp=100, dist=0):
+    from openr_tpu.types.topology import PrefixMetrics
+
+    return PrefixEntry(
+        prefix=IpPrefix.make(prefix),
+        tags=tuple(tags),
+        metrics=PrefixMetrics(
+            path_preference=pp, source_preference=sp, distance=dist
+        ),
+    )
+
+
+def test_route_map_ordered_first_match_wins_and_shadowing():
+    from openr_tpu.policy import RouteMap, RouteMapTerm
+
+    rm = RouteMap(
+        terms=(
+            # seq 20 listed FIRST but must run second (ordered by seq)
+            RouteMapTerm(seq=20, action="deny",
+                         match_tags_any=("blue",)),
+            # broad seq-10 permit SHADOWS the deny for blue+prod
+            RouteMapTerm(seq=10, action="permit",
+                         match_tags_all=("blue", "prod"),
+                         add_tags=("matched-10",)),
+        ),
+    )
+    # blue+prod hits seq 10 (shadowing the seq-20 deny)
+    got = rm.apply(_entry(tags=("blue", "prod")))
+    assert got is not None and "matched-10" in got.tags
+    # blue alone falls to seq 20 → denied
+    assert rm.apply(_entry(tags=("blue",))) is None
+    # nothing matches → implicit deny (default_accept=False)
+    assert rm.apply(_entry(tags=("green",))) is None
+    # fallthrough with default_accept=True passes unmodified
+    rm2 = RouteMap(terms=rm.terms, default_accept=True)
+    got2 = rm2.apply(_entry(tags=("green",)))
+    assert got2 == _entry(tags=("green",))
+
+
+def test_route_map_prefix_ge_le_bounds():
+    from openr_tpu.policy import RouteMap, RouteMapTerm
+
+    rm = RouteMap(
+        terms=(
+            RouteMapTerm(
+                seq=5, match_prefixes=(("10.0.0.0/8", 24, 28),)
+            ),
+        ),
+        default_accept=False,
+    )
+    assert rm.apply(_entry("10.1.2.0/24")) is not None
+    assert rm.apply(_entry("10.1.2.0/28")) is not None
+    assert rm.apply(_entry("10.1.0.0/16")) is None  # too short (< ge)
+    assert rm.apply(_entry("10.1.2.0/30")) is None  # too long (> le)
+    assert rm.apply(_entry("192.168.0.0/24")) is None  # outside
+
+
+def test_route_map_tag_set_algebra():
+    from openr_tpu.policy import RouteMap, RouteMapTerm
+
+    rm = RouteMap(
+        terms=(
+            RouteMapTerm(
+                seq=1,
+                set_tags=("base",),
+                add_tags=("x", "y"),
+                remove_tags=("y", "nope"),
+                set_path_preference=7,
+                set_distance_increment=3,
+            ),
+        ),
+    )
+    got = rm.apply(_entry(tags=("old-a", "old-b"), dist=10))
+    assert got.tags == ("base", "x")  # replace -> add -> remove
+    assert got.metrics.path_preference == 7
+    assert got.metrics.distance == 13
+
+
+def test_route_map_duplicate_seq_rejected():
+    from openr_tpu.policy import RouteMap, RouteMapTerm
+
+    with pytest.raises(ValueError):
+        RouteMap(terms=(RouteMapTerm(seq=1), RouteMapTerm(seq=1)))
+    with pytest.raises(ValueError):
+        RouteMap(terms=(RouteMapTerm(seq=1, action="accept"),))
+
+
+def test_route_map_property_vs_reference_evaluator():
+    """Randomized terms/entries vs an independent step-by-step
+    evaluator (shadowing + fallthrough semantics by construction)."""
+    import random
+
+    from openr_tpu.policy import RouteMap, RouteMapTerm
+
+    rng = random.Random(42)
+    TAGS = ["a", "b", "c", "d"]
+    PFX = [("10.0.0.0/8", 0, 0), ("10.1.0.0/16", 20, 28),
+           ("192.168.0.0/16", 0, 24)]
+
+    def rand_term(seq):
+        return RouteMapTerm(
+            seq=seq,
+            action=rng.choice(["permit", "deny"]),
+            match_tags_any=tuple(rng.sample(TAGS, rng.randint(0, 2))),
+            match_tags_all=tuple(rng.sample(TAGS, rng.randint(0, 1))),
+            match_not_tags=tuple(rng.sample(TAGS, rng.randint(0, 1))),
+            match_prefixes=tuple(
+                rng.sample(PFX, rng.randint(0, 2))
+            ),
+            add_tags=tuple(rng.sample(TAGS, rng.randint(0, 1))),
+            remove_tags=tuple(rng.sample(TAGS, rng.randint(0, 1))),
+            set_distance_increment=rng.choice([None, 1, 5]),
+        )
+
+    def ref_apply(rm, entry):
+        # independent evaluator: literal spec semantics
+        for t in sorted(rm.terms, key=lambda t: t.seq):
+            tags = set(entry.tags)
+            if t.match_tags_any and not (set(t.match_tags_any) & tags):
+                continue
+            if t.match_tags_all and not set(t.match_tags_all) <= tags:
+                continue
+            if t.match_not_tags and set(t.match_not_tags) & tags:
+                continue
+            if t.match_prefixes:
+                net = entry.prefix.network
+                hit = False
+                for p, ge, le in t.match_prefixes:
+                    pn = IpPrefix.make(p).network
+                    if (
+                        pn.version == net.version
+                        and net.subnet_of(pn)
+                        and (not ge or net.prefixlen >= ge)
+                        and (not le or net.prefixlen <= le)
+                    ):
+                        hit = True
+                        break
+                if not hit:
+                    continue
+            if t.action == "deny":
+                return None
+            return t.transform(entry)
+        return entry if rm.default_accept else None
+
+    prefixes = ["10.1.2.0/24", "10.1.0.0/16", "10.2.3.0/26",
+                "192.168.5.0/24", "192.168.0.0/18", "172.16.0.0/12"]
+    for trial in range(200):
+        n_terms = rng.randint(0, 5)
+        rm = RouteMap(
+            terms=tuple(rand_term((i + 1) * 10) for i in range(n_terms)),
+            default_accept=rng.random() < 0.5,
+        )
+        e = _entry(
+            rng.choice(prefixes),
+            tags=tuple(rng.sample(TAGS, rng.randint(0, 3))),
+            dist=rng.randint(0, 5),
+        )
+        assert rm.apply(e) == ref_apply(rm, e), (trial, rm, e)
+
+
+def test_route_map_at_origination_via_prefix_manager_seam():
+    """PolicyManager.route_map applies at the PrefixManager seam: deny
+    blocks origination, permit transforms the advertised entry."""
+    from openr_tpu.policy import PolicyManager, RouteMap, RouteMapTerm
+
+    pm = PolicyManager(
+        route_map=RouteMap(
+            terms=(
+                RouteMapTerm(seq=10, action="deny",
+                             match_tags_any=("no-export",)),
+                RouteMapTerm(seq=20, action="permit",
+                             add_tags=("exported",)),
+            ),
+        )
+    )
+    assert pm.apply(_entry(tags=("no-export",))) is None
+    got = pm.apply(_entry(tags=("ok",)))
+    assert got is not None and "exported" in got.tags
+
+
+def test_route_map_config_assembly():
+    from openr_tpu.config.config import RouteMapTermConfig
+    from openr_tpu.policy.policy import build_route_map, parse_prefix_match
+
+    assert parse_prefix_match("10.0.0.0/8 ge 24 le 28") == (
+        "10.0.0.0/8", 24, 28,
+    )
+    assert parse_prefix_match("10.0.0.0/8") == ("10.0.0.0/8", 0, 0)
+    with pytest.raises(ValueError):
+        parse_prefix_match("10.0.0.0/8 ge")
+    with pytest.raises(ValueError):
+        parse_prefix_match("10.0.0.0/8 ge 28 le 24")
+    rm = build_route_map(
+        (
+            RouteMapTermConfig(
+                seq=10, match_prefixes=("10.0.0.0/8 ge 24",),
+                add_tags=("t",),
+            ),
+        ),
+        default_accept=False,
+    )
+    assert rm.apply(_entry("10.5.5.0/24")).tags == ("t",)
+    assert rm.apply(_entry("10.0.0.0/8")) is None
