@@ -720,7 +720,15 @@ class TpuSpfSolver:
                 [csr.name_to_id[n] for n in best_nodes], dtype=np.int64
             )
             dests[j] = ids[np.argmin(d_root[ids])]  # ids ascending: first min
-        chunk = 256
+        # chunk the job batch by a MEMORY budget, not a constant: the
+        # kernel's working set per job is dominated by the [Vp, D] banned
+        # mask plus ~3 [Vp, D] i32 intermediates under the k-round scan
+        # (round-2 verdict item 4 — a constant 256 put the 100k case at
+        # ~1.6 GB per chunk before intermediates)
+        vp_d = int(d_nbr.shape[0]) * int(d_nbr.shape[1])
+        bytes_per_job = vp_d * 13  # 1B banned + 3 x 4B candidates
+        cap = max(8, min(256, (2 << 30) // bytes_per_job))
+        chunk = 1 << (cap.bit_length() - 1)  # floor power of two
         max_hops = csr.padded_nodes - 1
         for start in range(0, len(jobs), chunk):
             sub = dests[start : start + chunk]

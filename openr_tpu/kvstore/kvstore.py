@@ -530,8 +530,24 @@ class KvStore(OpenrModule):
         return {"enabled": True, **ft.status()}
 
     def _flood_topo_tick(self) -> None:
-        for ft in self.flood_topos.values():
+        for area, ft in self.flood_topos.items():
             ft.tick()
+            # flood optimization enabled but no electable root in sight
+            # (e.g. the flood_root_candidates set names no live node):
+            # the store silently floods full-mesh, which is correct but
+            # defeats the operator-enabled optimization — surface it
+            if self.peers and ft.dual.pick_flood_root() is None:
+                if self.counters:
+                    self.counters.increment("kvstore.flood_root_missing")
+                if not getattr(self, "_warned_no_flood_root", False):
+                    self._warned_no_flood_root = True
+                    log.warning(
+                        "%s: flood optimization enabled in area %s but no "
+                        "flood root is electable (check is_flood_root / "
+                        "flood_root_candidates) — falling back to "
+                        "full-mesh flooding",
+                        self.name, area,
+                    )
 
     # ------------------------------------------------------------------ TTL
 

@@ -107,11 +107,11 @@ class RouteMapTerm:
     remove_tags: tuple[str, ...] = ()
 
     def __post_init__(self):
-        # parse + validate the prefix matchers ONCE (redistribution
-        # applies the map per RIB prefix — re-parsing per evaluation
-        # would be O(prefixes x terms x items) string parses, and a
-        # malformed prefix must fail at build time, not on the first
-        # matching entry inside PrefixManager's event loop)
+        # parse + validate the prefix matchers and freeze the tag sets
+        # ONCE (redistribution applies the map per RIB prefix — doing
+        # this per evaluation would be O(prefixes x terms) rebuild work,
+        # and a malformed prefix must fail at build time, not on the
+        # first matching entry inside PrefixManager's event loop)
         object.__setattr__(
             self,
             "_nets",
@@ -120,16 +120,17 @@ class RouteMapTerm:
                 for p, ge, le in self.match_prefixes
             ),
         )
+        object.__setattr__(self, "_any", frozenset(self.match_tags_any))
+        object.__setattr__(self, "_all", frozenset(self.match_tags_all))
+        object.__setattr__(self, "_not", frozenset(self.match_not_tags))
 
-    def matches(self, entry: PrefixEntry) -> bool:
-        tags = set(entry.tags)
-        if self.match_tags_any and not (set(self.match_tags_any) & tags):
+    def matches(self, entry: PrefixEntry, _tags=None) -> bool:
+        tags = set(entry.tags) if _tags is None else _tags
+        if self._any and not (self._any & tags):
             return False
-        if self.match_tags_all and not (
-            set(self.match_tags_all) <= tags
-        ):
+        if self._all and not (self._all <= tags):
             return False
-        if self.match_not_tags and (set(self.match_not_tags) & tags):
+        if self._not and (self._not & tags):
             return False
         if self.match_prefixes:
             net = entry.prefix.network
@@ -192,8 +193,9 @@ class RouteMap:
                 )
 
     def apply(self, entry: PrefixEntry) -> PrefixEntry | None:
+        tags = set(entry.tags)  # once per entry, shared across terms
         for t in self.terms:
-            if t.matches(entry):
+            if t.matches(entry, _tags=tags):
                 if t.action == "deny":
                     return None
                 return t.transform(entry)
