@@ -131,14 +131,19 @@ def main() -> None:
     }
 
     # ---- TPU batched engine (v3 split kernel) -------------------------
+    # OPENR_BENCH_TRACE=<dir> captures an xprof trace of the timed
+    # iterations (SURVEY §5.1; solve/assembly phases are annotated)
+    from openr_tpu.monitor import profiling
+
     tpu = TpuSpfSolver(native_rib="off")  # batched kernel path
     for _ in range(WARMUP):
         solved = tpu.solve(ls, "node-0")
     times = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        solved = tpu.solve(ls, "node-0")
-        times.append((time.perf_counter() - t0) * 1e3)
+    with profiling.trace(os.environ.get("OPENR_BENCH_TRACE")):
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            solved = tpu.solve(ls, "node-0")
+            times.append((time.perf_counter() - t0) * 1e3)
     solve_p50, solve_p99 = _p50_p99(times)
     _csr, dist, _fh, nbr_ids, _ = solved
     detail["spf_batch"] = int(dist.shape[1])

@@ -27,6 +27,7 @@ from openr_tpu.decision.ksp import (
 )
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
 from openr_tpu.decision.oracle import metric_key
+from openr_tpu.monitor import profiling
 from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
     INF_DIST,
@@ -410,7 +411,8 @@ class TpuSpfSolver:
 
         roots = np.full(b, my_id, dtype=np.int32)  # padding repeats the root
         roots[1 : 1 + n] = nbr_ids
-        dist = self._solve_dist(csr, roots)
+        with profiling.annotate("spf:batched_solve"):
+            dist = self._solve_dist(csr, roots)
         fh = np.asarray(
             first_hop_matrix(
                 dist,
@@ -442,6 +444,10 @@ class TpuSpfSolver:
         solved = self.solve(ls, my_node)
         if solved is None:
             return rdb
+        with profiling.annotate("spf:rib_assembly"):
+            return self._assemble_routes(rdb, ls, ps, my_node, solved)
+
+    def _assemble_routes(self, rdb, ls, ps, my_node, solved):
         csr, dist, fh, nbr_ids, lfa = solved
         my_id = csr.name_to_id[my_node]
         d_root = dist[:, 0]  # [Vp]

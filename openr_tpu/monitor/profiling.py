@@ -1,0 +1,62 @@
+"""Kernel tracing surface (SURVEY §5.1: "JAX profiler + xprof traces
+for the SPF kernel, plus the same counter surface").
+
+Wraps jax.profiler so the rest of the framework never imports jax for
+observability alone, and so tracing degrades to a no-op on hosts where
+the backend is unavailable (the axon tunnel can be down while the CPU
+control plane keeps running).
+
+Usage:
+  with profiling.trace("/tmp/spf_trace"):      # xprof trace directory
+      solver.compute_routes(...)
+  with profiling.annotate("spf:solve"):        # named span inside it
+      ...
+
+bench.py honors OPENR_BENCH_TRACE=<dir> and wraps its timed iterations;
+TpuSpfSolver annotates solve/assembly phases so the xprof timeline
+separates device solve time from host RIB assembly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None):
+    """jax.profiler.trace(trace_dir), or a no-op when dir is falsy or
+    the profiler is unavailable/fails to start (unwritable directory,
+    session already active, ...)."""
+    if not trace_dir:
+        yield
+        return
+    cm = None
+    try:
+        import jax
+
+        cm = jax.profiler.trace(trace_dir)
+        cm.__enter__()  # start_trace runs HERE — keep it under the guard
+    except Exception:  # noqa: BLE001 — profiling must never break prod
+        log.warning("jax profiler unavailable; tracing disabled")
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            cm.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001 — export failure (bad dir, ...)
+            log.warning("jax profiler trace export failed", exc_info=True)
+
+
+def annotate(name: str):
+    """Named trace span (xprof timeline row); no-op without jax."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001
+        return contextlib.nullcontext()

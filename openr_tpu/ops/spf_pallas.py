@@ -16,13 +16,17 @@ distances, saturation at INF_DIST, overloaded-transit masking with the
 per-root exemption); `tests/test_spf_pallas.py` asserts elementwise
 equality against it.
 
-VMEM budget: dist is [Vp, B] int32 — 100k × 32 ≈ 12.8 MB, inside a
-v5e core's ~16 MB. `fits_vmem()` guards the caller; beyond it, use the
-XLA kernel (which tiles through HBM naturally).
-
-On CPU backends the kernel runs in interpreter mode (functional, slow)
-— production use is TPU-only, selected by `DecisionConfig.
-use_pallas_kernel`.
+**Round-3 hardware finding (docs/spf_kernel_profile.md §2):** this
+design cannot run on v5e. Mosaic lowers the row gather to
+`tpu.dynamic_gather`, which the hardware only supports INSIDE one 8x128
+vreg — any larger gather fails in the backend compiler. The kernel is
+therefore correct-but-interpreter-only (CPU), kept as the reference
+VMEM formulation for hardware generations with a SparseCore/wider
+gather; production TPU solves use `ops.spf_split` (the XLA v3 kernel),
+and `use_pallas_kernel` remains off by default. The per-sweep host
+round-trip in `batched_sssp_pallas` would also cost ~85 ms each over
+the axon tunnel — a single-jit while_loop (as in spf_split) is the
+only viable loop structure there.
 """
 
 from __future__ import annotations
