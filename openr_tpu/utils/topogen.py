@@ -232,6 +232,7 @@ class LsdbView:
         self._labels = {
             s: 101 + i for i, s in enumerate(csr.node_names)
         }
+        self._out_index = None  # lazy src-sorted edge index
 
     def to_csr(self):
         return self._csr
@@ -239,10 +240,52 @@ class LsdbView:
     def node_label(self, node: str) -> int:
         return self._labels[node]
 
+    def is_node_overloaded(self, node: str) -> bool:
+        nid = self._csr.name_to_id.get(node)
+        return bool(
+            nid is not None and self._csr.node_overloaded[nid]
+        )
+
     def adjacency_db(self, node: str):
-        # adjacency MPLS labels are out of scope for the synthetic
-        # benchmark LSDB (no per-link label allocation)
-        return None
+        """Synthesized on demand from the CSR arrays (same naming
+        convention as the adj_details the builder populates), so the
+        oracle and the MPLS adjacency section see a full LinkState
+        surface. No per-link labels (adj_label=0)."""
+        from openr_tpu.types.topology import Adjacency, AdjacencyDatabase
+
+        csr = self._csr
+        nid = csr.name_to_id.get(node)
+        if nid is None:
+            return None
+        if self._out_index is None:
+            valid = csr.edge_metric < np.int32(1 << 30)
+            src = csr.edge_src[valid]
+            order = np.argsort(src, kind="stable")
+            starts = np.searchsorted(
+                src[order], np.arange(csr.padded_nodes + 1)
+            )
+            self._out_index = (
+                csr.edge_dst[valid][order],
+                csr.edge_metric[valid][order],
+                starts,
+            )
+        dst, met, starts = self._out_index
+        lo, hi = starts[nid], starts[nid + 1]
+        adjs = tuple(
+            Adjacency(
+                other_node_name=csr.node_names[int(d)],
+                if_name=f"if_{nid}_{int(d)}",
+                other_if_name=f"if_{int(d)}_{nid}",
+                metric=int(m),
+            )
+            for d, m in zip(dst[lo:hi], met[lo:hi])
+        )
+        return AdjacencyDatabase(
+            this_node_name=node,
+            adjacencies=adjs,
+            node_label=self._labels[node],
+            area=self.area,
+        )
 
 
 def erdos_renyi_lsdb(

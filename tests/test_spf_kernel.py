@@ -246,3 +246,24 @@ def test_kernel_repeated_roots_and_padding():
     assert dist[0, 0] == 0 and dist[2, 0] == 2
     # dead padding node slots stay unreachable
     assert (dist[csr.num_nodes :, :] >= INF_DIST).all()
+
+
+def test_synthetic_bench_lsdb_matches_oracle():
+    """bench.py's directly-constructed LSDB (topogen.erdos_renyi_lsdb,
+    no AdjacencyDatabase objects) must drive compute_routes to the same
+    RIB the oracle derives from the same view — validates the headline
+    bench's full-RIB path end-to-end at a small scale."""
+    from openr_tpu.ops.native_spf import native_available
+
+    ls, ps, _csr = topogen.erdos_renyi_lsdb(
+        300, avg_degree=6, seed=3, max_metric=32
+    )
+    want = oracle_routes(ls, ps, "node-0")
+    assert len(want.unicast_routes) > 250  # connected-ish graph
+    engines = [dict(native_rib="off")]
+    if native_available():
+        engines.append(dict(native_rib="on"))
+    for kw in engines:
+        got = TpuSpfSolver(**kw).compute_routes(ls, ps, "node-0")
+        assert got.unicast_routes == want.unicast_routes, kw
+        assert got.mpls_routes == want.mpls_routes, kw
