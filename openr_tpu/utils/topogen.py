@@ -258,7 +258,9 @@ class LsdbView:
         if nid is None:
             return None
         if self._out_index is None:
-            valid = csr.edge_metric < np.int32(1 << 30)
+            from openr_tpu.common.constants import DIST_INF
+
+            valid = csr.edge_metric < DIST_INF
             src = csr.edge_src[valid]
             order = np.argsort(src, kind="stable")
             starts = np.searchsorted(

@@ -1,0 +1,91 @@
+"""Fleet batched solve (decision/fleet.py): every node's RIB from one
+device call must equal the per-node solver output exactly."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.fleet import compute_fleet_ribs
+from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.spf_backend import TpuSpfSolver
+from openr_tpu.types.topology import AdjacencyDatabase
+from openr_tpu.utils import topogen
+
+
+def _state(adj_dbs, prefix_dbs):
+    ls, ps = LinkState(), PrefixState()
+    for db in adj_dbs:
+        ls.update_adjacency_db(db)
+    for db in prefix_dbs:
+        ps.update_prefix_db(db)
+    return ls, ps
+
+
+@pytest.mark.parametrize(
+    "topo",
+    ["grid", "fat_tree", "er"],
+)
+def test_fleet_equals_per_node(topo):
+    if topo == "grid":
+        adj_dbs, prefix_dbs = topogen.grid(4, 4)
+    elif topo == "fat_tree":
+        adj_dbs, prefix_dbs = topogen.fat_tree(4)
+    else:
+        adj_dbs, prefix_dbs = topogen.erdos_renyi(
+            40, avg_degree=4, seed=9, max_metric=16
+        )
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    fleet = compute_fleet_ribs(ls, ps)
+    assert set(fleet) == set(ls.nodes)
+    per_node = TpuSpfSolver(native_rib="off")
+    for node in ls.nodes:
+        want = per_node.compute_routes(ls, ps, node)
+        got = fleet[node]
+        assert got.unicast_routes == want.unicast_routes, node
+        assert got.mpls_routes == want.mpls_routes, node
+
+
+def test_fleet_with_overloads():
+    adj_dbs, prefix_dbs = topogen.grid(4, 4)
+    adj_dbs[5] = AdjacencyDatabase(
+        this_node_name=adj_dbs[5].this_node_name,
+        adjacencies=adj_dbs[5].adjacencies,
+        is_overloaded=True,
+        node_label=adj_dbs[5].node_label,
+        area=adj_dbs[5].area,
+    )
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    fleet = compute_fleet_ribs(ls, ps)
+    per_node = TpuSpfSolver(native_rib="off")
+    for node in ("node-0", "node-5", "node-15"):
+        want = per_node.compute_routes(ls, ps, node)
+        assert fleet[node].unicast_routes == want.unicast_routes, node
+
+
+def test_fleet_subset_and_unknown():
+    adj_dbs, prefix_dbs = topogen.ring(5)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    fleet = compute_fleet_ribs(ls, ps, nodes=["node-1", "ghost"])
+    assert set(fleet) == {"node-1"}
+    want = TpuSpfSolver(native_rib="off").compute_routes(ls, ps, "node-1")
+    assert fleet["node-1"].unicast_routes == want.unicast_routes
+
+
+def test_fleet_chunked_solves():
+    """Chunked all-roots solving (chunk < n) must match the per-node
+    solver exactly (the memory-bounded fleet path)."""
+    adj_dbs, prefix_dbs = topogen.grid(5, 5)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    fleet = compute_fleet_ribs(ls, ps, chunk=8)
+    per_node = TpuSpfSolver(native_rib="off")
+    for node in ("node-0", "node-12", "node-24"):
+        want = per_node.compute_routes(ls, ps, node)
+        assert fleet[node].unicast_routes == want.unicast_routes, node
+
+
+def test_fleet_rejects_lfa_solver():
+    adj_dbs, prefix_dbs = topogen.ring(4)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    with pytest.raises(ValueError):
+        compute_fleet_ribs(ls, ps, solver=TpuSpfSolver(enable_lfa=True))
