@@ -49,19 +49,6 @@ def compute_fleet_ribs(
     n = csr.num_nodes
     if n == 0:
         return {}
-    chunk = pad_batch(min(chunk, n))
-    cols = []
-    pending = None
-    for start in range(0, n, chunk):
-        roots = (
-            np.arange(start, start + chunk, dtype=np.int32) % n
-        )  # tail wraps — duplicate columns are simply unused
-        d = solver._solve_dist(csr, roots)
-        if pending is not None:
-            cols.append(np.asarray(pending))
-        pending = d
-    cols.append(np.asarray(pending))
-    dist_all = np.concatenate(cols, axis=1)[:, : max(n, 1)]  # [vp, n]
 
     # per-node out-adjacency (min metric per neighbor), from the keys
     # the CSR already carries for nexthop construction
@@ -69,8 +56,39 @@ def compute_fleet_ribs(
     for (s, d) in csr.adj_details:
         nbrs_of.setdefault(s, []).append(d)
 
+    targets = [
+        node
+        for node in (nodes if nodes is not None else list(csr.node_names))
+        if node in csr.name_to_id
+    ]
+    # roots actually needed: each target plus its neighbors (a subset
+    # request must not pay a whole-fleet solve)
+    needed: set[int] = set()
+    for node in targets:
+        mid = csr.name_to_id[node]
+        needed.add(mid)
+        needed.update(nbrs_of.get(mid, []))
+    root_list = np.array(sorted(needed), dtype=np.int32)
+    col_of = {int(r): i for i, r in enumerate(root_list)}
+    # the MPLS entry cache is keyed per root fingerprint — cover them all
+    solver._mpls_fingerprint_cap = max(
+        solver._mpls_fingerprint_cap, len(targets) + 1
+    )
+
+    chunk = pad_batch(min(chunk, max(len(root_list), 1)))
+    cols = []
+    pending = None
+    for start in range(0, len(root_list), chunk):
+        roots = np.resize(root_list[start : start + chunk], chunk)
+        d = solver._solve_dist(csr, roots)
+        if pending is not None:
+            cols.append(np.asarray(pending))
+        pending = d
+    cols.append(np.asarray(pending))
+    dist_all = np.concatenate(cols, axis=1)[:, : len(root_list)]
+
     out: dict[str, RouteDatabase] = {}
-    for node in nodes if nodes is not None else list(csr.node_names):
+    for node in targets:
         my_id = csr.name_to_id.get(node)
         if my_id is None:
             continue
@@ -82,8 +100,10 @@ def compute_fleet_ribs(
             nbr_metric[i] = min(
                 min(det[1] for det in csr.details(my_id, d)), METRIC_MAX
             )
-        d_root = dist_all[:, my_id].astype(np.int64)  # [vp]
-        d_nbr = dist_all[:, nbr_ids].astype(np.int64)  # [vp, k]
+        d_root = dist_all[:, col_of[my_id]].astype(np.int64)  # [vp]
+        d_nbr = dist_all[
+            :, [col_of[d] for d in nbr_ids]
+        ].astype(np.int64)  # [vp, k]
         # ECMP first-hop identity (ops.spf.first_hop_matrix, host-side):
         # n is a valid first hop toward v iff m(root,n) + dist_n(v) ==
         # dist_root(v); overloaded neighbors only toward themselves
@@ -100,7 +120,7 @@ def compute_fleet_ribs(
         fh[:k] = on_spt.T
         solved = (
             csr,
-            dist_all[:, my_id][:, None].astype(np.int32),
+            dist_all[:, col_of[my_id]][:, None].astype(np.int32),
             fh,
             nbr_ids,
             None,

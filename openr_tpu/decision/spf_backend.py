@@ -52,6 +52,31 @@ from openr_tpu.types.network import (
 from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
 
 
+def _dest_classes(fh: np.ndarray, d_root: np.ndarray, n_live: int):
+    """(class id per live node, content token per class) for the
+    (first-hop column, igp) equivalence relation.
+
+    The token is what cross-rebuild caches key on, so it must encode
+    the CONTENT (column bits + igp), never the rebuild-local class
+    number. Up to 32 neighbor slots + igp packs into one int64 — the
+    common case — which makes the unique() a fast 1-D integer sort;
+    wider neighbor sets fall back to row-wise unique over bytes.
+    """
+    packed = np.packbits(fh[:, :n_live], axis=0)  # [P, n_live]
+    igp32 = np.ascontiguousarray(d_root[:n_live].astype(np.int32))
+    p = packed.shape[0]
+    width = p + 4
+    key = np.zeros((n_live, 8 if width <= 8 else width), np.uint8)
+    key[:, :p] = packed.T
+    key[:, p : p + 4] = igp32.view(np.uint8).reshape(n_live, 4)
+    if width <= 8:
+        flat = key.view(np.int64).ravel()
+        tokens, inv = np.unique(flat, return_inverse=True)
+        return inv, [int(t) for t in tokens]
+    ucls, inv = np.unique(key, axis=0, return_inverse=True)
+    return inv, [u.tobytes() for u in ucls]
+
+
 class TpuSpfSolver:
     """Computes a node's RouteDatabase on the TPU from the padded CSR LSDB.
 
@@ -94,9 +119,11 @@ class TpuSpfSolver:
         self._dev: dict[int, dict] = {}
         self._dev_lru_cap = 4
         # cross-rebuild MPLS RibMplsEntry cache: {slot_fingerprint:
-        # {(label, node, fh_col_bytes, igp): RibMplsEntry}} — see the
-        # MPLS section of compute_routes
+        # {(label, node, class_token, igp): RibMplsEntry}} — see the
+        # MPLS section of _assemble_routes. LRU over fingerprints; the
+        # cap covers one root by default and is raised by the fleet path
         self._mpls_cache: dict = {}
+        self._mpls_fingerprint_cap = 8
 
     def _device_arrays(self, csr, want: str):
         """Cached (and incrementally patched) device copies of the LSDB.
@@ -480,6 +507,14 @@ class TpuSpfSolver:
                 )
             return got
 
+        # per-destination-node (first-hop column, igp) equivalence
+        # classes, computed ONCE and shared by the plain-prefix and MPLS
+        # sections: dest_cls[i] is node i's class, dest_tokens[c] a
+        # content-stable hashable token (survives rebuilds — it encodes
+        # the column bits + igp, so cross-rebuild caches can key on it)
+        n_live = len(csr.node_names)
+        dest_cls, dest_tokens = _dest_classes(fh, d_root, n_live)
+
         # ---- unicast: plain prefixes, vectorized --------------------------
         # The dominant RIB shape is "one advertiser, SP_ECMP, no
         # constraints" (every loopback in the fabric). PrefixState
@@ -496,32 +531,19 @@ class TpuSpfSolver:
             reach = (
                 (d_root[orig] < INF_DIST) & fh_any[orig] & (orig != my_id)
             )
-            igp = d_root[orig].astype(np.int32)
-            packed = np.packbits(fh, axis=0)  # [ceil(N/8), Vp]
+            igp = d_root[orig].astype(np.int64)
             idxs = np.nonzero(reach)[0]
-            key = np.concatenate(
-                [
-                    packed[:, orig[idxs]].T,
-                    np.ascontiguousarray(igp[idxs])
-                    .view(np.uint8)
-                    .reshape(len(idxs), 4),
-                ],
-                axis=1,
-            )
-            _ucls, uidx, inv = np.unique(
-                key, axis=0, return_index=True, return_inverse=True
-            )
-            class_nhs = []
-            for u in uidx:
+            cls = dest_cls[orig[idxs]]  # shared per-node classification
+            ucls, uidx = np.unique(cls, return_index=True)
+            class_nhs = {}
+            for c, u in zip(ucls, uidx):
                 i = idxs[int(u)]
-                class_nhs.append(
-                    self._mk_nexthops_union(
-                        slot_cache, fh[:, orig[i]], int(igp[i]), ls.area
-                    )
+                class_nhs[int(c)] = self._mk_nexthops_union(
+                    slot_cache, fh[:, orig[i]], int(igp[i]), ls.area
                 )
             unicast = rdb.unicast_routes
             for j, i in enumerate(idxs):
-                nhs = class_nhs[inv[j]]
+                nhs = class_nhs[int(cls[j])]
                 if not nhs:
                     continue
                 p = plain_p[i]
@@ -624,24 +646,43 @@ class TpuSpfSolver:
         # fingerprint keys my own adjacency details (interface names,
         # min-metric parallel links), which the fh column alone can't see.
         slot_gen = (ls.area, tuple(tuple(s) for s in slot_cache))
-        mpls_cache = self._mpls_cache.setdefault(slot_gen, {})
-        if len(self._mpls_cache) > 8:  # new slot fingerprints evict old
-            self._mpls_cache = {slot_gen: mpls_cache}
+        # re-insert to refresh the fingerprint's LRU position
+        mpls_cache = self._mpls_cache.pop(slot_gen, None) or {}
+        self._mpls_cache[slot_gen] = mpls_cache
+        # evict least-recently-used fingerprints (NOT a full wipe — the
+        # fleet path serves many roots per pass, each a fingerprint, and
+        # a wipe would defeat the cross-rebuild cache it relies on); the
+        # cap is raised by compute_fleet_ribs to cover its root count
+        while len(self._mpls_cache) > self._mpls_fingerprint_cap:
+            self._mpls_cache.pop(next(iter(self._mpls_cache)))
         if len(mpls_cache) > max(4096, 4 * len(csr.node_names)):
             mpls_cache.clear()
-        for node in ls.nodes:
-            label = ls.node_label(node)
-            nid = csr.name_to_id[node]
-            if label < MPLS_LABEL_MIN or node == my_node:
-                continue
-            if d_root[nid] >= INF_DIST or not fh_any[nid]:
-                continue
-            igp = int(d_root[nid])
-            col = fh[:, nid]
-            key = (label, node, col.tobytes(), igp)
+        # vectorized per-destination eligibility; the expensive content
+        # key reuses the shared dest_cls/dest_tokens classification, so
+        # the steady-state loop is token-keyed dict hits (no per-node
+        # tobytes/hashing of columns)
+        names = csr.node_names
+        ids = np.arange(n_live, dtype=np.int64)
+        labels_v = np.fromiter(
+            (ls.node_label(nm) for nm in names), np.int64, count=n_live
+        )
+        elig = (
+            (labels_v >= MPLS_LABEL_MIN)
+            & (ids != my_id)
+            & (d_root[:n_live] < INF_DIST)
+            & fh_any[:n_live]
+        )
+        sel = np.nonzero(elig)[0]
+        mpls_routes = rdb.mpls_routes
+        for j in range(len(sel)):
+            i = int(sel[j])
+            node = names[i]
+            label = int(labels_v[i])
+            igp = int(d_root[i])
+            key = (label, node, dest_tokens[dest_cls[i]], igp)
             entry = mpls_cache.get(key)
             if entry is None:
-                base = mk_nexthops_cached(np.array([nid]), igp)
+                base = mk_nexthops_cached(np.array([i]), igp)
                 nhs = tuple(
                     NextHop(
                         address=nh.address,
@@ -663,7 +704,7 @@ class TpuSpfSolver:
                     continue
                 entry = RibMplsEntry(label=label, nexthops=nhs)
                 mpls_cache[key] = entry
-            rdb.mpls_routes[label] = entry
+            mpls_routes[label] = entry
 
         # ---- MPLS adjacency labels ---------------------------------------
         my_db = ls.adjacency_db(my_node)

@@ -3,7 +3,6 @@ device call must equal the per-node solver output exactly."""
 
 from __future__ import annotations
 
-import numpy as np
 import pytest
 
 from openr_tpu.decision.fleet import compute_fleet_ribs
