@@ -68,12 +68,10 @@ def compute_fleet_ribs(
         mid = csr.name_to_id[node]
         needed.add(mid)
         needed.update(nbrs_of.get(mid, []))
+    if not targets:
+        return {}
     root_list = np.array(sorted(needed), dtype=np.int32)
     col_of = {int(r): i for i, r in enumerate(root_list)}
-    # the MPLS entry cache is keyed per root fingerprint — cover them all
-    solver._mpls_fingerprint_cap = max(
-        solver._mpls_fingerprint_cap, len(targets) + 1
-    )
 
     chunk = pad_batch(min(chunk, max(len(root_list), 1)))
     cols = []
@@ -87,6 +85,25 @@ def compute_fleet_ribs(
     cols.append(np.asarray(pending))
     dist_all = np.concatenate(cols, axis=1)[:, : len(root_list)]
 
+    # the MPLS entry cache is keyed per root fingerprint — raise the cap
+    # for the duration of this pass so the fleet's own roots fit, then
+    # restore it (a shared long-lived solver must not keep an
+    # N-fingerprint memory footprint after one fleet pass; per-pass
+    # reuse is what matters, and the LRU keeps the hottest entries)
+    saved_cap = solver._mpls_fingerprint_cap
+    solver._mpls_fingerprint_cap = max(saved_cap, len(targets) + 1)
+    try:
+        out = _assemble_all(
+            solver, ls, ps, csr, targets, nbrs_of, col_of, dist_all
+        )
+    finally:
+        solver._mpls_fingerprint_cap = saved_cap
+    return out
+
+
+def _assemble_all(
+    solver, ls, ps, csr, targets, nbrs_of, col_of, dist_all
+) -> dict[str, RouteDatabase]:
     out: dict[str, RouteDatabase] = {}
     for node in targets:
         my_id = csr.name_to_id.get(node)

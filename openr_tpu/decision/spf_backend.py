@@ -118,6 +118,10 @@ class TpuSpfSolver:
         # updated by scatter")
         self._dev: dict[int, dict] = {}
         self._dev_lru_cap = 4
+        # observability: full table (re)builds+uploads vs in-place patch
+        # scatters vs pure hits — under metric-only churn, `uploads`
+        # must stay flat after warmup (tested)
+        self.dev_cache_stats = {"uploads": 0, "patches": 0, "hits": 0}
         # cross-rebuild MPLS RibMplsEntry cache: {slot_fingerprint:
         # {(label, node, class_token, igp): RibMplsEntry}} — see the
         # MPLS section of _assemble_routes. LRU over fingerprints; the
@@ -154,7 +158,9 @@ class TpuSpfSolver:
             self._dev.pop(next(iter(self._dev)))
         got = cache["sets"].get(want)
         if got is not None:
+            self.dev_cache_stats["hits"] += 1
             return got
+        self.dev_cache_stats["uploads"] += 1
         # build the wanted set from the (already journal-complete) csr
         if want == "split":
             t = build_split_tables(
@@ -204,6 +210,7 @@ class TpuSpfSolver:
             return
         done = cache.get("journal_len", 0)
         if len(csr.patches) > done:
+            self.dev_cache_stats["patches"] += 1
             new_patches = list(csr.patches[done:])
             # pad the patch arrays to a bucket (repeating the last patch
             # — duplicate .set of the same value is a no-op): without

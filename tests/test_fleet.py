@@ -88,3 +88,19 @@ def test_fleet_rejects_lfa_solver():
     ls, ps = _state(adj_dbs, prefix_dbs)
     with pytest.raises(ValueError):
         compute_fleet_ribs(ls, ps, solver=TpuSpfSolver(enable_lfa=True))
+
+
+def test_fleet_empty_and_all_unknown_targets():
+    adj_dbs, prefix_dbs = topogen.ring(4)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    assert compute_fleet_ribs(ls, ps, nodes=[]) == {}
+    assert compute_fleet_ribs(ls, ps, nodes=["no-such-node"]) == {}
+
+
+def test_fleet_restores_mpls_fingerprint_cap():
+    adj_dbs, prefix_dbs = topogen.grid(4, 4)
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    solver = TpuSpfSolver(native_rib="off")
+    cap0 = solver._mpls_fingerprint_cap
+    compute_fleet_ribs(ls, ps, solver=solver)
+    assert solver._mpls_fingerprint_cap == cap0

@@ -238,3 +238,54 @@ def test_decision_churn_end_to_end_equivalence():
     rib_ref = dec_fresh.compute_rib()
     assert rib1.unicast_routes == rib_ref.unicast_routes
     assert rib1.mpls_routes == rib_ref.mpls_routes
+
+
+def test_device_cache_zero_reuploads_under_metric_churn():
+    """Under sustained metric-only churn — including KSP-bearing
+    rebuilds — the solver's device cache must absorb every update as a
+    patch scatter: ZERO table re-uploads after warmup (round-2 verdict
+    item 4's done-criterion)."""
+    import dataclasses
+
+    from openr_tpu.decision.linkstate import PrefixState
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.types.topology import (
+        ForwardingAlgorithm,
+        PrefixDatabase,
+    )
+    from openr_tpu.utils import topogen
+
+    adj_dbs, prefix_dbs = topogen.grid(4, 4)
+    ls = fresh_ls(adj_dbs)
+    ps = PrefixState()
+    for i, p in enumerate(prefix_dbs):
+        entries = tuple(
+            dataclasses.replace(
+                e, forwarding_algorithm=ForwardingAlgorithm.KSP2_ED_ECMP
+            )
+            if i % 4 == 0
+            else e
+            for e in p.prefix_entries
+        )
+        ps.update_prefix_db(
+            PrefixDatabase(
+                this_node_name=p.this_node_name,
+                prefix_entries=entries,
+                area=p.area,
+            )
+        )
+    solver = TpuSpfSolver(native_rib="off")
+    solver.compute_routes(ls, ps, "node-0")  # warm: uploads happen here
+    uploads_warm = solver.dev_cache_stats["uploads"]
+    for m in (11, 13, 17, 19):
+        base = adj_dbs[5]
+        adjs = tuple(
+            dataclasses.replace(a, metric=m) for a in base.adjacencies
+        )
+        ls.update_adjacency_db(
+            dataclasses.replace(base, adjacencies=adjs)
+        )
+        solver.compute_routes(ls, ps, "node-0")
+    stats = solver.dev_cache_stats
+    assert stats["uploads"] == uploads_warm, stats  # zero re-uploads
+    assert stats["patches"] >= 4, stats  # every churn step patched
