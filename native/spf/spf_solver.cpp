@@ -94,8 +94,11 @@ inline bool usable_src(const Csr& g, int32_t u, int32_t root) {
 }
 
 // Dijkstra from `root` honoring overload-transit rules. dist must be
-// caller-allocated [v]; filled with kInf for unreachable.
-void dijkstra(const Csr& g, int32_t root, int32_t* dist) {
+// caller-allocated [v]; filled with kInf for unreachable. When `order`
+// is non-null, the settle (final-pop) sequence is appended to it — a
+// free by-product that saves the fh pass an O(V log V) sort.
+void dijkstra(const Csr& g, int32_t root, int32_t* dist,
+              std::vector<int32_t>* order = nullptr) {
   std::fill(dist, dist + g.v, kInf);
   if (root < 0 || root >= g.v) return;
   RadixHeap heap(g.v);
@@ -104,6 +107,7 @@ void dijkstra(const Csr& g, int32_t root, int32_t* dist) {
   while (!heap.empty()) {
     auto [d, u] = heap.pop();
     if (d != dist[u]) continue;  // stale
+    if (order != nullptr && u != root) order->push_back(u);
     if (!usable_src(g, u, root)) continue;
     const int64_t lo = g.row_start[u], hi = g.row_start[u + 1];
     for (int64_t i = lo; i < hi; ++i) {
@@ -160,20 +164,14 @@ int openr_spf_rib(int32_t v, const int64_t* row_start, const int32_t* dst,
                   const int32_t* nbr_ids, const int32_t* nbr_metric,
                   int32_t n_nbrs, int32_t* dist_out, uint64_t* fh_out) {
   Csr g{v, row_start, dst, w, overloaded};
-  dijkstra(g, root, dist_out);
+  // settle order falls out of the Dijkstra pops (non-decreasing dist)
+  // — no separate O(V log V) sort for the propagation pass
+  std::vector<int32_t> order;
+  order.reserve(v);
+  dijkstra(g, root, dist_out, &order);
   const int32_t words = (n_nbrs + 63) / 64;
   std::memset(fh_out, 0, static_cast<size_t>(v) * words * sizeof(uint64_t));
   if (n_nbrs == 0) return 0;
-
-  // Order nodes by distance (counting sort over the compressed set of
-  // distinct finite distances — distances are arbitrary int32, so sort
-  // (dist, node) pairs instead; v log v with a tight constant).
-  std::vector<int64_t> order;
-  order.reserve(g.v);
-  for (int32_t i = 0; i < g.v; ++i)
-    if (dist_out[i] < kInf && i != root)
-      order.push_back((static_cast<int64_t>(dist_out[i]) << 32) | i);
-  std::sort(order.begin(), order.end());
 
   // Seed: direct root->neighbor edges that are tight. A slot seeds even
   // for an overloaded neighbor (valid toward itself); propagation out of
@@ -196,8 +194,7 @@ int openr_spf_rib(int32_t v, const int64_t* row_start, const int32_t* dst,
   bool grew = true;
   while (grew) {
     grew = false;
-    for (const int64_t key : order) {
-      const int32_t u = static_cast<int32_t>(key & 0xffffffff);
+    for (const int32_t u : order) {
       if (!usable_src(g, u, root)) continue;
       const uint64_t* fu = fh_out + static_cast<int64_t>(u) * words;
       bool any = false;

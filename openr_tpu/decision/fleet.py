@@ -85,20 +85,18 @@ def compute_fleet_ribs(
     cols.append(np.asarray(pending))
     dist_all = np.concatenate(cols, axis=1)[:, : len(root_list)]
 
-    # the MPLS entry cache is keyed per root fingerprint — raise the cap
-    # for the duration of this pass so the fleet's own roots fit, then
-    # restore it (a shared long-lived solver must not keep an
-    # N-fingerprint memory footprint after one fleet pass; per-pass
-    # reuse is what matters, and the LRU keeps the hottest entries)
-    saved_cap = solver._mpls_fingerprint_cap
-    solver._mpls_fingerprint_cap = max(saved_cap, len(targets) + 1)
-    try:
-        out = _assemble_all(
-            solver, ls, ps, csr, targets, nbrs_of, col_of, dist_all
-        )
-    finally:
-        solver._mpls_fingerprint_cap = saved_cap
-    return out
+    # The MPLS entry cache is keyed per root fingerprint; raise the cap
+    # DURABLY so repeated fleet passes keep their entries (cross-pass
+    # reuse is why a caller shares a solver at all). The memory cost is
+    # the caller's explicit choice: the default (solver=None) footprint
+    # dies with this call, and a shared solver can reclaim it any time
+    # via TpuSpfSolver.trim_caches().
+    solver._mpls_fingerprint_cap = max(
+        solver._mpls_fingerprint_cap, len(targets) + 1
+    )
+    return _assemble_all(
+        solver, ls, ps, csr, targets, nbrs_of, col_of, dist_all
+    )
 
 
 def _assemble_all(

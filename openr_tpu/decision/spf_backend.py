@@ -125,7 +125,8 @@ class TpuSpfSolver:
         # cross-rebuild MPLS RibMplsEntry cache: {slot_fingerprint:
         # {(label, node, class_token, igp): RibMplsEntry}} — see the
         # MPLS section of _assemble_routes. LRU over fingerprints; the
-        # cap covers one root by default and is raised by the fleet path
+        # cap covers one root by default, and compute_fleet_ribs raises
+        # it durably to its root count (reclaim via trim_caches())
         self._mpls_cache: dict = {}
         self._mpls_fingerprint_cap = 8
 
@@ -267,6 +268,14 @@ class TpuSpfSolver:
                         )
             cache["journal_len"] = len(csr.patches)
         cache["version"] = csr.version
+
+    def trim_caches(self, fingerprint_cap: int = 8) -> None:
+        """Reclaim assembly-cache memory (e.g. after a fleet pass on a
+        shared solver): drop the MPLS fingerprint cap back down and
+        evict LRU fingerprints beyond it."""
+        self._mpls_fingerprint_cap = fingerprint_cap
+        while len(self._mpls_cache) > fingerprint_cap:
+            self._mpls_cache.pop(next(iter(self._mpls_cache)))
 
     def _pick_table(self, csr) -> str:
         """Which table set the batched solve uses for this topology.

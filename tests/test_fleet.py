@@ -97,10 +97,22 @@ def test_fleet_empty_and_all_unknown_targets():
     assert compute_fleet_ribs(ls, ps, nodes=["no-such-node"]) == {}
 
 
-def test_fleet_restores_mpls_fingerprint_cap():
+def test_fleet_mpls_cache_reuse_and_trim():
+    """The fleet pass durably raises the MPLS fingerprint cap so a
+    SECOND pass reuses the cached entries; trim_caches() reclaims the
+    footprint on demand."""
     adj_dbs, prefix_dbs = topogen.grid(4, 4)
     ls, ps = _state(adj_dbs, prefix_dbs)
     solver = TpuSpfSolver(native_rib="off")
-    cap0 = solver._mpls_fingerprint_cap
-    compute_fleet_ribs(ls, ps, solver=solver)
-    assert solver._mpls_fingerprint_cap == cap0
+    f1 = compute_fleet_ribs(ls, ps, solver=solver)
+    n_fp = len(solver._mpls_cache)
+    assert n_fp >= len(f1)  # one fingerprint per root retained
+    f2 = compute_fleet_ribs(ls, ps, solver=solver)
+    # second pass: identical results served from the retained caches
+    assert all(
+        f1[n].mpls_routes == f2[n].mpls_routes for n in f1
+    )
+    assert len(solver._mpls_cache) == n_fp  # no thrash between passes
+    solver.trim_caches()
+    assert len(solver._mpls_cache) <= 8
+    assert solver._mpls_fingerprint_cap == 8
