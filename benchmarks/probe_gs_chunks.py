@@ -1,0 +1,130 @@
+"""Probe: Gauss-Seidel chunked sweeps + gather form for the v4 kernel.
+
+A Jacobi sweep needs ~26 iterations (weighted hop depth). Chunked
+Gauss-Seidel relaxes row-chunks sequentially within a sweep, each chunk
+seeing the chunks before it — alternating sweep direction halves the
+count again. Same gathered rows per sweep, fewer sweeps. Measures
+sweeps-to-fixpoint and wall time per (chunks, direction) config, plus
+the d-loop gather form inside chunks.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.decision.spf_backend import TpuSpfSolver
+from openr_tpu.utils.topogen import erdos_renyi_lsdb
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+INF = np.int32(1 << 30)
+
+print(f"# device: {jax.devices()[0]}")
+ls, ps, csr = erdos_renyi_lsdb(N, avg_degree=20, seed=0, max_metric=64)
+tpu = TpuSpfSolver(native_rib="off")
+dev = tpu._device_arrays(csr, "split")
+vp = dev["base_nbr"].shape[0]
+W = dev["base_wgt"].shape[1]
+b = 32
+rng = np.random.default_rng(1)
+roots_h = rng.integers(0, N, size=b).astype(np.int32)
+roots = jnp.asarray(roots_h)
+
+base_nbr, base_wgt = dev["base_nbr"], dev["base_wgt"]
+ov_ids, ov_nbr, ov_wgt = dev["ov_ids"], dev["ov_nbr"], dev["ov_wgt"]
+
+
+def relax_block(dist, nbr, wgt):
+    g = dist[nbr]
+    return jnp.where(
+        g < INF, jnp.minimum(g + wgt[:, :, None], INF), INF
+    ).min(axis=1)
+
+
+def relax_block_dloop(dist, nbr, wgt):
+    acc = jnp.full((nbr.shape[0], dist.shape[1]), INF, jnp.int32)
+    for d in range(nbr.shape[1]):
+        g = dist[nbr[:, d]]
+        cand = jnp.where(g < INF, jnp.minimum(g + wgt[:, d][:, None], INF), INF)
+        acc = jnp.minimum(acc, cand)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("chunks", "alternate", "dloop"))
+def solve_gs(roots, chunks, alternate, dloop):
+    dist = jnp.full((vp, b), INF, jnp.int32)
+    dist = dist.at[roots, jnp.arange(b)].set(0)
+    csz = vp // chunks
+    rb = relax_block_dloop if dloop else relax_block
+
+    def sweep(state):
+        dist, it, _ = state
+        before = dist
+
+        def chunk_body(c, dist):
+            idx = jax.lax.cond(
+                alternate & (it % 2 == 1),
+                lambda: (chunks - 1 - c) * csz,
+                lambda: c * csz,
+            )
+            nbr = jax.lax.dynamic_slice(base_nbr, (idx, 0), (csz, W))
+            wgt = jax.lax.dynamic_slice(base_wgt, (idx, 0), (csz, W))
+            new = rb(dist, nbr, wgt)
+            cur = jax.lax.dynamic_slice(dist, (idx, 0), (csz, b))
+            return jax.lax.dynamic_update_slice(
+                dist, jnp.minimum(new, cur), (idx, 0)
+            )
+
+        dist = jax.lax.fori_loop(0, chunks, chunk_body, dist)
+        ov_new = relax_block(dist, ov_nbr, ov_wgt)
+        dist = dist.at[ov_ids].min(ov_new)
+        return dist, it + 1, jnp.any(dist < before)
+
+    def cond(state):
+        _, it, changed = state
+        return changed & (it < 200)
+
+    dist, sweeps, _ = jax.lax.while_loop(
+        cond, sweep, (dist, jnp.int32(0), jnp.bool_(True))
+    )
+    return dist, sweeps
+
+
+ref = None
+for chunks, alternate, dloop in [
+    (1, False, False),
+    (2, True, False),
+    (4, False, False),
+    (4, True, False),
+    (8, True, False),
+    (16, True, False),
+    (4, True, True),
+    (8, True, True),
+]:
+    try:
+        out, sw = solve_gs(roots, chunks, alternate, dloop)
+        out.block_until_ready()
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            out, sw = solve_gs(roots, chunks, alternate, dloop)
+            s = int(jnp.asarray(sw))
+            ts.append((time.perf_counter() - t0) * 1e3)
+        ts.sort()
+        o = np.asarray(out[:, 0])
+        if ref is None:
+            ref = o
+        okay = "ok" if (o == ref).all() else "MISMATCH"
+        print(f"  chunks={chunks:3d} alt={int(alternate)} dloop={int(dloop)}"
+              f"  sweeps={s:3d}  p50 {ts[len(ts)//2]:8.2f} ms  {okay}")
+    except Exception as e:  # noqa: BLE001
+        print(f"  chunks={chunks} alt={alternate} dloop={dloop} FAIL "
+              f"{str(e).splitlines()[0][:120]}")

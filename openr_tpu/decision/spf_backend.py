@@ -40,8 +40,10 @@ from openr_tpu.ops.spf import (
 )
 from openr_tpu.ops.spf_split import (
     batched_sssp_split,
+    batched_sssp_split_rib,
     build_split_tables,
     tight_nodes,
+    unpack_rib_buffer,
 )
 from openr_tpu.types.network import (
     MplsAction,
@@ -75,6 +77,60 @@ def _dest_classes(fh: np.ndarray, d_root: np.ndarray, n_live: int):
         return inv, [int(t) for t in tokens]
     ucls, inv = np.unique(key, axis=0, return_inverse=True)
     return inv, [u.tobytes() for u in ucls]
+
+
+class _LazyDist:
+    """Device-resident [Vp, B] distance matrix, materialized to host only
+    on demand.
+
+    The production RIB assembly reads only the root column (supplied
+    pre-transferred) and the packed first-hop bits; the full matrix is
+    12.8 MB at the 100k benchmark and the axon tunnel moves ~16 MB/s, so
+    an eager np.asarray costs ~760 ms nothing consumes. Consumers that DO
+    want the matrix (LFA backup construction, oracle checks, tests) index
+    or np.asarray() this object and pay the transfer once.
+    """
+
+    __slots__ = ("_dev", "_d_root", "_np")
+
+    def __init__(self, dev, d_root: np.ndarray):
+        self._dev = dev
+        self._d_root = d_root
+        self._np: np.ndarray | None = None
+
+    @property
+    def shape(self):
+        return self._dev.shape
+
+    @property
+    def dtype(self):
+        return np.dtype(np.int32)
+
+    def _materialize(self) -> np.ndarray:
+        if self._np is None:
+            self._np = np.asarray(self._dev)
+        return self._np
+
+    def __array__(self, dtype=None, copy=None):
+        a = self._materialize()
+        if dtype is not None and np.dtype(dtype) != a.dtype:
+            return a.astype(dtype)
+        return a
+
+    def __getitem__(self, key):
+        # fast path: any spelling of "rows of column 0" ([:, 0],
+        # [:n, 0], [:, np.int32(0)]) serves from the pre-transferred
+        # root column instead of pulling the full matrix
+        if (
+            isinstance(key, tuple)
+            and len(key) == 2
+            and isinstance(key[0], slice)
+            and not isinstance(key[1], slice)
+            and np.ndim(key[1]) == 0
+            and int(key[1]) == 0
+        ):
+            return self._d_root[key[0]]
+        return self._materialize()[key]
 
 
 class TpuSpfSolver:
@@ -308,10 +364,18 @@ class TpuSpfSolver:
             return tight_nodes(csr.num_nodes)
         return csr.padded_nodes
 
-    def _solve_dist(self, csr, roots: np.ndarray) -> np.ndarray:
+    def _dispatch(self, csr) -> tuple[str, dict, bool]:
+        """Shared dispatch state for every batched-solve entry point:
+        (table kind, device array set, has_overloads)."""
         table = self._pick_table(csr)
         dev = self._device_arrays(csr, table)
         has_over = bool(csr.node_overloaded.any())
+        return table, dev, has_over
+
+    def _solve_dist(
+        self, csr, roots: np.ndarray, _dispatched: tuple | None = None
+    ) -> np.ndarray:
+        table, dev, has_over = _dispatched or self._dispatch(csr)
         if table == "split":
             return batched_sssp_split(
                 dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
@@ -400,8 +464,10 @@ class TpuSpfSolver:
         """Compute distances + the ECMP first-hop matrix for my_node's
         RIB; returns (csr, dist, fh, neighbor_ids, lfa) — lfa is the
         [N, Vp] loop-free-alternate matrix or None when enable_lfa is
-        off — or None if my_node is not in the topology. dist/fh/lfa
-        are host numpy.
+        off — or None if my_node is not in the topology. fh/lfa are
+        host numpy; dist is host numpy on the native/dense/edge paths
+        and a `_LazyDist` on the split path (root column pre-fetched,
+        full [Vp, B] matrix transferred only if indexed/np.asarray'd).
 
         Two interchangeable engines (identical results, tested):
           * native C++ radix-heap Dijkstra + first-hop DAG propagation —
@@ -454,8 +520,32 @@ class TpuSpfSolver:
 
         roots = np.full(b, my_id, dtype=np.int32)  # padding repeats the root
         roots[1 : 1 + n] = nbr_ids
+
+        table, dev, has_over = self._dispatch(csr)
+        if table == "split":
+            # fused single-dispatch path with packed outputs: through the
+            # axon tunnel this is the difference between ~0.8 MB and
+            # ~16 MB of device→host traffic per rebuild (see
+            # ops.spf_split.batched_sssp_split_rib)
+            vp = dev["vp"]
+            with profiling.annotate("spf:batched_solve"):
+                dist_dev, packed = batched_sssp_split_rib(
+                    dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                    dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"],
+                    dev["over"], jnp.asarray(roots),
+                    jnp.asarray(nbr_metric), jnp.asarray(nbr_ids_p),
+                    jnp.asarray(nbr_over), jnp.int32(my_id),
+                    has_overloads=has_over,
+                    with_lfa=self.enable_lfa,
+                )
+                buf = np.asarray(packed)
+            d_root, fh, lfa = unpack_rib_buffer(buf, vp, b, self.enable_lfa)
+            return csr, _LazyDist(dist_dev, d_root), fh, nbr_ids, lfa
+
         with profiling.annotate("spf:batched_solve"):
-            dist = self._solve_dist(csr, roots)
+            dist = self._solve_dist(
+                csr, roots, _dispatched=(table, dev, has_over)
+            )
         fh = np.asarray(
             first_hop_matrix(
                 dist,

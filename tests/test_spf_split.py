@@ -65,9 +65,10 @@ def test_split_matches_dense_er(n, deg, mw):
     np.testing.assert_array_equal(ref, got)
 
 
-def test_split_matches_dense_overloads():
+@pytest.mark.parametrize("n", [800, 2000])  # 2000 → vp=2048: GS chunks on
+def test_split_matches_dense_overloads(n):
     es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
-        800, avg_degree=6, seed=5, max_metric=32
+        n, avg_degree=6, seed=5, max_metric=32
     )
     rng = np.random.default_rng(7)
     over = np.zeros(vp, bool)
@@ -172,3 +173,45 @@ def test_tight_nodes_and_width_picker():
     indeg = np.full(1000, 4)
     indeg[0] = 4096
     assert pick_base_width(indeg) <= 8
+
+
+def test_fused_rib_path_matches_dense_and_lazy_dist():
+    """batched_sssp_split_rib (fused solve + packed d_root/fh/lfa) must
+    produce byte-identical results to the unfused dense-kernel path, and
+    _LazyDist must serve every spelling of the root column without a
+    full materialization."""
+    from openr_tpu.decision.spf_backend import TpuSpfSolver, _LazyDist
+
+    ls, ps, csr = topogen.erdos_renyi_lsdb(
+        220, avg_degree=6, seed=7, max_metric=64
+    )
+    n = csr.num_nodes
+    for lfa in (False, True):
+        a = TpuSpfSolver(native_rib="off", enable_lfa=lfa)  # fused split
+        b = TpuSpfSolver(
+            native_rib="off", kernel_impl="dense", enable_lfa=lfa
+        )
+        sa, sb = a.solve(ls, "node-0"), b.solve(ls, "node-0")
+        assert isinstance(sa[1], _LazyDist)
+        # root column fast path: several spellings, no materialization
+        assert sa[1]._np is None
+        np.testing.assert_array_equal(
+            sa[1][:, 0][:n], np.asarray(sb[1])[:n, 0]
+        )
+        np.testing.assert_array_equal(
+            sa[1][:n, 0], np.asarray(sb[1])[:n, 0]
+        )
+        np.testing.assert_array_equal(
+            sa[1][:, np.int32(0)][:n], np.asarray(sb[1])[:n, 0]
+        )
+        assert sa[1]._np is None, "root-column reads must not transfer"
+        # full materialization agrees
+        np.testing.assert_array_equal(
+            np.asarray(sa[1])[:n], np.asarray(sb[1])[:n]
+        )
+        np.testing.assert_array_equal(sa[2][:, :n], sb[2][:, :n])
+        if lfa:
+            np.testing.assert_array_equal(sa[4][:, :n], sb[4][:, :n])
+        assert a.compute_routes(ls, ps, "node-0") == b.compute_routes(
+            ls, ps, "node-0"
+        )
