@@ -34,6 +34,7 @@ INF_METRIC = DIST_INF
 
 # process-wide monotonic CsrGraph version counter (anchors patch journals)
 _csr_version = itertools.count(1)
+_PS_LINEAGE = itertools.count(1)
 
 
 @dataclass(frozen=True)
@@ -481,6 +482,12 @@ class PrefixState:
         # the view on a throwaway copy every time.
         self._rev = 0
         self._view_cell: list = [None]
+        # lineage id: distinguishes independent PrefixState instances
+        # whose per-instance _rev counters could coincide. Snapshots
+        # (copy-style constructors) inherit it, so within one lineage
+        # the solver_view gen is content-stable; across instances it
+        # can never collide.
+        self._lineage = next(_PS_LINEAGE)
 
     def update_prefix_db(self, db: PrefixDatabase) -> set[IpPrefix]:
         """Apply a node's prefix advertisement; returns changed prefixes."""
@@ -506,6 +513,7 @@ class PrefixState:
         snap._entries = {p: dict(per) for p, per in self._entries.items()}
         snap._rev = self._rev
         snap._view_cell = self._view_cell  # shared cell, rev-keyed
+        snap._lineage = self._lineage  # same lineage: gen stays stable
         return snap
 
     def solver_view(self, name_to_id: dict, base_version: int):
@@ -522,9 +530,14 @@ class PrefixState:
         rebuilds skip the O(P) classification entirely.
 
         Returns (plain_prefixes, plain_nodes, plain_entries,
-        orig_ids [P] int64, complex_items).
+        orig_ids [P] int64, complex_items, gen) — `gen` is a generation
+        token unique to (instance lineage, prefix rev, topology base):
+        within one PrefixState lineage it changes iff the view could,
+        and it can never collide across independent instances (the
+        lineage id), so cross-rebuild caches may key row indices into
+        the plain arrays on it.
         """
-        key = (self._rev, base_version)
+        key = (self._lineage, self._rev, base_version)
         cached = self._view_cell[0]
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -560,6 +573,7 @@ class PrefixState:
             plain_e,
             np.asarray(orig, dtype=np.int64),
             complex_items,
+            key,
         )
         self._view_cell[0] = (key, data)
         return data

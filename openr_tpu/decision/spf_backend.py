@@ -184,6 +184,9 @@ class TpuSpfSolver:
         # cap covers one root by default, and compute_fleet_ribs raises
         # it durably to its root count (reclaim via trim_caches())
         self._mpls_cache: dict = {}
+        # cross-rebuild unicast RibEntry cache, same fingerprint scheme
+        # (see the plain-prefix section of _assemble_routes)
+        self._uni_cache: dict = {}
         self._mpls_fingerprint_cap = 8
 
     def _device_arrays(self, csr, want: str):
@@ -332,6 +335,8 @@ class TpuSpfSolver:
         self._mpls_fingerprint_cap = fingerprint_cap
         while len(self._mpls_cache) > fingerprint_cap:
             self._mpls_cache.pop(next(iter(self._mpls_cache)))
+        while len(self._uni_cache) > fingerprint_cap:
+            self._uni_cache.pop(next(iter(self._uni_cache)))
 
     def _pick_table(self, csr) -> str:
         """Which table set the batched solve uses for this topology.
@@ -630,9 +635,13 @@ class TpuSpfSolver:
         # igp) classes — in a fat-tree thousands of prefixes collapse to
         # a handful of classes. The general per-prefix loop below keeps
         # every other case (anycast, UCMP, KSP, min_nexthop, LFA).
-        plain_p, plain_n, plain_e, orig, complex_items = ps.solver_view(
-            csr.name_to_id, csr.base_version
+        plain_p, plain_n, plain_e, orig, complex_items, view_gen = (
+            ps.solver_view(csr.name_to_id, csr.base_version)
         )
+        # fingerprint for every cross-rebuild assembly cache: my own
+        # adjacency slot details (interface names, min-metric parallel
+        # links), which the fh column alone can't see
+        slot_gen = (ls.area, tuple(tuple(s) for s in slot_cache))
         if len(plain_p) and lfa is None:
             reach = (
                 (d_root[orig] < INF_DIST) & fh_any[orig] & (orig != my_id)
@@ -642,25 +651,50 @@ class TpuSpfSolver:
             cls = dest_cls[orig[idxs]]  # shared per-node classification
             ucls, uidx = np.unique(cls, return_index=True)
             class_nhs = {}
-            for c, u in zip(ucls, uidx):
-                i = idxs[int(u)]
-                class_nhs[int(c)] = self._mk_nexthops_union(
+            for c, u in zip(ucls.tolist(), uidx.tolist()):
+                i = idxs[u]
+                class_nhs[c] = self._mk_nexthops_union(
                     slot_cache, fh[:, orig[i]], int(igp[i]), ls.area
                 )
+            # cross-rebuild RibEntry cache (same shape as the MPLS entry
+            # cache below): under churn most plain prefixes keep the
+            # same (first-hop set, igp) class, and the frozen RibEntry
+            # can be reused as-is — which also lets the Decision/Fib
+            # diffs skip field-by-field equality via identity. Keyed by
+            # (view row, class token): the view gen pins row meaning,
+            # the token pins fh bits + igp, the fingerprint pins slots.
+            uni_cache = self._uni_cache.pop(slot_gen, None) or {}
+            self._uni_cache[slot_gen] = uni_cache
+            while len(self._uni_cache) > self._mpls_fingerprint_cap:
+                self._uni_cache.pop(next(iter(self._uni_cache)))
+            if uni_cache.get("gen") != view_gen:
+                uni_cache.clear()
+                uni_cache["gen"] = view_gen
+            elif len(uni_cache) > max(8192, 4 * len(plain_p)):
+                uni_cache.clear()
+                uni_cache["gen"] = view_gen
             unicast = rdb.unicast_routes
-            for j, i in enumerate(idxs):
-                nhs = class_nhs[int(cls[j])]
+            cls_l = cls.tolist()
+            igp_l = igp[idxs].tolist()
+            for j, i in enumerate(idxs.tolist()):
+                c = cls_l[j]
+                nhs = class_nhs[c]
                 if not nhs:
                     continue
-                p = plain_p[i]
-                unicast[p] = RibEntry(
-                    prefix=p,
-                    nexthops=nhs,
-                    best_node=plain_n[i],
-                    best_nodes=(plain_n[i],),
-                    best_entry=plain_e[i],
-                    igp_cost=int(igp[i]),
-                )
+                key = (i, dest_tokens[c])
+                e = uni_cache.get(key)
+                if e is None:
+                    p = plain_p[i]
+                    e = RibEntry(
+                        prefix=p,
+                        nexthops=nhs,
+                        best_node=plain_n[i],
+                        best_nodes=(plain_n[i],),
+                        best_entry=plain_e[i],
+                        igp_cost=igp_l[j],
+                    )
+                    uni_cache[key] = e
+                unicast[e.prefix] = e
         elif len(plain_p):
             # LFA backups are per-target, not per-class — use the
             # general loop for everything when LFA is enabled
@@ -748,10 +782,8 @@ class TpuSpfSolver:
         # cross-rebuild cache: under churn most nodes keep the same
         # (first-hop set, igp), so the per-node SWAP/PHP NextHop
         # construction — the single hottest host loop in a steady-state
-        # rebuild — is skipped for every unchanged destination. The slot
-        # fingerprint keys my own adjacency details (interface names,
-        # min-metric parallel links), which the fh column alone can't see.
-        slot_gen = (ls.area, tuple(tuple(s) for s in slot_cache))
+        # rebuild — is skipped for every unchanged destination. Keyed by
+        # the shared `slot_gen` fingerprint computed above.
         # re-insert to refresh the fingerprint's LRU position
         mpls_cache = self._mpls_cache.pop(slot_gen, None) or {}
         self._mpls_cache[slot_gen] = mpls_cache

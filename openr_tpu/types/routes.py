@@ -97,13 +97,19 @@ def diff_route_dbs(old: RouteDatabase, new: RouteDatabase) -> RouteUpdate:
     """
     upd = RouteUpdate()
     for prefix, entry in new.unicast_routes.items():
-        if old.unicast_routes.get(prefix) != entry:
+        # identity first: the solver's cross-rebuild entry caches hand
+        # back the same frozen object for unchanged routes, making the
+        # steady-state diff a pointer compare instead of a
+        # field-by-field dataclass equality over the nexthop tuples
+        prev = old.unicast_routes.get(prefix)
+        if prev is not entry and prev != entry:
             upd.unicast_to_update[prefix] = entry
     for prefix in old.unicast_routes:
         if prefix not in new.unicast_routes:
             upd.unicast_to_delete.append(prefix)
     for label, mentry in new.mpls_routes.items():
-        if old.mpls_routes.get(label) != mentry:
+        prev_m = old.mpls_routes.get(label)
+        if prev_m is not mentry and prev_m != mentry:
             upd.mpls_to_update[label] = mentry
     for label in old.mpls_routes:
         if label not in new.mpls_routes:

@@ -215,3 +215,39 @@ def test_fused_rib_path_matches_dense_and_lazy_dist():
         assert a.compute_routes(ls, ps, "node-0") == b.compute_routes(
             ls, ps, "node-0"
         )
+
+
+def test_uni_cache_not_fooled_by_parallel_prefix_states():
+    """Two independent PrefixState instances can reach the same _rev with
+    different prefix contents; a shared solver's cross-rebuild unicast
+    cache must not serve one state's RibEntrys for the other (lineage id
+    in the solver_view gen)."""
+    from openr_tpu.decision.linkstate import PrefixState
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+
+    ls, ps_a, csr = topogen.erdos_renyi_lsdb(
+        64, avg_degree=4, seed=11, max_metric=16
+    )
+
+    def mk_ps(tag):
+        ps = PrefixState()
+        for i, name in enumerate(csr.node_names):
+            ps.update_prefix_db(
+                PrefixDatabase(
+                    this_node_name=name,
+                    prefix_entries=(
+                        PrefixEntry(prefix=f"10.{tag}.{i}.0/24"),
+                    ),
+                )
+            )
+        return ps
+
+    a, b = mk_ps(1), mk_ps(2)
+    assert a._rev == b._rev  # the collision the lineage id must break
+    solver = TpuSpfSolver(native_rib="off")
+    ra = solver.compute_routes(ls, a, "node-0")
+    rb = solver.compute_routes(ls, b, "node-0")
+    assert all(str(p).startswith("10.1.") for p in ra.unicast_routes)
+    assert all(str(p).startswith("10.2.") for p in rb.unicast_routes)
+    assert len(ra.unicast_routes) == len(rb.unicast_routes) > 0
