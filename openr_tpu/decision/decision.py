@@ -15,6 +15,7 @@ flooding/RPC latency is never blocked behind a solve.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from dataclasses import replace
@@ -34,10 +35,22 @@ from openr_tpu.types.routes import (
     RouteUpdateType,
     diff_route_dbs,
 )
-from openr_tpu.types.serde import from_wire
-from openr_tpu.types.topology import AdjacencyDatabase, PrefixDatabase
+from openr_tpu.types.serde import decoder_for, from_wire
+from openr_tpu.types.topology import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+)
 
 log = logging.getLogger(__name__)
+
+_ADJ_DEC = decoder_for(Adjacency)
+_ADJDB_DEC = decoder_for(AdjacencyDatabase)
+# _adj_reuse bound: entries hold the raw dicts + Adjacency tuple of one
+# node's adjacency list (~10 KB at degree 32), and a tombstone racing a
+# threaded decode can strand an entry (no future expiry event), so the
+# cache is LRU-capped rather than trusted to drain
+_ADJ_REUSE_CAP = 4096
 
 
 def merge_area_ribs(
@@ -140,6 +153,17 @@ class Decision(OpenrModule):
         # flapping key instead of one per publication, off the per-pub
         # path (config-5 churn measured this as the top host cost)
         self._pending_kvs: dict[tuple[str, str], Value | None] = {}
+        # churn decode cache: (area, adj key) → (raw adjacency dicts,
+        # decoded Adjacency tuple) of the last accepted version. A flap
+        # re-sends the node's WHOLE AdjacencyDatabase with one metric
+        # changed; comparing raw dicts (C-speed) and reusing the
+        # unchanged Adjacency objects skips ~all dataclass construction
+        # — and the reused identities make LinkState's old==new /
+        # metric-delta comparisons short-circuit too. Entries are
+        # per-node (bounded) and dropped on key expiry. Thread-safety:
+        # values are replaced, never mutated; a lost update between the
+        # decode thread and the event loop just costs one fresh decode.
+        self._adj_reuse: dict[tuple[str, str], tuple[list, tuple]] = {}
         dcfg = config.node.decision
         backend = solver or ("tpu" if dcfg.use_tpu_solver else "cpu")
         self.backend = backend
@@ -273,10 +297,44 @@ class Decision(OpenrModule):
             return parsed[0], PrefixDatabase
         return None, None
 
+    def _decode_value(self, area: str, key: str, val: Value, schema):
+        """Decode one publication value; AdjacencyDatabase goes through
+        the churn reuse cache (see _adj_reuse)."""
+        if schema is not AdjacencyDatabase:
+            return from_wire(val.value, schema)
+        raw = json.loads(val.value)
+        raws = raw.pop("adjacencies", None) or []
+        prev = self._adj_reuse.get((area, key))
+        if prev is not None:
+            prev_raws, prev_objs = prev
+            n = len(prev_raws)
+            adjs = tuple(
+                prev_objs[i]
+                if i < n and r == prev_raws[i]
+                else _ADJ_DEC(r)
+                for i, r in enumerate(raws)
+            )
+        else:
+            adjs = tuple(_ADJ_DEC(r) for r in raws)
+        # non-adjacency fields go through the compiled schema decoder —
+        # one source of truth, so fields added to AdjacencyDatabase
+        # later are never silently dropped on this path
+        db = replace(_ADJDB_DEC(raw), adjacencies=adjs)
+        cache = self._adj_reuse
+        cache.pop((area, key), None)  # refresh LRU position
+        cache[(area, key)] = (raws, adjs)
+        while len(cache) > _ADJ_REUSE_CAP:
+            try:
+                cache.pop(next(iter(cache)), None)
+            except (StopIteration, RuntimeError):
+                break  # lost an eviction race with the other thread
+        return db
+
     def _decode_batch(self, batch: dict) -> dict:
         """Pure serde decode of a pending-kv batch (thread-safe: touches
-        no Decision state). Keyed by (area, key, id(value)) so a value
-        superseded between capture and apply is never misapplied."""
+        no Decision state beyond the replace-only _adj_reuse cache).
+        Keyed by (area, key, id(value)) so a value superseded between
+        capture and apply is never misapplied."""
         out = {}
         for (area, key), val in batch.items():
             if val is None:
@@ -285,7 +343,9 @@ class Decision(OpenrModule):
             if schema is None:
                 continue
             try:
-                out[(area, key, id(val))] = from_wire(val.value, schema)
+                out[(area, key, id(val))] = self._decode_value(
+                    area, key, val, schema
+                )
             except Exception:  # noqa: BLE001 — fall to _apply_key's path
                 continue
         return out
@@ -308,7 +368,7 @@ class Decision(OpenrModule):
         if schema is None:
             return False
         try:
-            db = from_wire(val.value, schema)
+            db = self._decode_value(ls.area, key, val, schema)
         except Exception:  # noqa: BLE001 — corrupt key: ignore
             log.warning("%s: bad db in key %s", self.name, key)
             return False
@@ -319,6 +379,7 @@ class Decision(OpenrModule):
     def _expire_key(self, ls: LinkState, ps: PrefixState, key: str) -> bool:
         node = C.parse_adj_key(key)
         if node is not None:
+            self._adj_reuse.pop((ls.area, key), None)
             return ls.delete_adjacency_db(node)
         parsed = C.parse_prefix_key(key)
         if parsed is not None:

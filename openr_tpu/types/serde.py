@@ -308,3 +308,12 @@ def from_wire(data: bytes | str, cls: Type[T]) -> T:
     """Deserialize canonical JSON bytes into a schema dataclass."""
     raw = json.loads(data)
     return _decode(raw, cls)
+
+
+def decoder_for(cls: Type[T]):
+    """The compiled raw→object decoder closure for `cls` (the same one
+    `from_wire` dispatches through). Exposed for callers that decode
+    many sibling objects from pre-parsed JSON and want to skip the
+    per-call registry lookup — e.g. Decision's churn-path adjacency
+    decode, which reuses unchanged sub-objects across versions."""
+    return _decoder(cls)

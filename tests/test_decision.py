@@ -207,3 +207,38 @@ def test_local_prefix_not_programmed():
         await d.stop()
 
     run(body())
+
+
+def test_adj_reuse_decode_equals_from_wire():
+    """The churn-path adjacency decode (raw-dict reuse cache) must be
+    byte-equivalent to plain from_wire, reuse unchanged Adjacency
+    objects across versions, and decode changed ones fresh."""
+    import dataclasses
+
+    from openr_tpu.types.serde import from_wire
+    from openr_tpu.types.topology import AdjacencyDatabase
+
+    d, _pubs, _routes = mk_decision()
+    adj_dbs, _ = topogen.ring(6)
+    db = adj_dbs[0]
+    key = adj_key(db.this_node_name)
+    v1 = Value(version=1, originator_id="x", value=to_wire(db)).with_hash()
+    got1 = d._decode_value(DEFAULT_AREA, key, v1, AdjacencyDatabase)
+    assert got1 == from_wire(v1.value, AdjacencyDatabase)
+
+    # flap one metric: the other adjacency object must be REUSED
+    adjs = list(db.adjacencies)
+    adjs[0] = dataclasses.replace(adjs[0], metric=77)
+    db2 = dataclasses.replace(db, adjacencies=tuple(adjs))
+    v2 = Value(version=2, originator_id="x", value=to_wire(db2)).with_hash()
+    got2 = d._decode_value(DEFAULT_AREA, key, v2, AdjacencyDatabase)
+    assert got2 == from_wire(v2.value, AdjacencyDatabase)
+    assert got2.adjacencies[0].metric == 77
+    assert got2.adjacencies[1] is got1.adjacencies[1]  # reused identity
+
+    # expiry drops the cache entry
+    ls, ps = d._get_area(DEFAULT_AREA)
+    ls.update_adjacency_db(got2)
+    assert (DEFAULT_AREA, key) in d._adj_reuse
+    d._expire_key(ls, ps, key)
+    assert (DEFAULT_AREA, key) not in d._adj_reuse
