@@ -185,6 +185,8 @@ def _metric_only_delta(
         return None
     delta: list[Adjacency] = []
     for oa, na in zip(old.adjacencies, new.adjacencies):
+        if oa is na:  # Decision's decode cache reuses unchanged objects
+            continue
         if (
             oa.other_node_name != na.other_node_name
             or oa.if_name != na.if_name

@@ -54,6 +54,17 @@ from openr_tpu.types.network import (
 from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
 
 
+def _class_groups(cls_arr: np.ndarray):
+    """Index groups of equal values in `cls_arr` (stable order): yields
+    int arrays of positions. Shared by the unicast and MPLS class-dict
+    sections."""
+    if not len(cls_arr):
+        return ()
+    order = np.argsort(cls_arr, kind="stable")
+    bounds = np.nonzero(np.diff(cls_arr[order]))[0] + 1
+    return np.split(order, bounds)
+
+
 def _dest_classes(fh: np.ndarray, d_root: np.ndarray, n_live: int):
     """(class id per live node, content token per class) for the
     (first-hop column, igp) equivalence relation.
@@ -187,6 +198,8 @@ class TpuSpfSolver:
         # cross-rebuild unicast RibEntry cache, same fingerprint scheme
         # (see the plain-prefix section of _assemble_routes)
         self._uni_cache: dict = {}
+        # class-level {label: RibMplsEntry} sub-dicts (MPLS section)
+        self._mpls_cls_cache: dict = {}
         self._mpls_fingerprint_cap = 8
 
     def _device_arrays(self, csr, want: str):
@@ -337,6 +350,8 @@ class TpuSpfSolver:
             self._mpls_cache.pop(next(iter(self._mpls_cache)))
         while len(self._uni_cache) > fingerprint_cap:
             self._uni_cache.pop(next(iter(self._uni_cache)))
+        while len(self._mpls_cls_cache) > fingerprint_cap:
+            self._mpls_cls_cache.pop(next(iter(self._mpls_cls_cache)))
 
     def _pick_table(self, csr) -> str:
         """Which table set the batched solve uses for this topology.
@@ -656,45 +671,69 @@ class TpuSpfSolver:
                 class_nhs[c] = self._mk_nexthops_union(
                     slot_cache, fh[:, orig[i]], int(igp[i]), ls.area
                 )
-            # cross-rebuild RibEntry cache (same shape as the MPLS entry
-            # cache below): under churn most plain prefixes keep the
-            # same (first-hop set, igp) class, and the frozen RibEntry
-            # can be reused as-is — which also lets the Decision/Fib
-            # diffs skip field-by-field equality via identity. Keyed by
-            # (view row, class token): the view gen pins row meaning,
-            # the token pins fh bits + igp, the fingerprint pins slots.
-            uni_cache = self._uni_cache.pop(slot_gen, None) or {}
-            self._uni_cache[slot_gen] = uni_cache
+            # cross-rebuild RibEntry caches (same shape as the MPLS
+            # entry cache below): under churn most plain prefixes keep
+            # the same (first-hop set, igp) class, and the frozen
+            # RibEntry can be reused as-is — which also lets the
+            # Decision/Fib diffs skip field-by-field equality via
+            # identity. Two levels, both scoped to the slot fingerprint
+            # and the solver_view generation:
+            #   entries:    (view row, class token) → RibEntry
+            #   classdicts: (token, membership fp) → {prefix: RibEntry}
+            # The class-level dict makes an unchanged class ONE C-speed
+            # dict.update instead of a per-prefix python loop — a warm
+            # 100k-prefix rebuild collapses to a handful of updates.
+            cell = self._uni_cache.pop(slot_gen, None)
+            if cell is None or cell.get("gen") != view_gen:
+                cell = {"gen": view_gen, "entries": {}, "classdicts": {}}
+            self._uni_cache[slot_gen] = cell
             while len(self._uni_cache) > self._mpls_fingerprint_cap:
                 self._uni_cache.pop(next(iter(self._uni_cache)))
-            if uni_cache.get("gen") != view_gen:
-                uni_cache.clear()
-                uni_cache["gen"] = view_gen
-            elif len(uni_cache) > max(8192, 4 * len(plain_p)):
-                uni_cache.clear()
-                uni_cache["gen"] = view_gen
+            entries = cell["entries"]
+            classdicts = cell["classdicts"]
+            if len(entries) > max(8192, 4 * len(plain_p)):
+                entries.clear()
+                classdicts.clear()
             unicast = rdb.unicast_routes
-            cls_l = cls.tolist()
-            igp_l = igp[idxs].tolist()
-            for j, i in enumerate(idxs.tolist()):
-                c = cls_l[j]
+            for g in _class_groups(cls):
+                c = int(cls[g[0]])
                 nhs = class_nhs[c]
                 if not nhs:
                     continue
-                key = (i, dest_tokens[c])
-                e = uni_cache.get(key)
-                if e is None:
-                    p = plain_p[i]
-                    e = RibEntry(
-                        prefix=p,
-                        nexthops=nhs,
-                        best_node=plain_n[i],
-                        best_nodes=(plain_n[i],),
-                        best_entry=plain_e[i],
-                        igp_cost=igp_l[j],
-                    )
-                    uni_cache[key] = e
-                unicast[e.prefix] = e
+                rows = idxs[g]
+                token = dest_tokens[c]
+                # membership keyed by the BYTES (not their hash): a
+                # 64-bit hash collision would silently install another
+                # class's routes — unacceptable for a RIB
+                gkey = (token, rows.tobytes())
+                sub = classdicts.get(gkey)
+                if sub is None:
+                    sub = {}
+                    igp_c = int(igp[rows[0]])
+                    for i in rows.tolist():
+                        key = (i, token)
+                        e = entries.get(key)
+                        if e is None:
+                            p = plain_p[i]
+                            e = RibEntry(
+                                prefix=p,
+                                nexthops=nhs,
+                                best_node=plain_n[i],
+                                best_nodes=(plain_n[i],),
+                                best_entry=plain_e[i],
+                                igp_cost=igp_c,
+                            )
+                            entries[key] = e
+                        sub[e.prefix] = e
+                    # bound by TOTAL cached route objects, not key
+                    # count: under churn every rebuild mints new tokens
+                    # and each stale key pins a whole sub-dict
+                    cell["cd_total"] = cell.get("cd_total", 0) + len(sub)
+                    if cell["cd_total"] > 4 * max(len(plain_p), 4096):
+                        classdicts.clear()
+                        cell["cd_total"] = len(sub)
+                    classdicts[gkey] = sub
+                unicast.update(sub)
         elif len(plain_p):
             # LFA backups are per-target, not per-class — use the
             # general loop for everything when LFA is enabled
@@ -812,37 +851,65 @@ class TpuSpfSolver:
         )
         sel = np.nonzero(elig)[0]
         mpls_routes = rdb.mpls_routes
-        for j in range(len(sel)):
-            i = int(sel[j])
-            node = names[i]
-            label = int(labels_v[i])
-            igp = int(d_root[i])
-            key = (label, node, dest_tokens[dest_cls[i]], igp)
-            entry = mpls_cache.get(key)
-            if entry is None:
-                base = mk_nexthops_cached(np.array([i]), igp)
-                nhs = tuple(
-                    NextHop(
-                        address=nh.address,
-                        if_name=nh.if_name,
-                        metric=nh.metric,
-                        neighbor_node=nh.neighbor_node,
-                        area=nh.area,
-                        mpls_action=(
-                            MplsAction(action=MplsActionType.PHP)
-                            if nh.neighbor_node == node
-                            else MplsAction(
-                                action=MplsActionType.SWAP, swap_label=label
+        # class-level sub-dict reuse, mirroring the unicast path: a
+        # destination class whose membership, labels, and (fh, igp)
+        # token are unchanged since a previous rebuild is ONE dict
+        # update. base_version is in the key because rows are node IDS
+        # (the name↔id interning changes with the topology base).
+        mcell = self._mpls_cls_cache.pop(slot_gen, None) or {
+            "groups": {}, "total": 0
+        }
+        self._mpls_cls_cache[slot_gen] = mcell
+        while len(self._mpls_cls_cache) > self._mpls_fingerprint_cap:
+            self._mpls_cls_cache.pop(next(iter(self._mpls_cls_cache)))
+        mcls = mcell["groups"]
+        cls_sel = dest_cls[sel]
+        for g in _class_groups(cls_sel):
+            rows = sel[g]
+            token = dest_tokens[int(cls_sel[g[0]])]
+            lab = labels_v[rows]
+            # bytes, not hashes, for the same reason as the unicast path
+            gkey = (csr.base_version, token, rows.tobytes(), lab.tobytes())
+            sub = mcls.get(gkey)
+            if sub is None:
+                sub = {}
+                igp = int(d_root[rows[0]])
+                for i in rows.tolist():
+                    node = names[i]
+                    label = int(labels_v[i])
+                    key = (label, node, token, igp)
+                    entry = mpls_cache.get(key)
+                    if entry is None:
+                        base = mk_nexthops_cached(np.array([i]), igp)
+                        nhs = tuple(
+                            NextHop(
+                                address=nh.address,
+                                if_name=nh.if_name,
+                                metric=nh.metric,
+                                neighbor_node=nh.neighbor_node,
+                                area=nh.area,
+                                mpls_action=(
+                                    MplsAction(action=MplsActionType.PHP)
+                                    if nh.neighbor_node == node
+                                    else MplsAction(
+                                        action=MplsActionType.SWAP,
+                                        swap_label=label,
+                                    )
+                                ),
                             )
-                        ),
-                    )
-                    for nh in base
-                )
-                if not nhs:
-                    continue
-                entry = RibMplsEntry(label=label, nexthops=nhs)
-                mpls_cache[key] = entry
-            mpls_routes[label] = entry
+                            for nh in base
+                        )
+                        if not nhs:
+                            continue
+                        entry = RibMplsEntry(label=label, nexthops=nhs)
+                        mpls_cache[key] = entry
+                    sub[label] = entry
+                mcell["total"] += len(sub)
+                if mcell["total"] > 4 * max(n_live, 4096):
+                    mcls.clear()
+                    mcell["total"] = len(sub)
+                mcls[gkey] = sub
+            mpls_routes.update(sub)
 
         # ---- MPLS adjacency labels ---------------------------------------
         my_db = ls.adjacency_db(my_node)
