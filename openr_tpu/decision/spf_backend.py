@@ -694,6 +694,7 @@ class TpuSpfSolver:
             if len(entries) > max(8192, 4 * len(plain_p)):
                 entries.clear()
                 classdicts.clear()
+                cell["cd_total"] = 0
             unicast = rdb.unicast_routes
             for g in _class_groups(cls):
                 c = int(cls[g[0]])

@@ -19,4 +19,5 @@ from openr_tpu.parallel.mesh import make_mesh  # noqa: F401
 from openr_tpu.parallel.sharded_spf import (  # noqa: F401
     sharded_sssp,
     sharded_sssp_padded,
+    sharded_sssp_split,
 )

@@ -103,6 +103,115 @@ def sharded_sssp(
     return fn(edge_src, edge_dst, edge_metric, edge_blocked, roots)
 
 
+def _local_split_sssp(
+    base_nbr, base_wgt, ov_ids, ov_nbr, ov_wgt, node_overloaded, roots,
+    vp, has_overloads,
+):
+    """Per-device body for the split-table kernel: this shard owns a
+    contiguous row slice of the base in-neighbor tables and relaxes only
+    those rows each sweep; the full distance matrix is re-assembled with
+    a tiled all_gather over the graph axis (the ICI frontier exchange —
+    rows replace pmin because the row partition is disjoint). The tiny
+    overflow tables are replicated and relaxed identically everywhere."""
+    b = roots.shape[0]
+    dist = jnp.full((vp, b), INF_DIST, jnp.int32)
+    dist = dist.at[roots, jnp.arange(b)].set(0)
+    # the loop carry passes through an all_gather over the graph axis,
+    # whose output is varying-on-graph under check_vma; the initial
+    # carry must carry the same manual-axes type. (Values stay
+    # replicated in fact — every shard computes identical full dist —
+    # so per-shard while_loop trip counts coincide and the in-loop
+    # collectives stay aligned.)
+    dist = jax.lax.pcast(dist, GRAPH_AXIS, to="varying")
+
+    if has_overloads:
+        over_rows = node_overloaded[base_nbr]  # [vp/G, W] src-overloaded
+        over_ov = node_overloaded[ov_nbr]
+
+    def relax(nbr, wgt, over_t, dist):
+        g = dist[nbr]
+        cand = jnp.where(
+            g < INF_DIST, jnp.minimum(g + wgt[:, :, None], INF_DIST), INF_DIST
+        )
+        if has_overloads:
+            cand = jnp.where(
+                over_t[:, :, None] & (nbr[:, :, None] != roots[None, None, :]),
+                INF_DIST,
+                cand,
+            )
+        return cand.min(axis=1)
+
+    def sweep(state):
+        dist, _changed, it = state
+        mine = relax(
+            base_nbr, base_wgt, over_rows if has_overloads else None, dist
+        )
+        full = jax.lax.all_gather(
+            mine, GRAPH_AXIS, axis=0, tiled=True
+        )  # [vp, B]
+        new = jnp.minimum(full, dist)
+        ov_new = relax(ov_nbr, ov_wgt, over_ov if has_overloads else None, dist)
+        new = new.at[ov_ids].min(ov_new)
+        return new, jnp.any(new < dist), it + 1
+
+    def cond(state):
+        _dist, changed, it = state
+        return changed & (it < vp)
+
+    changed0 = jnp.any(dist <= INF_DIST)  # varying True (see _local_sssp)
+    dist, _, _ = jax.lax.while_loop(cond, sweep, (dist, changed0, 0))
+    # dist is replicated in value but varying in type; one identity
+    # pmin proves the replication to check_vma for the P(None, sources)
+    # out_spec
+    return jax.lax.pmin(dist, GRAPH_AXIS)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "has_overloads")
+)
+def sharded_sssp_split(
+    base_nbr: jax.Array,   # [vp, W] — vp must divide by the graph axis
+    base_wgt: jax.Array,
+    ov_ids: jax.Array,     # [Go] (replicated)
+    ov_nbr: jax.Array,     # [Go, Wo]
+    ov_wgt: jax.Array,
+    node_overloaded: jax.Array,  # [vp] bool (replicated)
+    roots: jax.Array,      # [B] — B must divide by the sources axis
+    mesh: Mesh,
+    has_overloads: bool = False,
+) -> jax.Array:
+    """The flagship v3 split-width kernel (ops/spf_split.py), SPMD over a
+    ``sources × graph`` mesh: roots shard over ``sources`` (independent
+    solves), the base in-neighbor table rows shard over ``graph`` (HBM
+    scaling — the tables dominate at 100k nodes), with one tiled
+    all_gather per sweep over ICI. Distances equal the single-device
+    kernel's (tests/test_parallel.py)."""
+    vp = base_nbr.shape[0]
+    g = mesh.shape[GRAPH_AXIS]
+    if vp % g:
+        raise ValueError(f"vp={vp} must divide by graph axis size {g}")
+    fn = jax.shard_map(
+        functools.partial(
+            _local_split_sssp, vp=vp, has_overloads=has_overloads
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(None),
+            P(None, None),
+            P(None, None),
+            P(None),
+            P(SOURCES_AXIS),
+        ),
+        out_specs=P(None, SOURCES_AXIS),
+        check_vma=True,
+    )
+    return fn(
+        base_nbr, base_wgt, ov_ids, ov_nbr, ov_wgt, node_overloaded, roots
+    )
+
+
 def sharded_sssp_padded(
     edge_src,
     edge_dst,
