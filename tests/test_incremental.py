@@ -289,3 +289,77 @@ def test_device_cache_zero_reuploads_under_metric_churn():
     stats = solver.dev_cache_stats
     assert stats["uploads"] == uploads_warm, stats  # zero re-uploads
     assert stats["patches"] >= 4, stats  # every churn step patched
+
+
+def test_randomized_churn_cache_equivalence_property():
+    """Property test for the cross-rebuild assembly caches: a SHARED
+    solver (entry/class-dict/device caches carried across rebuilds)
+    must match the stateless oracle after every step of a random
+    mutation sequence — metric flaps, prefix withdraw/re-add, overload
+    toggles, and adjacency removal/restore."""
+    import dataclasses
+
+    import numpy as np
+
+    from openr_tpu.decision.linkstate import PrefixState
+    from openr_tpu.decision.oracle import (
+        compute_routes as oracle_compute_routes,
+    )
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.types.network import IpPrefix
+    from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+    from openr_tpu.utils import topogen
+
+    adj_dbs, prefix_dbs = topogen.fat_tree(8)  # 80 nodes, rich ECMP
+    ls = fresh_ls(adj_dbs)
+    ps = PrefixState()
+    for pdb in prefix_dbs:
+        ps.update_prefix_db(pdb)
+    rng = np.random.default_rng(99)
+    solver = TpuSpfSolver(native_rib="off")
+    names = [adb.this_node_name for adb in adj_dbs]
+    removed: dict[str, object] = {}
+
+    for step in range(24):
+        op = rng.integers(0, 10)
+        node = names[int(rng.integers(0, len(names)))]
+        db = ls.adjacency_db(node)
+        if op < 5 and db and db.adjacencies:
+            # metric flap (the journal/patch fast path)
+            adjs = list(db.adjacencies)
+            k = int(rng.integers(0, len(adjs)))
+            adjs[k] = dataclasses.replace(
+                adjs[k], metric=int(rng.integers(1, 32))
+            )
+            ls.update_adjacency_db(
+                dataclasses.replace(db, adjacencies=tuple(adjs))
+            )
+        elif op < 7:
+            # prefix withdraw or re-add (solver_view gen transitions)
+            i = int(rng.integers(0, len(names)))
+            pfx = IpPrefix(prefix=f"10.9.{i}.0/24")
+            if rng.integers(0, 2):
+                ps.update_prefix_db(
+                    PrefixDatabase(
+                        this_node_name=names[i],
+                        prefix_entries=(PrefixEntry(prefix=pfx),),
+                    )
+                )
+            else:
+                ps.withdraw(names[i], pfx)
+        elif op < 8 and db:
+            # node overload toggle (structural: full CSR rebuild)
+            ls.update_adjacency_db(
+                dataclasses.replace(db, is_overloaded=not db.is_overloaded)
+            )
+        elif op < 9 and db and node not in removed and node != names[0]:
+            removed[node] = db
+            ls.delete_adjacency_db(node)
+        elif removed:
+            name, db_r = removed.popitem()
+            ls.update_adjacency_db(db_r)
+
+        got = solver.compute_routes(ls, ps, names[0])
+        want = oracle_compute_routes(ls, ps, names[0])
+        assert got.unicast_routes == want.unicast_routes, f"step {step}"
+        assert got.mpls_routes == want.mpls_routes, f"step {step}"
