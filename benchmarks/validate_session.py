@@ -1,0 +1,105 @@
+"""One-shot real-chip validation for the session's kernel work.
+
+Run when the axon tunnel is healthy:  python benchmarks/validate_session.py
+Prints, in order (each flushed as it lands, in case the tunnel dies):
+  1. fused production solve wall p50 at 100k (tpu.solve: GS kernel +
+     packed ~0.8 MB transfer) — the headline quantity;
+  2. pure-kernel p50 via scalar drain (compare: 287 ms pre-GS);
+  3. B=256 all-sources solve (compare: 505.6 ms);
+  4. warm full-RIB p50 (solve + assembly with the entry/class caches);
+  5. in-run oracle spot check (3 roots vs native C++ Dijkstra).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from openr_tpu.decision.spf_backend import TpuSpfSolver
+from openr_tpu.utils.topogen import erdos_renyi_lsdb
+
+
+def p50(fn, n=7, warm=2):
+    for _ in range(warm):
+        fn()
+    vals = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        vals.append(time.perf_counter() - t0)
+    vals.sort()
+    return vals[len(vals) // 2] * 1e3
+
+
+def main() -> None:
+    import jax
+
+    print(f"# device: {jax.devices()[0]}", flush=True)
+    ls, ps, csr = erdos_renyi_lsdb(100_000, avg_degree=20, seed=0, max_metric=64)
+    tpu = TpuSpfSolver(native_rib="off")
+
+    t = p50(lambda: tpu.solve(ls, "node-0"))
+    print(f"1. fused solve wall p50      : {t:8.1f} ms", flush=True)
+
+    import jax.numpy as jnp
+
+    dev = tpu._device_arrays(csr, "split")
+    from openr_tpu.ops.spf_split import batched_sssp_split
+
+    my_id = csr.name_to_id["node-0"]
+    roots = np.full(32, my_id, np.int32)
+
+    def solve_scalar():
+        out = batched_sssp_split(
+            dev["base_nbr"], dev["base_wgt"], dev["ov_ids"], dev["ov_nbr"],
+            dev["ov_wgt"], dev["out_nbr"], dev["over"], jnp.asarray(roots),
+            has_overloads=False,
+        )
+        return float(jnp.asarray(out[0, 0]))
+
+    t = p50(solve_scalar)
+    print(f"2. GS kernel p50 (scalar)    : {t:8.1f} ms  (pre-GS: 287)", flush=True)
+
+    b256 = np.arange(256, dtype=np.int32) % csr.num_nodes
+
+    def solve_b256():
+        d = tpu._solve_dist(csr, b256)
+        return float(np.asarray(d[:, 0]).sum())
+
+    t = p50(solve_b256, n=3, warm=1)
+    print(f"3. B=256 solve p50           : {t:8.1f} ms  (r3s1: 505.6)", flush=True)
+
+    def full_rib():
+        return tpu.compute_routes(ls, ps, "node-0")
+
+    t = p50(full_rib, n=5, warm=2)
+    print(f"4. warm full RIB p50         : {t:8.1f} ms", flush=True)
+
+    # oracle spot check
+    from openr_tpu.ops.native_spf import OutCsr, native_available
+
+    solved = tpu.solve(ls, "node-0")
+    _csr, dist, fh, nbr_ids, _ = solved
+    if native_available():
+        oc = OutCsr.from_arrays(
+            csr.edge_src, csr.edge_dst, csr.edge_metric, csr.padded_nodes
+        )
+        ok = True
+        full = np.asarray(dist)
+        for col, r in enumerate([my_id] + [int(x) for x in nbr_ids[:2]]):
+            ref = oc.dijkstra(r)
+            m = min(len(ref), full.shape[0])
+            ok &= bool((ref[:m] == full[:m, col]).all())
+        print(f"5. oracle (3 roots)          : {'ok' if ok else 'MISMATCH'}",
+              flush=True)
+    else:
+        print("5. oracle: native lib not built", flush=True)
+
+
+if __name__ == "__main__":
+    main()
