@@ -242,6 +242,9 @@ def main() -> None:
                 k: round(float(np.percentile(np.array(v), 50)), 2)
                 for k, v in breakdown.items()
             },
+            # byte-splice decode tiers (decision.py _decode_adj_fast):
+            # "fast" should dominate under single-flap-per-key churn
+            "decode_stats": dict(dec.decode_stats),
             "backend": _backend(),
         },
     }

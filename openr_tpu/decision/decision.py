@@ -17,8 +17,11 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import threading
 import time
 from dataclasses import replace
+
+import numpy as np
 
 from openr_tpu.common import constants as C
 from openr_tpu.common.eventbase import OpenrModule
@@ -35,7 +38,7 @@ from openr_tpu.types.routes import (
     RouteUpdateType,
     diff_route_dbs,
 )
-from openr_tpu.types.serde import decoder_for, from_wire
+from openr_tpu.types.serde import decoder_for, from_wire, to_wire
 from openr_tpu.types.topology import (
     Adjacency,
     AdjacencyDatabase,
@@ -46,11 +49,13 @@ log = logging.getLogger(__name__)
 
 _ADJ_DEC = decoder_for(Adjacency)
 _ADJDB_DEC = decoder_for(AdjacencyDatabase)
-# _adj_reuse bound: entries hold the raw dicts + Adjacency tuple of one
-# node's adjacency list (~10 KB at degree 32), and a tombstone racing a
-# threaded decode can strand an entry (no future expiry event), so the
-# cache is LRU-capped rather than trusted to drain
-_ADJ_REUSE_CAP = 4096
+# _adj_reuse bound: an entry holds one node's wire payload (~3 KB at
+# degree 32), its raw dicts, the decoded Adjacency tuple + db, and two
+# small span arrays — ~25-30 KB total. A tombstone racing a threaded
+# decode can strand an entry (no future expiry event), so the cache is
+# LRU-capped rather than trusted to drain: 2048 × ~30 KB ≈ 60 MB worst
+# case, covering every actively-flapping node of the config-5 bench
+_ADJ_REUSE_CAP = 2048
 
 
 def merge_area_ribs(
@@ -153,17 +158,29 @@ class Decision(OpenrModule):
         # flapping key instead of one per publication, off the per-pub
         # path (config-5 churn measured this as the top host cost)
         self._pending_kvs: dict[tuple[str, str], Value | None] = {}
-        # churn decode cache: (area, adj key) → (raw adjacency dicts,
-        # decoded Adjacency tuple) of the last accepted version. A flap
-        # re-sends the node's WHOLE AdjacencyDatabase with one metric
-        # changed; comparing raw dicts (C-speed) and reusing the
-        # unchanged Adjacency objects skips ~all dataclass construction
-        # — and the reused identities make LinkState's old==new /
-        # metric-delta comparisons short-circuit too. Entries are
-        # per-node (bounded) and dropped on key expiry. Thread-safety:
+        # churn decode cache: (area, adj key) → dict(payload, spans,
+        # raws, adjs, db) of the last accepted version. A flap re-sends
+        # the node's WHOLE AdjacencyDatabase with one metric changed;
+        # two reuse tiers avoid re-decoding it:
+        #   1. byte-span fast path — the common prefix/suffix against
+        #      the cached payload confines the diff to ONE adjacency's
+        #      body span, and only those ~100 bytes are parsed (see
+        #      _decode_adj_fast for the structural-soundness argument);
+        #   2. full parse with raw-dict compare — unchanged Adjacency
+        #      objects are reused by C-speed dict equality.
+        # Reused identities also make LinkState's old==new /
+        # metric-delta comparisons short-circuit. Entries are per-node
+        # (LRU-bounded) and dropped on key expiry. Thread-safety:
         # values are replaced, never mutated; a lost update between the
         # decode thread and the event loop just costs one fresh decode.
-        self._adj_reuse: dict[tuple[str, str], tuple[list, tuple]] = {}
+        self._adj_reuse: dict[tuple[str, str], dict] = {}
+        # observability: byte-splice fast decodes vs full parses vs
+        # payload-identical reuses (exported via bench_churn). Updated
+        # from both the decode worker thread and the event loop, so
+        # increments take the (uncontended) lock — dropped counts would
+        # skew the very tier ratios this exists to report
+        self.decode_stats = {"fast": 0, "full": 0, "same": 0}
+        self._decode_stats_lock = threading.Lock()
         dcfg = config.node.decision
         backend = solver or ("tpu" if dcfg.use_tpu_solver else "cpu")
         self.backend = backend
@@ -297,38 +314,173 @@ class Decision(OpenrModule):
             return parsed[0], PrefixDatabase
         return None, None
 
+    @staticmethod
+    def _adj_spans(payload: bytes, adjs: tuple):
+        """Byte spans (starts, ends int64 arrays) of each adjacency
+        object BODY (interior, without braces) in a canonical
+        AdjacencyDatabase payload, or None when untrustworthy.
+
+        The separator scan counts every b'},{' between the array open
+        and the last b'}],'. Real inter-object separators are always
+        present in the byte stream and fake ones (inside string fields)
+        only ADD to the count, so an exact count of n−1 proves the
+        middle boundaries are the true ones. The two soft anchors — the
+        array head (position-pinned: "adjacencies" sorts first) and the
+        rfind'd tail (a trailing string field could contain b'}],') —
+        mean a span is only PROVEN once its bytes are checked against
+        the parsed adjacency's canonical re-encode; the splice fast
+        path does that lazily for the one span it uses, so full parses
+        don't pay an O(n) re-encode for reuse that may never happen."""
+        n_adjs = len(adjs)
+        head = payload.find(b'"adjacencies":[{')
+        if head < 0 or n_adjs == 0:
+            return None
+        start0 = head + 16  # len(b'"adjacencies":[{')
+        tail = payload.rfind(b"}],")
+        if tail < 0 or tail < start0:
+            return None
+        seps = []
+        p = payload.find(b"},{", start0)
+        while p != -1 and p < tail:
+            seps.append(p)
+            p = payload.find(b"},{", p + 1)
+        if len(seps) != n_adjs - 1:
+            return None
+        starts = np.array([start0] + [s + 3 for s in seps], np.int64)
+        ends = np.array([*seps, tail], np.int64)
+        return starts, ends
+
+    def _decode_adj_fast(self, payload: bytes, prev: dict):
+        """Tier-1 decode: if `payload` differs from the cached previous
+        payload only WITHIN one adjacency's body span, parse just that
+        body and splice it into the cached objects.
+
+        Soundness: cached spans are re-encode-validated object bodies
+        of the previous payload (`_adj_spans`), an invariant this
+        method maintains by validating the replacement body the same
+        way. The common prefix covers everything before the body and
+        the common suffix everything after it, so the new document is
+        byte-identical to the old outside the body; the body re-encode
+        check proves it is a complete canonical adjacency object
+        interior, hence the full parse of the new document would yield
+        exactly the spliced result. Anything unproven returns None →
+        caller does the full parse.
+        """
+        pv = prev["payload"]
+        if payload == pv:  # TTL refresh / idempotent re-publish
+            return prev
+        spans = prev["spans"]
+        if spans is None:
+            return None
+        starts, ends = spans
+        a = np.frombuffer(payload, np.uint8)
+        bb = np.frombuffer(pv, np.uint8)
+        m = min(a.size, bb.size)
+        neq = a[:m] != bb[:m]
+        pre = int(neq.argmax()) if neq.any() else m
+        neqr = a[-m:][::-1] != bb[-m:][::-1]
+        suf = int(neqr.argmax()) if neqr.any() else m
+        suf = min(suf, m - pre)
+        delta = a.size - bb.size
+        # the only span that can contain the diff start
+        i = int(np.searchsorted(starts, pre, side="right")) - 1
+        if i < 0:
+            return None
+        s, e = int(starts[i]), int(ends[i])
+        if pre >= e + 3 or suf < bb.size - e:
+            return None  # diff in framing, or spills past this body
+        proven = prev["proven"]
+        if not proven[i]:
+            # lazy span proof (see _adj_spans): the OLD bytes of this
+            # span must be exactly the canonical encoding of the cached
+            # adjacency i, pinning the span to the true object
+            # location. Checked at most once per span per generation —
+            # the `proven` bitmap carries across splices.
+            if to_wire(prev["adjs"][i]) != b"{%s}" % pv[s:e]:
+                return None
+        body = payload[s : e + delta]
+        try:
+            adj = _ADJ_DEC(json.loads(b"{%s}" % body))
+        except Exception:  # noqa: BLE001 — structural proof failed
+            return None
+        if to_wire(adj) != b"{%s}" % body:
+            return None  # non-canonical body: the span would be unproven
+        adjs = prev["adjs"][:i] + (adj,) + prev["adjs"][i + 1 :]
+        raws = prev["raws"]
+        if raws is not None:
+            raws = list(raws)
+            raws[i] = None  # position decoded without a raw dict
+        if delta:
+            starts = starts.copy()
+            ends = ends.copy()
+            starts[i + 1 :] += delta
+            ends[i:] += delta
+        if not proven[i]:
+            proven = proven.copy()
+            proven[i] = True
+        return {
+            "payload": payload,
+            "spans": (starts, ends),
+            "proven": proven,
+            "raws": raws,
+            "adjs": adjs,
+            "db": replace(prev["db"], adjacencies=adjs),
+        }
+
     def _decode_value(self, area: str, key: str, val: Value, schema):
         """Decode one publication value; AdjacencyDatabase goes through
         the churn reuse cache (see _adj_reuse)."""
         if schema is not AdjacencyDatabase:
             return from_wire(val.value, schema)
-        raw = json.loads(val.value)
-        raws = raw.pop("adjacencies", None) or []
-        prev = self._adj_reuse.get((area, key))
-        if prev is not None:
-            prev_raws, prev_objs = prev
-            n = len(prev_raws)
-            adjs = tuple(
-                prev_objs[i]
-                if i < n and r == prev_raws[i]
-                else _ADJ_DEC(r)
-                for i, r in enumerate(raws)
-            )
-        else:
-            adjs = tuple(_ADJ_DEC(r) for r in raws)
-        # non-adjacency fields go through the compiled schema decoder —
-        # one source of truth, so fields added to AdjacencyDatabase
-        # later are never silently dropped on this path
-        db = replace(_ADJDB_DEC(raw), adjacencies=adjs)
+        payload = val.value
+        if isinstance(payload, str):
+            payload = payload.encode()
         cache = self._adj_reuse
+        prev = cache.get((area, key))
+        entry = None
+        if prev is not None:
+            entry = self._decode_adj_fast(payload, prev)
+        tier = (
+            "full" if entry is None
+            else ("same" if entry is prev else "fast")
+        )
+        with self._decode_stats_lock:
+            self.decode_stats[tier] += 1
+        if entry is None:
+            raw = json.loads(payload)
+            raws = raw.pop("adjacencies", None) or []
+            if prev is not None and prev["raws"] is not None:
+                prev_raws, prev_objs = prev["raws"], prev["adjs"]
+                n = len(prev_raws)
+                adjs = tuple(
+                    prev_objs[i]
+                    if i < n and prev_raws[i] is not None
+                    and r == prev_raws[i]
+                    else _ADJ_DEC(r)
+                    for i, r in enumerate(raws)
+                )
+            else:
+                adjs = tuple(_ADJ_DEC(r) for r in raws)
+            # non-adjacency fields go through the compiled schema
+            # decoder — one source of truth, so fields added to
+            # AdjacencyDatabase later are never silently dropped here
+            db = replace(_ADJDB_DEC(raw), adjacencies=adjs)
+            entry = {
+                "payload": payload,
+                "spans": self._adj_spans(payload, adjs),
+                "proven": np.zeros(len(adjs), bool),
+                "raws": raws,
+                "adjs": adjs,
+                "db": db,
+            }
         cache.pop((area, key), None)  # refresh LRU position
-        cache[(area, key)] = (raws, adjs)
+        cache[(area, key)] = entry
         while len(cache) > _ADJ_REUSE_CAP:
             try:
                 cache.pop(next(iter(cache)), None)
             except (StopIteration, RuntimeError):
                 break  # lost an eviction race with the other thread
-        return db
+        return entry["db"]
 
     def _decode_batch(self, batch: dict) -> dict:
         """Pure serde decode of a pending-kv batch (thread-safe: touches

@@ -242,3 +242,76 @@ def test_adj_reuse_decode_equals_from_wire():
     assert (DEFAULT_AREA, key) in d._adj_reuse
     d._expire_key(ls, ps, key)
     assert (DEFAULT_AREA, key) not in d._adj_reuse
+
+
+def test_adj_byte_splice_decode_property():
+    """The tier-1 byte-splice decode must equal from_wire over random
+    mutation sequences, including adversarial names containing the
+    framing byte sequences, structural changes, and size-changing
+    metric edits."""
+    import dataclasses
+    import random
+
+    from openr_tpu.types.serde import from_wire
+    from openr_tpu.types.topology import Adjacency, AdjacencyDatabase
+
+    d, _pubs, _routes = mk_decision()
+    rng = random.Random(5)
+    names = [
+        "n1", "n2", 'evil"},{"other_node_name":"x', "n}],", "plain",
+        "n{{", "uénicode",
+    ]
+
+    def rand_db(nadj):
+        adjs = tuple(
+            Adjacency(
+                other_node_name=rng.choice(names),
+                if_name=f"if{j}",
+                metric=rng.randrange(1, 5000),
+                rtt_us=rng.randrange(0, 99),
+            )
+            for j in range(nadj)
+        )
+        return AdjacencyDatabase(this_node_name="src", adjacencies=adjs)
+
+    db = rand_db(8)
+    key = adj_key("src")
+    for step in range(120):
+        op = rng.randrange(10)
+        adjs = list(db.adjacencies)
+        if op < 6 and adjs:
+            # metric/rtt edit (arbitrary digit-width change)
+            j = rng.randrange(len(adjs))
+            adjs[j] = dataclasses.replace(
+                adjs[j],
+                metric=rng.randrange(1, 10**rng.randrange(1, 8)),
+                rtt_us=rng.randrange(0, 100),
+            )
+            db = dataclasses.replace(db, adjacencies=tuple(adjs))
+        elif op < 7:
+            # structural: add/remove an adjacency
+            if len(adjs) > 2 and rng.randrange(2):
+                adjs.pop(rng.randrange(len(adjs)))
+            else:
+                adjs.append(
+                    Adjacency(
+                        other_node_name=rng.choice(names),
+                        if_name=f"ifx{step}",
+                        metric=rng.randrange(1, 64),
+                    )
+                )
+            db = dataclasses.replace(db, adjacencies=tuple(adjs))
+        elif op < 8:
+            # non-adjacency field flip (diff lands outside the array)
+            db = dataclasses.replace(
+                db, is_overloaded=not db.is_overloaded,
+                node_label=rng.randrange(0, 1 << 20),
+            )
+        else:
+            db = rand_db(rng.randrange(1, 10))  # wholesale replacement
+        v = Value(
+            version=step + 1, originator_id="src", value=to_wire(db)
+        ).with_hash()
+        got = d._decode_value(DEFAULT_AREA, key, v, AdjacencyDatabase)
+        want = from_wire(v.value, AdjacencyDatabase)
+        assert got == want, f"step {step}: {got} != {want}"
