@@ -632,6 +632,12 @@ class Decision(OpenrModule):
         if self.counters:
             self.counters.increment("decision.spf_runs")
             self.counters.set("decision.spf_ms", self._last_spf_ms)
+            with self._decode_stats_lock:
+                for tier, n in self.decode_stats.items():
+                    self.counters.set(f"decision.decode.{tier}", n)
+            if self._tpu is not None:
+                for k, n in self._tpu.dev_cache_stats.items():
+                    self.counters.set(f"decision.dev_cache.{k}", n)
         first = not self.rib_computed.is_set()
         self.rib = new_rib
         self._last_completed_snapshot_t0 = t0
