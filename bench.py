@@ -95,10 +95,84 @@ def _p50_p99(times: list[float]) -> tuple[float, float]:
     )
 
 
+def _run_tpu_subprocess() -> bool:
+    """Run the TPU measurement in a child process with a hard timeout.
+
+    The axon tunnel can wedge MID-RUN (observed 2026-07-30: it served
+    ~25 min of dispatches and then hung every later call for hours). A
+    hung jax dispatch blocks in C and cannot be interrupted in-process,
+    so the only reliable guard is process isolation — same reasoning as
+    the init probe above. The child is this script with
+    OPENR_BENCH_MODE=measure-tpu; its single JSON line is re-printed
+    verbatim. Returns False (→ caller runs the CPU fallback inline) on
+    timeout or failure.
+    """
+    import subprocess
+
+    timeout_s = int(os.environ.get("OPENR_BENCH_TPU_TIMEOUT", "1500"))
+    env = dict(os.environ)
+    env["OPENR_BENCH_MODE"] = "measure-tpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"# tpu measurement timed out after {timeout_s}s "
+            "(tunnel wedged mid-run?) — falling back to cpu",
+            file=sys.stderr,
+        )
+        return False
+    line = ""
+    parsed: dict = {}
+    for cand in reversed(r.stdout.strip().splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                parsed = {"detail": {"error": "child emitted malformed JSON"}}
+            break
+    if r.returncode == 0 and parsed.get("value") is not None:
+        print(line)
+        return True
+    # surface the best available diagnostic: the child's own JSON error
+    # (its __main__ handler reports exceptions with rc=0, value=null),
+    # else its stderr tail
+    err = r.stderr.strip().splitlines()
+    why = (parsed.get("detail") or {}).get("error") or (
+        err[-1] if err else "no output"
+    )
+    print(
+        f"# tpu measurement failed (rc={r.returncode}): {why}",
+        file=sys.stderr,
+    )
+    return False
+
+
 def main() -> None:
     global WARMUP, ITERS
+    mode = os.environ.get("OPENR_BENCH_MODE", "")
     n_nodes = N_NODES
-    tpu_ok = _probe_default_backend()
+    probe_ok = tpu_run_failed = False
+    if mode == "measure-tpu":
+        tpu_ok = probe_ok = True  # parent already probed; just measure
+    else:
+        assume = os.environ.get("OPENR_BENCH_ASSUME_TPU", "").lower()
+        tpu_ok = probe_ok = (
+            assume in ("1", "true", "yes") or _probe_default_backend()
+        )
+        if tpu_ok:
+            # measure in a subprocess so a mid-run tunnel wedge cannot
+            # hang the driver's bench slot
+            if _run_tpu_subprocess():
+                return
+            tpu_ok = False
+            tpu_run_failed = True
     if not tpu_ok:
         # fall back to cpu so the driver still records a real measurement
         # (flagged in detail.platform) — at reduced scale so the slower
@@ -127,8 +201,10 @@ def main() -> None:
         "nodes": csr.num_nodes,
         "directed_edges": csr.num_edges,
         "prefixes": len(ps.prefixes),
-        "tpu_probe_ok": tpu_ok,
+        "tpu_probe_ok": probe_ok,
     }
+    if tpu_run_failed:
+        detail["tpu_run"] = "failed-or-timed-out (probe was ok)"
 
     # ---- TPU batched engine (v3 split kernel) -------------------------
     # OPENR_BENCH_TRACE=<dir> captures an xprof trace of the timed
