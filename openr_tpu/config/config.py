@@ -92,6 +92,13 @@ class DecisionConfig:
     # in KSP2_ED_ECMP †; BASELINE config 4 exercises k=16; the batched
     # kernel supports k<=16 — validated)
     ksp_paths: int = 2
+    # multi-chip mesh for BATCHED solves (fleet/all-sources shapes):
+    # sources × graph device grid (parallel.make_mesh). 0 = off
+    # (single device). Requires mesh_sources × mesh_graph ≤ available
+    # jax devices; the single-root production rebuild always stays
+    # single-device (latency shape).
+    mesh_sources: int = 0
+    mesh_graph: int = 1
 
 
 @dataclass
@@ -344,6 +351,10 @@ class Config:
         if d.native_rib not in ("auto", "on", "off"):
             raise ConfigError(
                 "decision: native_rib must be auto|on|off"
+            )
+        if d.mesh_sources < 0 or d.mesh_graph < 1:
+            raise ConfigError(
+                "decision: mesh_sources must be >= 0 and mesh_graph >= 1"
             )
         k = n.kvstore
         if k.key_ttl_ms <= 0:

@@ -189,6 +189,13 @@ class Decision(OpenrModule):
             # lazy: the cpu/oracle path must not pay the jax import
             from openr_tpu.decision.spf_backend import TpuSpfSolver
 
+            mesh = None
+            if dcfg.mesh_sources > 0:
+                from openr_tpu.parallel import make_mesh
+
+                mesh = make_mesh(
+                    n_sources=dcfg.mesh_sources, n_graph=dcfg.mesh_graph
+                )
             self._tpu = TpuSpfSolver(
                 use_dense=dcfg.use_dense_kernel,
                 use_pallas=dcfg.use_pallas_kernel,
@@ -196,6 +203,7 @@ class Decision(OpenrModule):
                 ksp_k=dcfg.ksp_paths,
                 kernel_impl=dcfg.spf_kernel,
                 native_rib=dcfg.native_rib,
+                mesh=mesh,
             )
         self.debounce = AsyncDebounce(
             dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes

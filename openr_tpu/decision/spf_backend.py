@@ -17,6 +17,8 @@ path and the test suite asserts RouteDatabase equality between the two.
 
 from __future__ import annotations
 
+import logging
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -52,6 +54,8 @@ from openr_tpu.types.network import (
     sorted_nexthops,
 )
 from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
+
+log = logging.getLogger(__name__)
 
 
 def _class_groups(cls_arr: np.ndarray):
@@ -162,12 +166,21 @@ class TpuSpfSolver:
         ksp_k: int = 2,
         kernel_impl: str = "split",
         native_rib: str = "auto",
+        mesh=None,
     ):
         self.use_dense = use_dense
         self.dense_waste_limit = dense_waste_limit
         self.use_pallas = use_pallas
         self.enable_lfa = enable_lfa
         self.ksp_k = ksp_k
+        # optional jax.sharding.Mesh (parallel.make_mesh): batched
+        # multi-root solves (fleet, all-sources, B=256 shapes) run the
+        # sharded split kernel over it — roots over the `sources` axis,
+        # table rows over `graph` (parallel/sharded_spf.py). The
+        # single-root production rebuild stays single-device: it is a
+        # latency shape, and the fused packed-output path wins there.
+        self.mesh = mesh
+        self._mesh_fallback_warned = False
         # "split" (v3 split-width kernel, default) or "dense" (r2 kernel)
         self.kernel_impl = kernel_impl
         # "auto" | "on" | "off": the native C++ radix-heap solver for the
@@ -397,6 +410,24 @@ class TpuSpfSolver:
     ) -> np.ndarray:
         table, dev, has_over = _dispatched or self._dispatch(csr)
         if table == "split":
+            if self.mesh is not None:
+                if self._mesh_fits(dev, roots):
+                    from openr_tpu.parallel import sharded_sssp_split
+
+                    return sharded_sssp_split(
+                        dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                        dev["ov_nbr"], dev["ov_wgt"], dev["over"],
+                        jnp.asarray(roots), self.mesh,
+                        has_overloads=has_over,
+                    )
+                if not self._mesh_fallback_warned:
+                    self._mesh_fallback_warned = True
+                    log.warning(
+                        "configured mesh %s does not divide solve shape "
+                        "(vp=%d, b=%d) — falling back to single-device "
+                        "(use power-of-two axis sizes)",
+                        dict(self.mesh.shape), dev["vp"], len(roots),
+                    )
             return batched_sssp_split(
                 dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
                 dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"], dev["over"],
@@ -430,6 +461,20 @@ class TpuSpfSolver:
             dev["blocked"],
             jnp.asarray(roots),
             csr.padded_nodes,
+        )
+
+    def _mesh_fits(self, dev: dict, roots: np.ndarray) -> bool:
+        """Whether this (tables, roots) shape shards evenly over the
+        configured mesh — table rows must divide by the graph axis and
+        the root batch by the sources axis. tight_nodes pads to
+        multiples of 512 and pad_batch to power-of-two buckets, so
+        typical meshes (2/4/8 per axis) always fit; anything else falls
+        back to the single-device kernel rather than erroring."""
+        from openr_tpu.parallel.mesh import GRAPH_AXIS, SOURCES_AXIS
+
+        return (
+            dev["vp"] % self.mesh.shape[GRAPH_AXIS] == 0
+            and len(roots) % self.mesh.shape[SOURCES_AXIS] == 0
         )
 
     def _use_native(self) -> bool:

@@ -27,8 +27,13 @@ def make_mesh(
     devs = devices if devices is not None else jax.devices()
     if n_sources is None:
         n_sources = len(devs) // n_graph
-    assert n_sources * n_graph <= len(devs), (
-        f"mesh {n_sources}x{n_graph} needs more than {len(devs)} devices"
-    )
+    if n_sources * n_graph > len(devs):
+        # a real exception, not an assert: this is reachable from
+        # operator config (DecisionConfig.mesh_sources/mesh_graph) and
+        # must fail loudly even under python -O
+        raise ValueError(
+            f"mesh {n_sources}x{n_graph} needs "
+            f"{n_sources * n_graph} devices, have {len(devs)}"
+        )
     arr = np.array(devs[: n_sources * n_graph]).reshape(n_sources, n_graph)
     return Mesh(arr, (SOURCES_AXIS, GRAPH_AXIS))
