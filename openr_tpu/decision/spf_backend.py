@@ -181,6 +181,14 @@ class TpuSpfSolver:
         # latency shape, and the fused packed-output path wins there.
         self.mesh = mesh
         self._mesh_fallback_warned = False
+        # (base_version, node id) → sorted neighbor ids. The CSR's edge
+        # STRUCTURE is pinned by base_version (metric churn arrives as
+        # overrides, structural change mints a new base), so the O(E)
+        # adj_details scan runs once per topology instead of per
+        # rebuild; per-solve metrics still read the override-aware
+        # csr.details. Small FIFO bound at 4× the device-cache cap
+        # (entries are tiny; a steady-state node touches one key).
+        self._nbr_cache: dict[tuple[int, int], list[int]] = {}
         # "split" (v3 split-width kernel, default) or "dense" (r2 kernel)
         self.kernel_impl = kernel_impl
         # "auto" | "on" | "off": the native C++ radix-heap solver for the
@@ -545,7 +553,13 @@ class TpuSpfSolver:
         my_id = csr.name_to_id.get(my_node)
         if my_id is None:
             return None
-        nbr_ids = sorted(d for (s, d) in csr.adj_details if s == my_id)
+        nbr_key = (csr.base_version, my_id)
+        nbr_ids = self._nbr_cache.get(nbr_key)
+        if nbr_ids is None:
+            nbr_ids = sorted(d for (s, d) in csr.adj_details if s == my_id)
+            self._nbr_cache[nbr_key] = nbr_ids
+            while len(self._nbr_cache) > 4 * self._dev_lru_cap:
+                self._nbr_cache.pop(next(iter(self._nbr_cache)))
         n = len(nbr_ids)
         b = pad_batch(1 + n)
         nbr_metric_real = np.empty(n, dtype=np.int32)
