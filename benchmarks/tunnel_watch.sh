@@ -1,0 +1,29 @@
+#!/bin/bash
+# Probe the axon/TPU tunnel every ~3 min; append one line per probe to
+# /tmp/tunnel_watch.log. A probe is a subprocess jax.devices() with a hard
+# timeout (backend init HANGS, not errors, when the tunnel is wedged —
+# bench.py._probe_default_backend rationale). Run in the background for the
+# whole session so intermittent recovery windows (observed r3: tunnel came
+# back twice) are caught within minutes.
+LOG=${1:-/tmp/tunnel_watch.log}
+INTERVAL=${2:-180}
+while true; do
+  t0=$(date +%s)
+  out=$(timeout 45 python -u -c "
+import jax, numpy as np, time
+d = jax.devices()[0]
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+t = time.perf_counter()
+y = np.asarray(x @ x)
+print(d.platform, d, round((time.perf_counter()-t)*1e3, 1), 'ms')
+" 2>&1 | tail -1)
+  rc=$?
+  t1=$(date +%s)
+  if [ $rc -eq 0 ]; then
+    echo "$(date -u +%H:%M:%S) UP   ($((t1-t0))s) $out" >> "$LOG"
+  else
+    echo "$(date -u +%H:%M:%S) DOWN (rc=$rc, $((t1-t0))s)" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
