@@ -42,20 +42,33 @@ TARGET_MS = 10.0
 WARMUP = 2
 ITERS = 12
 
-PROBE_ATTEMPTS = int(os.environ.get("OPENR_BENCH_PROBE_ATTEMPTS", "3"))
-PROBE_TIMEOUT_S = int(os.environ.get("OPENR_BENCH_PROBE_TIMEOUT", "120"))
-PROBE_RETRY_DELAY_S = int(os.environ.get("OPENR_BENCH_PROBE_DELAY", "10"))
+PROBE_ATTEMPTS = int(os.environ.get("OPENR_BENCH_PROBE_ATTEMPTS", "1"))
+PROBE_TIMEOUT_S = int(os.environ.get("OPENR_BENCH_PROBE_TIMEOUT", "30"))
+PROBE_RETRY_DELAY_S = int(os.environ.get("OPENR_BENCH_PROBE_DELAY", "5"))
 
 
-def _probe_default_backend() -> bool:
+def _probe_default_backend(label: str = "probe") -> bool:
     """Check the default (axon/TPU) backend initializes, in a subprocess.
 
     Backend init can HANG (not just raise) when the TPU tunnel is down —
     round 1 lost its bench slot to exactly this. A subprocess with a hard
-    timeout is the only reliable guard; retries cover transient failures.
+    timeout is the only reliable guard. Round-4 lesson: the slot budget
+    matters more than probe certainty — ONE ~30 s attempt by default
+    (was 3 x 120 s + delays ~= 6.5 min of dead slot), then get on with a
+    real CPU measurement and re-probe once AFTER it (tunnel recoveries
+    are intermittent — r3 caught two live windows mid-session).
     """
     import subprocess
 
+    # the probe child must see the session's ORIGINAL platform
+    # resolution: the CPU fallback path sets JAX_PLATFORMS=cpu in
+    # os.environ, which would make a late re-probe trivially (and
+    # falsely) succeed on the CPU backend
+    env = dict(os.environ)
+    if _ORIG_JAX_PLATFORMS is None:
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = _ORIG_JAX_PLATFORMS
     for attempt in range(PROBE_ATTEMPTS):
         try:
             r = subprocess.run(
@@ -67,18 +80,33 @@ def _probe_default_backend() -> bool:
                 capture_output=True,
                 text=True,
                 timeout=PROBE_TIMEOUT_S,
+                env=env,
             )
             if r.returncode == 0:
-                return True
+                # a probe that lands on the CPU backend (e.g. the
+                # plugin RAISED instead of hanging and jax fell back
+                # with a warning) is NOT a live tunnel — treating it as
+                # one would produce the non-degraded 100k headline on
+                # the CPU backend
+                platform = r.stdout.strip().splitlines()
+                if platform and platform[-1].strip() != "cpu":
+                    return True
+                print(
+                    f"# backend {label} {attempt + 1}/{PROBE_ATTEMPTS}: "
+                    f"resolved to {platform[-1] if platform else '?'} "
+                    "(cpu fallback, not a live tunnel)",
+                    file=sys.stderr,
+                )
+                continue
             err = r.stderr.strip().splitlines()
             print(
-                f"# backend probe {attempt + 1}/{PROBE_ATTEMPTS} failed "
+                f"# backend {label} {attempt + 1}/{PROBE_ATTEMPTS} failed "
                 f"(rc={r.returncode}): {err[-1] if err else ''}",
                 file=sys.stderr,
             )
         except subprocess.TimeoutExpired:
             print(
-                f"# backend probe {attempt + 1}/{PROBE_ATTEMPTS} timed "
+                f"# backend {label} {attempt + 1}/{PROBE_ATTEMPTS} timed "
                 f"out after {PROBE_TIMEOUT_S}s",
                 file=sys.stderr,
             )
@@ -112,6 +140,13 @@ def _run_tpu_subprocess() -> bool:
     timeout_s = int(os.environ.get("OPENR_BENCH_TPU_TIMEOUT", "1500"))
     env = dict(os.environ)
     env["OPENR_BENCH_MODE"] = "measure-tpu"
+    # the CPU fallback path sets JAX_PLATFORMS=cpu in os.environ; the
+    # TPU child (e.g. after a successful late re-probe) must see the
+    # session's ORIGINAL platform resolution
+    if _ORIG_JAX_PLATFORMS is None:
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = _ORIG_JAX_PLATFORMS
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -154,32 +189,52 @@ def _run_tpu_subprocess() -> bool:
     return False
 
 
+_ORIG_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS")
+
+
 def main() -> None:
-    global WARMUP, ITERS
+    """Slot strategy (round-4 postmortem): one short probe, measure on
+    CPU IMMEDIATELY if it fails, then re-probe once — so an intermittent
+    tunnel recovery mid-slot still yields a TPU row. When both rows
+    exist, both are printed; the TPU row prints LAST so a last-line
+    parser picks the stronger, non-degraded headline (the CPU row is
+    truthfully labeled either way)."""
     mode = os.environ.get("OPENR_BENCH_MODE", "")
-    n_nodes = N_NODES
-    probe_ok = tpu_run_failed = False
     if mode == "measure-tpu":
-        tpu_ok = probe_ok = True  # parent already probed; just measure
-    else:
-        assume = os.environ.get("OPENR_BENCH_ASSUME_TPU", "").lower()
-        tpu_ok = probe_ok = (
-            assume in ("1", "true", "yes") or _probe_default_backend()
-        )
-        if tpu_ok:
-            # measure in a subprocess so a mid-run tunnel wedge cannot
-            # hang the driver's bench slot
-            if _run_tpu_subprocess():
-                return
-            tpu_ok = False
-            tpu_run_failed = True
+        _measure(True, {"tpu_probe_ok": True})  # parent already probed
+        return
+    assume = os.environ.get("OPENR_BENCH_ASSUME_TPU", "").lower()
+    t0 = time.perf_counter()
+    probe_ok = (
+        assume in ("1", "true", "yes") or _probe_default_backend()
+    )
+    probe_s = round(time.perf_counter() - t0, 1)
+    if probe_ok and _run_tpu_subprocess():
+        return
+    # fall back to cpu so the driver still records a real measurement —
+    # at reduced scale so the slower cpu backend stays inside the slot
+    extra = {
+        "tpu_probe_ok": probe_ok,
+        "probe_seconds": probe_s,
+    }
+    if probe_ok:
+        extra["tpu_run"] = "failed-or-timed-out (probe was ok)"
+    _measure(False, extra)
+    # late re-probe: the tunnel demonstrably recovers intermittently
+    # (r3 caught two live windows); the CPU measurement above took
+    # minutes, so one more cheap probe is the best value in the slot
+    if os.environ.get("OPENR_BENCH_NO_REPROBE", "").lower() not in (
+        "1", "true", "yes"
+    ):
+        if _probe_default_backend("late re-probe"):
+            _run_tpu_subprocess()
+
+
+def _measure(tpu_ok: bool, extra_detail: dict) -> None:
+    warmup, iters = (WARMUP, ITERS) if tpu_ok else (1, 3)
+    n_nodes = N_NODES if tpu_ok else 10_000
     if not tpu_ok:
-        # fall back to cpu so the driver still records a real measurement
-        # (flagged in detail.platform) — at reduced scale so the slower
-        # cpu backend stays inside the driver's slot
         os.environ["JAX_PLATFORMS"] = "cpu"
-        n_nodes = 10_000
-        WARMUP, ITERS = 1, 3
 
     import jax
 
@@ -201,10 +256,8 @@ def main() -> None:
         "nodes": csr.num_nodes,
         "directed_edges": csr.num_edges,
         "prefixes": len(ps.prefixes),
-        "tpu_probe_ok": probe_ok,
+        **extra_detail,
     }
-    if tpu_run_failed:
-        detail["tpu_run"] = "failed-or-timed-out (probe was ok)"
 
     # ---- TPU batched engine (v3 split kernel) -------------------------
     # OPENR_BENCH_TRACE=<dir> captures an xprof trace of the timed
@@ -212,11 +265,11 @@ def main() -> None:
     from openr_tpu.monitor import profiling
 
     tpu = TpuSpfSolver(native_rib="off")  # batched kernel path
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         solved = tpu.solve(ls, "node-0")
     times = []
     with profiling.trace(os.environ.get("OPENR_BENCH_TRACE")):
-        for _ in range(ITERS):
+        for _ in range(iters):
             t0 = time.perf_counter()
             solved = tpu.solve(ls, "node-0")
             times.append((time.perf_counter() - t0) * 1e3)
@@ -245,7 +298,7 @@ def main() -> None:
     # plain-prefix path + MPLS node segments)
     tpu.compute_routes(ls, ps, "node-0")  # warm assembly caches
     times_full = []
-    for _ in range(max(2, ITERS // 2)):
+    for _ in range(max(2, iters // 2)):
         t0 = time.perf_counter()
         rdb = tpu.compute_routes(ls, ps, "node-0")
         times_full.append((time.perf_counter() - t0) * 1e3)
@@ -347,18 +400,29 @@ def main() -> None:
     dev = jax.devices()[0]
     detail["device"] = str(dev)
     detail["platform"] = dev.platform
-    detail["iters"] = ITERS
-    print(
-        json.dumps(
-            {
-                "metric": "full_spf_recompute_p50_100k_node_1m_edge",
-                "value": round(solve_p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(TARGET_MS / solve_p50, 4),
-                "detail": detail,
-            }
-        )
-    )
+    detail["iters"] = iters
+    # truthful degraded-mode output (round-3/4 verdict): a CPU fallback
+    # run is a DIFFERENT experiment (10k nodes, cpu backend) — rename
+    # the metric, null vs_baseline, and flag it at the TOP level so the
+    # artifact cannot be misread as the 100k TPU number
+    degraded = not tpu_ok
+    out = {
+        "metric": (
+            "full_spf_recompute_p50_100k_node_1m_edge"
+            if not degraded
+            else f"full_spf_recompute_p50_{n_nodes // 1000}k_node"
+            "_cpu_fallback"
+        ),
+        "value": round(solve_p50, 3),
+        "unit": "ms",
+        "vs_baseline": (
+            None if degraded else round(TARGET_MS / solve_p50, 4)
+        ),
+    }
+    if degraded:
+        out["degraded"] = True
+    out["detail"] = detail
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,15 +1,27 @@
 #!/bin/bash
-# Probe the axon/TPU tunnel every ~3 min; append one line per probe to
-# /tmp/tunnel_watch.log. A probe is a subprocess jax.devices() with a hard
+# Probe the axon/TPU tunnel every ~3 min; append one line per probe to the
+# log. A probe is a subprocess jax.devices() + one real dispatch with a hard
 # timeout (backend init HANGS, not errors, when the tunnel is wedged —
 # bench.py._probe_default_backend rationale). Run in the background for the
 # whole session so intermittent recovery windows (observed r3: tunnel came
 # back twice) are caught within minutes.
+#
+# On a DOWN→UP transition, runs $ON_UP (if set) ONCE per transition — wire
+# it to `benchmarks/validate_session.py; python bench.py` so a recovery
+# window is spent measuring, not noticed after the fact.
+#
+# Probe timeout: OPENR_BENCH_PROBE_TIMEOUT (default 45 s here vs bench.py's
+# 30 s — the watcher can afford to wait longer than the bench slot; a
+# tunnel that inits in 30-45 s still logs UP here and the ON_UP bench run
+# re-probes with its own budget). `timeout -k` sends SIGKILL 10 s after
+# SIGTERM because a probe stuck in native TPU-init code ignores SIGTERM.
 LOG=${1:-/tmp/tunnel_watch.log}
 INTERVAL=${2:-180}
+PROBE_T=${OPENR_BENCH_PROBE_TIMEOUT:-45}
+was_up=0
 while true; do
   t0=$(date +%s)
-  out=$(timeout 45 python -u -c "
+  out=$(timeout -k 10 "$PROBE_T" python -u -c "
 import jax, numpy as np, time
 d = jax.devices()[0]
 import jax.numpy as jnp
@@ -17,13 +29,22 @@ x = jnp.ones((128, 128))
 t = time.perf_counter()
 y = np.asarray(x @ x)
 print(d.platform, d, round((time.perf_counter()-t)*1e3, 1), 'ms')
-" 2>&1 | tail -1)
+" 2>&1)
   rc=$?
   t1=$(date +%s)
-  if [ $rc -eq 0 ]; then
-    echo "$(date -u +%H:%M:%S) UP   ($((t1-t0))s) $out" >> "$LOG"
+  last=$(printf '%s' "$out" | tail -1)
+  if [ "$rc" -eq 0 ]; then
+    echo "$(date -u +%H:%M:%S) UP   ($((t1-t0))s) $last" >> "$LOG"
+    if [ "$was_up" -eq 0 ] && [ -n "$ON_UP" ]; then
+      echo "$(date -u +%H:%M:%S) ON_UP hook firing: $ON_UP" >> "$LOG"
+      bash -c "$ON_UP" >> "$LOG" 2>&1
+    fi
+    was_up=1
   else
-    echo "$(date -u +%H:%M:%S) DOWN (rc=$rc, $((t1-t0))s)" >> "$LOG"
+    # keep the probe's own error tail: rc 124/137 = timeout (SIGTERM /
+    # SIGKILL), anything else is an import/device error worth reading
+    echo "$(date -u +%H:%M:%S) DOWN (rc=$rc, $((t1-t0))s) $last" >> "$LOG"
+    was_up=0
   fi
   sleep "$INTERVAL"
 done
