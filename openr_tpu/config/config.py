@@ -78,8 +78,13 @@ class DecisionConfig:
     # TPU solver knobs (rebuild-specific)
     use_tpu_solver: bool = True  # False → CPU oracle path (tests/tiny nodes)
     use_dense_kernel: bool | None = None  # None = auto
-    # VMEM-resident Pallas relax kernel (TPU only; falls back to the XLA
-    # dense kernel when the distance matrix exceeds the VMEM budget)
+    # VMEM-resident Pallas relax kernel — interpreter-mode (CPU) design
+    # reference ONLY. On real TPU backends the solver REFUSES this knob
+    # at construction: the kernel's row gather lowers to
+    # tpu.dynamic_gather, which v5e Mosaic only supports inside one
+    # 8x128 vreg (measured, docs/spf_kernel_profile.md §2) — any
+    # production-size shape fails in the backend compiler. Production
+    # TPU solves use the XLA v3 split kernel (spf_kernel="split").
     use_pallas_kernel: bool = False
     # batched kernel implementation: "split" (v3 split-width tables +
     # compacted tail — the default) or "dense" (the r2 kernel)

