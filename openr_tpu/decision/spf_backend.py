@@ -218,6 +218,10 @@ class TpuSpfSolver:
         # all-sources shapes.
         self.native_rib = native_rib
         self._native_cache: dict[int, dict] = {}
+        # per-topology-base (out, in) distinct-neighbor counts for the
+        # KSP k clamp (_ksp_batch); structural, so metric churn never
+        # invalidates it
+        self._ksp_nbr_counts: dict[int, tuple] = {}
         # device-resident LSDB arrays keyed by the CSR's base version
         # (one entry per area's topology; small LRU): metric-only churn
         # arrives as a patch journal (linkstate.py MetricPatch) and is
@@ -1105,6 +1109,55 @@ class TpuSpfSolver:
         cap = max(8, min(256, (2 << 30) // bytes_per_job))
         chunk = 1 << (cap.bit_length() - 1)  # floor power of two
         max_hops = csr.padded_nodes - 1
+        # k CLAMP (round-4 verdict item 5): successive paths ban every
+        # parallel slot between each path's node pairs in both
+        # directions, so the number of edge-disjoint paths from the
+        # root is bounded by its count of DISTINCT NEIGHBORS (each path
+        # must leave through a different one), and symmetrically by the
+        # dest's. Rounds beyond min(outnbrs(root), max_j innbrs(dest_j))
+        # are structurally doomed — don't dispatch their SSSP fixpoints.
+        # BASELINE config 4's backbone has degree 2-4 with k=16: this
+        # alone cuts the per-prefix solve count ~4x; the in-kernel
+        # early exit (ops/ksp.py) handles the per-job dest bound.
+        # Neighbor counts are structural, so cache per topology base
+        # (LRU like _dev — one entry per area's topology).
+        counts = self._ksp_nbr_counts.get(csr.base_version)
+        if counts is None:
+            valid = csr.edge_metric < INF_DIST
+            pairs = np.unique(
+                csr.edge_src[valid].astype(np.int64) * csr.padded_nodes
+                + csr.edge_dst[valid]
+            )
+            # paths LEAVE the root (distinct out-neighbors bound) and
+            # ENTER the dest (distinct in-neighbors bound); the CSR can
+            # be asymmetric (a hard-drained adjacency drops one
+            # direction), so the two counts differ
+            out_counts = np.bincount(
+                (pairs // csr.padded_nodes).astype(np.int64),
+                minlength=csr.padded_nodes,
+            )
+            in_counts = np.bincount(
+                (pairs % csr.padded_nodes).astype(np.int64),
+                minlength=csr.padded_nodes,
+            )
+            counts = (out_counts, in_counts)
+            self._ksp_nbr_counts.pop(csr.base_version, None)
+            self._ksp_nbr_counts[csr.base_version] = counts
+            while len(self._ksp_nbr_counts) > self._dev_lru_cap:
+                self._ksp_nbr_counts.pop(
+                    next(iter(self._ksp_nbr_counts))
+                )
+        out_counts, in_counts = counts
+        k_eff = int(
+            max(
+                1,
+                min(
+                    self.ksp_k,
+                    out_counts[my_id],
+                    int(in_counts[dests].max()) if len(dests) else 1,
+                ),
+            )
+        )
         for start in range(0, len(jobs), chunk):
             sub = dests[start : start + chunk]
             b = pad_batch(len(sub))
@@ -1116,7 +1169,7 @@ class TpuSpfSolver:
                 blocked,
                 jnp.int32(my_id),
                 jnp.asarray(dsts),
-                k=self.ksp_k,
+                k=k_eff,
                 max_hops=max_hops,
             )
             costs, paths = np.asarray(costs), np.asarray(paths)

@@ -164,17 +164,38 @@ def ksp_edge_disjoint_dense(
         hops = jnp.where(ok, hops, 0)
         return cost, path, hops, banned, ok
 
-    def round_fn(banned, _):
-        dist = sssp(banned)
-        cost, path, hops, banned, ok = walk(dist, banned)
-        path = jnp.where(ok[:, None], path, -1)
-        return banned, (cost, path, hops)
+    # k rounds with EARLY EXIT (round-4 verdict item 5): bans only ever
+    # grow, so a round in which NO job finds a path leaves `banned`
+    # unchanged and every later round is doomed to the identical
+    # failure — stop dispatching SSSP fixpoints the moment a round
+    # comes back empty. In the config-4 backbone (node degree 2-4,
+    # k=16) this skips most of the rounds even without the host-side
+    # k clamp in _ksp_batch. Outputs for skipped rounds keep the same
+    # encoding as failed rounds (cost INF, path -1, hops 0), which is
+    # exactly what the oracle's per-prefix `break` produces.
+    costs0 = jnp.full((k, b), INF_DIST, DIST_DTYPE)
+    paths0 = jnp.full((k, b, max_hops + 1), -1, jnp.int32)
+    hops0 = jnp.zeros((k, b), jnp.int32)
+    banned0 = jnp.zeros((num_nodes, nbr.shape[1], b), bool)
 
-    _, (costs, paths, hops) = jax.lax.scan(
-        round_fn,
-        jnp.zeros((num_nodes, nbr.shape[1], b), bool),
-        None,
-        length=k,
+    def round_cond(state):
+        _banned, _c, _p, _h, i, live = state
+        return live & (i < k)
+
+    def round_body(state):
+        banned, costs, paths, hops, i, _live = state
+        dist = sssp(banned)
+        cost, path, hop, banned, ok = walk(dist, banned)
+        path = jnp.where(ok[:, None], path, -1)
+        costs = costs.at[i].set(cost)
+        paths = paths.at[i].set(path)
+        hops = hops.at[i].set(hop)
+        return banned, costs, paths, hops, i + 1, jnp.any(ok)
+
+    _, costs, paths, hops, _, _ = jax.lax.while_loop(
+        round_cond,
+        round_body,
+        (banned0, costs0, paths0, hops0, jnp.int32(0), jnp.bool_(True)),
     )
     return costs, paths, hops
 

@@ -328,3 +328,33 @@ def test_ksp_k_overload_respected_both_backends():
     for nh in e.nexthops:
         if nh.mpls_action is not None and nh.mpls_action.push_labels:
             assert lbl4 not in nh.mpls_action.push_labels
+
+
+def test_ksp_clamp_asymmetric_dest_matches_oracle():
+    """Regression (r5 review): the KSP k clamp bounds the DEST by its
+    IN-neighbor count — a hard-drained adjacency at the dest drops one
+    direction from the CSR (out-deg < in-deg), and clamping by out-deg
+    would compute fewer disjoint paths than exist. Both backends must
+    still agree, and the path count must match the true in-degree."""
+    adj_dbs, _ = topogen.ring(4)
+    # drain node-2's own link toward node-1: edge (2→1) leaves the CSR,
+    # (1→2) stays — node-2 now has out-deg 1, in-deg 2
+    dbs = []
+    for db in adj_dbs:
+        if db.this_node_name == "node-2":
+            adjs = tuple(
+                replace(a, is_overloaded=(a.other_node_name == "node-1"))
+                for a in db.adjacencies
+            )
+            db = replace(db, adjacencies=adjs)
+        dbs.append(db)
+    prefix_db = PrefixDatabase(
+        this_node_name="node-2", prefix_entries=(ksp2_entry("10.9.0.0/16"),)
+    )
+    ls, ps = _state(dbs, [prefix_db])
+    cpu = compute_routes(ls, ps, "node-0")
+    tpu = TpuSpfSolver().compute_routes(ls, ps, "node-0")
+    assert cpu.unicast_routes == tpu.unicast_routes
+    e = tpu.unicast_routes[IpPrefix.make("10.9.0.0/16")]
+    # both edge-disjoint paths into node-2 must survive the clamp
+    assert {nh.neighbor_node for nh in e.nexthops} == {"node-1", "node-3"}

@@ -65,7 +65,10 @@ def test_split_matches_dense_er(n, deg, mw):
     np.testing.assert_array_equal(ref, got)
 
 
-@pytest.mark.parametrize("n", [800, 2000])  # 2000 → vp=2048: GS chunks on
+# 9000 → vp=9216 ≥ GS_MIN_VP: the DEFAULT picker runs chunked sweeps,
+# so the dense-equality assertion covers the production GS path (the
+# explicit-override coverage is test_split_gs_chunk_counts_all_equal)
+@pytest.mark.parametrize("n", [800, 9000])
 def test_split_matches_dense_overloads(n):
     es, ed, em, vp, nn, _e = topogen.erdos_renyi_csr(
         n, avg_degree=6, seed=5, max_metric=32
