@@ -81,8 +81,17 @@ def build_decision(adj_dbs, prefix_dbs, debounce_min=None, debounce_max=None):
     return dec, pubs, routes, pub_for
 
 
-async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
-    """Flap link metrics at the target rate while Decision runs live."""
+async def churn(
+    dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds, burst=10
+):
+    """Flap link metrics at the target rate while Decision runs live.
+
+    `burst` flaps are delivered back-to-back per wakeup (aggregate rate
+    unchanged). Real KvStore floods deliver publication BATCHES, and a
+    per-flap 1 kHz wakeup loop on the 1-core bench host starves the
+    solver of contiguous CPU — round-3's 2x row variance with host
+    weather came from exactly this generator/solver contention
+    (round-5 protocol note; --burst 1 restores the old behavior)."""
     import dataclasses
 
     from openr_tpu.messaging import QueueClosedError
@@ -149,8 +158,12 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
     no_change_flaps = [0]
     stop = time.perf_counter() + seconds  # exclude pregen time
     while time.perf_counter() < stop and n_flaps < max_flaps:
-        flap_t[n_flaps] = time.perf_counter()
-        dec.process_publication(pregen[n_flaps])
+        for _ in range(burst):
+            if n_flaps >= max_flaps:
+                break
+            flap_t[n_flaps] = time.perf_counter()
+            dec.process_publication(pregen[n_flaps])
+            n_flaps += 1
         dec.debounce.poke()
         # one recompute-latency sample PER RECOMPUTE (flap-weighted
         # sampling would duplicate the pre-churn value hundreds of times)
@@ -169,8 +182,7 @@ async def churn(dec, pubs, routes, pub_for, adj_dbs, flaps_per_sec, seconds):
                 if emitted < t <= completed:
                     del flap_t[seq]
                     no_change_flaps[0] += 1
-        n_flaps += 1
-        next_send += interval
+        next_send += interval * burst
         delay = next_send - time.perf_counter()
         if delay > 0:
             await asyncio.sleep(delay)
@@ -191,6 +203,7 @@ def main() -> None:
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--debounce-min-ms", type=float, default=None)
     ap.add_argument("--debounce-max-ms", type=float, default=None)
+    ap.add_argument("--burst", type=int, default=10)
     ap.add_argument(
         "--backend", choices=("auto", "cpu"), default="auto",
         help="cpu forces jax onto host CPU (the axon sitecustomize "
@@ -216,7 +229,7 @@ def main() -> None:
     n_flaps, spf_runs, spf_ms, lat, no_change, breakdown = asyncio.new_event_loop().run_until_complete(
         churn(
             dec, pubs, routes, pub_for, list(adj_dbs),
-            args.flaps_per_sec, args.seconds,
+            args.flaps_per_sec, args.seconds, burst=args.burst,
         )
     )
     spf = np.array(spf_ms) if spf_ms else np.array([0.0])
@@ -232,6 +245,7 @@ def main() -> None:
             "k": k,
             "flaps_sent": n_flaps,
             "flap_rate_target": args.flaps_per_sec,
+            "burst": args.burst,
             "recomputes": spf_runs,
             "flaps_per_recompute": round(n_flaps / max(spf_runs, 1), 1),
             "no_change_flaps": no_change,

@@ -219,19 +219,29 @@ class LinkState:
     def __init__(self, area: str = DEFAULT_AREA):
         self.area = area
         self._adj_dbs: dict[str, AdjacencyDatabase] = {}
-        # one-cell CSR cache, SHARED with snapshots: a snapshot that builds
-        # the CSR off-thread publishes it back through the cell, so the live
-        # object (and later snapshots of the same topology) reuse it.
-        # Mutation replaces the cell instead of clearing it, so snapshots
-        # taken before the change keep their own still-valid cache.
-        self._csr_cell: list[CsrGraph | None] = [None]
+        # CSR cache cell [base, patched, patched_upto], SHARED with
+        # snapshots: a snapshot that builds the base CSR — or advances
+        # the patched view — off-thread publishes it back through the
+        # cell, so the live object (and later snapshots of the same
+        # topology) reuse it. The patch state MUST live here and not on
+        # the instance: rebuilds run on per-rebuild snapshots, and
+        # instance-held progress would never propagate back — every
+        # rebuild would re-apply the whole accumulated pending list
+        # (observed: to_csr cost growing linearly over a churn epoch,
+        # ~16 ms/cycle at steady state; round-5 profile). Mutation
+        # replaces the cell instead of clearing it, so snapshots taken
+        # before a structural change keep their own still-valid cache;
+        # within one cell only the (serialized) rebuild thread writes
+        # slots 1-2.
+        self._csr_cell: list = [None, None, 0]
         # metric-only changes since the base CSR in the cell: applied
         # copy-on-write at to_csr() time (one array copy per solve, not
         # per flap), so churn never pays the O(E) python rebuild.
-        # Rebound (never mutated in place) so snapshots stay consistent.
+        # Rebound (never mutated in place) so snapshots stay consistent
+        # — which also keeps cell[2] meaningful across snapshots: the
+        # rebinding append preserves the prefix, so an index into one
+        # snapshot's list addresses the same flaps in every later one.
         self._pending: list[tuple[str, Adjacency]] = []
-        self._patched: CsrGraph | None = None
-        self._patched_upto = 0  # prefix of _pending baked into _patched
 
     # ---- mutation ---------------------------------------------------------
 
@@ -255,21 +265,17 @@ class LinkState:
                 self._pending = self._pending + [
                     (db.this_node_name, a) for a in delta
                 ]
-                # _patched stays: to_csr applies only the new suffix
+                # cell's patched view stays: to_csr applies the suffix
                 return True
-        self._csr_cell = [None]
+        self._csr_cell = [None, None, 0]
         self._pending = []
-        self._patched = None
-        self._patched_upto = 0
         return True
 
     def delete_adjacency_db(self, node: str) -> bool:
         if node in self._adj_dbs:
             del self._adj_dbs[node]
-            self._csr_cell = [None]
+            self._csr_cell = [None, None, 0]
             self._pending = []
-            self._patched = None
-            self._patched_upto = 0
             return True
         return False
 
@@ -281,11 +287,10 @@ class LinkState:
         snap = LinkState(self.area)
         snap._adj_dbs = dict(self._adj_dbs)
         snap._csr_cell = self._csr_cell
-        # pending/patched are rebound on mutation, never mutated, so
-        # sharing the current references is race-free
+        # _pending is rebound on mutation, never mutated, so sharing
+        # the current reference is race-free; the patched view travels
+        # in the shared cell
         snap._pending = self._pending
-        snap._patched = self._patched
-        snap._patched_upto = self._patched_upto
         return snap
 
     # ---- queries ----------------------------------------------------------
@@ -315,26 +320,35 @@ class LinkState:
         instead of the O(E) python rebuild — carrying the cumulative
         patch journal for the solver's device-array cache.
         """
-        if self._csr_cell[0] is None:
-            self._csr_cell[0] = self._build_csr()
+        cell = self._csr_cell
+        if cell[0] is None:
+            cell[0] = self._build_csr()
+            cell[1], cell[2] = None, 0
             self._pending = []
-            self._patched = None
-            self._patched_upto = 0
-        base = self._csr_cell[0]
-        if not self._pending:
+        base = cell[0]
+        pending = self._pending  # rebound-on-append: stable view
+        if not pending:
             return base
-        if self._patched is None:
-            self._patched = self._apply_pending(base, self._pending)
-        elif self._patched_upto < len(self._pending):
+        patched, upto = cell[1], cell[2]
+        if patched is None:
+            patched, upto = self._apply_pending(base, pending), 0
+        elif upto < len(pending):
             # incremental: patch only the suffix that arrived since the
             # last materialization — under sustained metric churn this
             # keeps per-rebuild host cost O(new flaps), not O(all
-            # accumulated flaps since the last structural rebuild)
-            self._patched = self._apply_pending(
-                self._patched, self._pending[self._patched_upto :]
-            )
-        self._patched_upto = len(self._pending)
-        return self._patched
+            # accumulated flaps since the last structural rebuild).
+            # Progress is published through the shared cell so the NEXT
+            # rebuild's snapshot continues from here.
+            patched = self._apply_pending(patched, pending[upto:])
+        elif upto > len(pending):
+            # a cell advanced past this snapshot's pending view (a
+            # newer rebuild ran concurrently — not the serialized
+            # production flow): the patched CSR is ahead of this
+            # snapshot; rebuild from base for a consistent view without
+            # touching the shared progress
+            return self._apply_pending(base, pending)
+        cell[1], cell[2] = patched, len(pending)
+        return patched
 
     def _apply_pending(
         self, base: CsrGraph, pending: list[tuple[str, Adjacency]]
