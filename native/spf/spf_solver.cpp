@@ -97,8 +97,14 @@ inline bool usable_src(const Csr& g, int32_t u, int32_t root) {
 // caller-allocated [v]; filled with kInf for unreachable. When `order`
 // is non-null, the settle (final-pop) sequence is appended to it — a
 // free by-product that saves the fh pass an O(V log V) sort.
+// When `saw_zero` is non-null it is set if any zero-metric edge is
+// RELAXED (i.e. leaves a settled, usable node) — exactly the edges the
+// first-hop propagation can traverse, so it decides whether the
+// propagation needs the fixpoint loop (free: rides the existing edge
+// walk instead of a separate O(E) scan).
 void dijkstra(const Csr& g, int32_t root, int32_t* dist,
-              std::vector<int32_t>* order = nullptr) {
+              std::vector<int32_t>* order = nullptr,
+              bool* saw_zero = nullptr) {
   std::fill(dist, dist + g.v, kInf);
   if (root < 0 || root >= g.v) return;
   RadixHeap heap(g.v);
@@ -113,6 +119,7 @@ void dijkstra(const Csr& g, int32_t root, int32_t* dist,
     for (int64_t i = lo; i < hi; ++i) {
       const int32_t wt = g.w[i];
       if (wt >= kInf) continue;
+      if (saw_zero != nullptr && wt == 0) *saw_zero = true;
       const int32_t nd = d + wt;  // both < 2^30: no overflow
       const int32_t x = g.dst[i];
       if (nd < dist[x]) {
@@ -168,7 +175,8 @@ int openr_spf_rib(int32_t v, const int64_t* row_start, const int32_t* dst,
   // — no separate O(V log V) sort for the propagation pass
   std::vector<int32_t> order;
   order.reserve(v);
-  dijkstra(g, root, dist_out, &order);
+  bool has_zero = false;
+  dijkstra(g, root, dist_out, &order, &has_zero);
   const int32_t words = (n_nbrs + 63) / 64;
   std::memset(fh_out, 0, static_cast<size_t>(v) * words * sizeof(uint64_t));
   if (n_nbrs == 0) return 0;
@@ -189,8 +197,14 @@ int openr_spf_rib(int32_t v, const int64_t* row_start, const int32_t* dst,
   // every tight out-edge u->x ORs u's mask into x. Zero-metric edges
   // create tight edges BETWEEN equal-distance nodes, which a single
   // distance-ordered pass can visit in the wrong order — iterate to a
-  // fixpoint (masks only grow, so this terminates; one pass suffices
-  // when all metrics are positive).
+  // fixpoint (masks only grow, so this terminates). With strictly
+  // positive metrics every tight edge goes to a strictly-later settle
+  // position, so ONE pass is exact — and the `grew` flag would still
+  // force a full confirming second pass (masks grew in pass 1 by
+  // construction). `has_zero` (collected for free during the Dijkstra
+  // relax, and only over edges propagation can actually traverse)
+  // gates the fixpoint loop — halves the propagation cost (~2.2M edge
+  // visits at the 100k benchmark) in the common all-positive case.
   bool grew = true;
   while (grew) {
     grew = false;
@@ -216,6 +230,7 @@ int openr_spf_rib(int32_t v, const int64_t* row_start, const int32_t* dst,
         }
       }
     }
+    if (!has_zero) break;  // positive metrics: single pass is exact
   }
   return 0;
 }
