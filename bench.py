@@ -231,6 +231,16 @@ def main() -> None:
 
 
 def _measure(tpu_ok: bool, extra_detail: dict) -> None:
+    # OPENR_BENCH_SMOKE_CPU forces the cpu backend even in measure-tpu
+    # mode, at full scale — the only way to exercise the EXACT code
+    # path the driver runs on hardware without the tunnel (the axon
+    # sitecustomize overrides the JAX_PLATFORMS env var, so an
+    # env-only override cannot do it). Smoke rows are labeled like
+    # fallback rows (degraded, renamed metric) — a forced-cpu run must
+    # never be mistakable for the TPU headline.
+    smoke = os.environ.get("OPENR_BENCH_SMOKE_CPU", "").lower() in (
+        "1", "true", "yes"
+    )
     warmup, iters = (WARMUP, ITERS) if tpu_ok else (1, 3)
     n_nodes = N_NODES if tpu_ok else 10_000
     if not tpu_ok:
@@ -238,11 +248,17 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
 
     import jax
 
-    if not tpu_ok:
+    if not tpu_ok or smoke:
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
-            pass
+            if smoke:
+                raise  # an explicit smoke run must never reach the tunnel
+    if smoke and jax.devices()[0].platform != "cpu":
+        raise RuntimeError(
+            "OPENR_BENCH_SMOKE_CPU set but the backend is "
+            f"{jax.devices()[0].platform}, not cpu"
+        )
 
     from openr_tpu.decision.spf_backend import TpuSpfSolver
     from openr_tpu.ops.native_spf import native_available
@@ -430,13 +446,13 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     # run is a DIFFERENT experiment (10k nodes, cpu backend) — rename
     # the metric, null vs_baseline, and flag it at the TOP level so the
     # artifact cannot be misread as the 100k TPU number
-    degraded = not tpu_ok
+    degraded = (not tpu_ok) or smoke
     out = {
         "metric": (
             "full_spf_recompute_p50_100k_node_1m_edge"
             if not degraded
             else f"full_spf_recompute_p50_{n_nodes // 1000}k_node"
-            "_cpu_fallback"
+            + ("_cpu_smoke" if smoke else "_cpu_fallback")
         ),
         "value": round(solve_p50, 3),
         "unit": "ms",
