@@ -115,6 +115,12 @@ def _probe_default_backend(label: str = "probe") -> bool:
     return False
 
 
+def _env_flag(name: str) -> bool:
+    """Shared truthy-env parse — one set of accepted spellings for
+    every OPENR_BENCH_* boolean flag."""
+    return os.environ.get(name, "").lower() in ("1", "true", "yes")
+
+
 def _p50_p99(times: list[float]) -> tuple[float, float]:
     times = sorted(times)
     return (
@@ -203,10 +209,9 @@ def main() -> None:
     if mode == "measure-tpu":
         _measure(True, {"tpu_probe_ok": True})  # parent already probed
         return
-    assume = os.environ.get("OPENR_BENCH_ASSUME_TPU", "").lower()
     t0 = time.perf_counter()
     probe_ok = (
-        assume in ("1", "true", "yes") or _probe_default_backend()
+        _env_flag("OPENR_BENCH_ASSUME_TPU") or _probe_default_backend()
     )
     probe_s = round(time.perf_counter() - t0, 1)
     if probe_ok and _run_tpu_subprocess():
@@ -223,9 +228,7 @@ def main() -> None:
     # late re-probe: the tunnel demonstrably recovers intermittently
     # (r3 caught two live windows); the CPU measurement above took
     # minutes, so one more cheap probe is the best value in the slot
-    if os.environ.get("OPENR_BENCH_NO_REPROBE", "").lower() not in (
-        "1", "true", "yes"
-    ):
+    if not _env_flag("OPENR_BENCH_NO_REPROBE"):
         if _probe_default_backend("late re-probe"):
             _run_tpu_subprocess()
 
@@ -238,9 +241,10 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     # env-only override cannot do it). Smoke rows are labeled like
     # fallback rows (degraded, renamed metric) — a forced-cpu run must
     # never be mistakable for the TPU headline.
-    smoke = os.environ.get("OPENR_BENCH_SMOKE_CPU", "").lower() in (
-        "1", "true", "yes"
-    )
+    # only meaningful in measure-tpu mode (tpu_ok): the fallback path
+    # is already a different, truthfully-labeled experiment, and the
+    # flag must not relabel it (review finding)
+    smoke = tpu_ok and _env_flag("OPENR_BENCH_SMOKE_CPU")
     warmup, iters = (WARMUP, ITERS) if tpu_ok else (1, 3)
     n_nodes = N_NODES if tpu_ok else 10_000
     if not tpu_ok:

@@ -62,6 +62,7 @@ def ksp_edge_disjoint_dense(
     *,
     k: int,
     max_hops: int,
+    dist0: jax.Array | None = None,  # [Vp] i32, see below
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (costs [k, B] i32, paths [k, B, max_hops+1] i32, hops [k, B]).
 
@@ -69,6 +70,15 @@ def ksp_edge_disjoint_dense(
     WALK order (dest first, root last), -1 padded; ``costs[i, b]`` is
     INF_DIST when no i-th disjoint path exists. Rounds are emitted in
     computation order; successive costs are non-decreasing.
+
+    ``dist0`` (optional; None vs array is structurally static under
+    jit): precomputed UNBANNED distances from ``root`` under the same
+    blocked/overload semantics — round 1 has no bans, so its SSSP
+    result is identical for every job and the caller usually has it
+    already (the production solve's d_root).
+    Skipping the round-1 fixpoint saves 1/k_eff of the solve cost —
+    material on high-diameter graphs where each fixpoint runs
+    ~diameter sweeps (round-4 verdict item 5, "share the k=1 solve").
     """
     num_nodes, _d = nbr.shape
     b = dests.shape[0]
@@ -184,7 +194,18 @@ def ksp_edge_disjoint_dense(
 
     def round_body(state):
         banned, costs, paths, hops, i, _live = state
-        dist = sssp(banned)
+        if dist0 is not None:
+            # round 1 is ban-free and shared: broadcast the caller's
+            # precomputed distances instead of running the fixpoint
+            dist = jax.lax.cond(
+                i == 0,
+                lambda: jnp.broadcast_to(
+                    dist0[:, None], (num_nodes, b)
+                ).astype(DIST_DTYPE),
+                lambda: sssp(banned),
+            )
+        else:
+            dist = sssp(banned)
         cost, path, hop, banned, ok = walk(dist, banned)
         path = jnp.where(ok[:, None], path, -1)
         costs = costs.at[i].set(cost)
