@@ -7,6 +7,8 @@ Prints, in order (each flushed as it lands, in case the tunnel dies):
   2. pure-kernel p50 via scalar drain (compare: 287 ms pre-GS);
   3. B=256 all-sources solve (compare: 505.6 ms);
   4. warm full-RIB p50 (solve + assembly with the entry/class caches);
+  4b. hop-count-regime solve p50 (uniform metrics — same compiled
+     kernel, ~5-8 sweeps; the north-star regime, docs/scaling.md §3);
   5. in-run oracle spot check (3 roots vs native C++ Dijkstra).
 """
 
@@ -79,6 +81,17 @@ def main() -> None:
 
     t = p50(full_rib, n=5, warm=2)
     print(f"4. warm full RIB p50         : {t:8.1f} ms", flush=True)
+
+    # hop-count metric regime (Open/R default; same table shapes → the
+    # SAME compiled kernel, ~5-8 sweeps instead of ~19): the regime the
+    # <10 ms north star is reachable in on v5e-4 (docs/scaling.md §3)
+    ls_hop, _ps_hop, _csr_hop = erdos_renyi_lsdb(
+        100_000, avg_degree=20, seed=0, max_metric=1
+    )
+    tpu.solve(ls_hop, "node-0")  # upload + warm
+    t = p50(lambda: tpu.solve(ls_hop, "node-0"), n=5, warm=1)
+    print(f"4b. hop-regime solve wall p50 : {t:8.1f} ms  "
+          "(projected ~40 pre-d-loop)", flush=True)
 
     # oracle spot check
     from openr_tpu.ops.native_spf import OutCsr, native_available
