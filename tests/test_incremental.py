@@ -363,3 +363,52 @@ def test_randomized_churn_cache_equivalence_property():
         want = oracle_compute_routes(ls, ps, names[0])
         assert got.unicast_routes == want.unicast_routes, f"step {step}"
         assert got.mpls_routes == want.mpls_routes, f"step {step}"
+
+
+def test_patch_progress_shared_across_snapshots():
+    """Round-5 regression guard: the incremental patch state must live
+    in the snapshot-SHARED CSR cell — when it lived on the LinkState
+    instance, every per-rebuild snapshot re-applied the WHOLE
+    accumulated flap backlog (O(epoch) host work per rebuild, the
+    dominant config-5 cost). A later snapshot must continue from the
+    progress an earlier snapshot's to_csr published."""
+    import dataclasses
+
+    from openr_tpu.decision.linkstate import LinkState
+
+    dbs = ring_dbs(8)
+    ls = fresh_ls(dbs)
+    ls.to_csr()  # build the base into the shared cell
+
+    calls = []
+    orig = LinkState._apply_pending
+
+    def spy(self, base, pending):
+        calls.append(len(pending))
+        return orig(self, base, pending)
+
+    LinkState._apply_pending = spy
+    try:
+        for cycle in range(3):
+            # two metric-only flaps per cycle
+            for j in (2, 5):
+                node = f"n{j}"
+                cur = ls.adjacency_db(node)
+                adjs = list(cur.adjacencies)
+                adjs[0] = dataclasses.replace(
+                    adjs[0], metric=10 + cycle + j
+                )
+                assert ls.update_adjacency_db(
+                    dataclasses.replace(cur, adjacencies=tuple(adjs))
+                )
+            # the production flow: a FRESH snapshot per rebuild
+            snap = ls.snapshot()
+            snap.to_csr()
+    finally:
+        LinkState._apply_pending = orig
+
+    # every cycle must apply ONLY its own suffix (2 flaps), never the
+    # accumulated backlog (2, then 4, then 6 would indicate the r3 bug)
+    assert calls == [2, 2, 2], calls
+    # and the live object's shared cell carries the progress
+    assert ls._csr_cell[2] == 6
