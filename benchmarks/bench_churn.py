@@ -87,11 +87,16 @@ async def churn(
     """Flap link metrics at the target rate while Decision runs live.
 
     `burst` flaps are delivered back-to-back per wakeup (aggregate rate
-    unchanged). Real KvStore floods deliver publication BATCHES, and a
-    per-flap 1 kHz wakeup loop on the 1-core bench host starves the
-    solver of contiguous CPU — round-3's 2x row variance with host
-    weather came from exactly this generator/solver contention
-    (round-5 protocol note; --burst 1 restores the old behavior)."""
+    unchanged); real KvStore floods deliver publication BATCHES. The
+    inter-wakeup gap (burst / flaps_per_sec) is the protocol's most
+    load-bearing knob: gaps at or below Decision's debounce MIN
+    (default 10 ms) re-defer the coalescing window on every poke, so
+    each cycle runs to the debounce MAX cap (default 250 ms) — the
+    by-design saturating-churn regime (~250-flap batches, flap→RIB
+    ≈ max/2 + recompute). Gaps above the min (burst 20 at 1 kHz ⇒
+    20 ms) fire the min-debounce after every burst — the low-latency
+    regime. See the BASELINE.md config-5 protocol note; traced
+    poke-by-poke in round 5."""
     import dataclasses
 
     from openr_tpu.messaging import QueueClosedError
