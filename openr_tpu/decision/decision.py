@@ -185,7 +185,7 @@ class Decision(OpenrModule):
         # from both the decode worker thread and the event loop, so
         # increments take the (uncontended) lock — dropped counts would
         # skew the very tier ratios this exists to report
-        self.decode_stats = {"fast": 0, "full": 0, "same": 0}
+        self.decode_stats = {"fast": 0, "multi": 0, "full": 0, "same": 0}
         self._decode_stats_lock = threading.Lock()
         dcfg = config.node.decision
         backend = solver or ("tpu" if dcfg.use_tpu_solver else "cpu")
@@ -413,12 +413,9 @@ class Decision(OpenrModule):
             if to_wire(prev["adjs"][i]) != b"{%s}" % pv[s:e]:
                 return None
         body = payload[s : e + delta]
-        try:
-            adj = _ADJ_DEC(json.loads(b"{%s}" % body))
-        except Exception:  # noqa: BLE001 — structural proof failed
+        adj = self._validated_adj_body(body)
+        if adj is None:
             return None
-        if to_wire(adj) != b"{%s}" % body:
-            return None  # non-canonical body: the span would be unproven
         adjs = prev["adjs"][:i] + (adj,) + prev["adjs"][i + 1 :]
         raws = prev["raws"]
         if raws is not None:
@@ -441,6 +438,90 @@ class Decision(OpenrModule):
             "db": replace(prev["db"], adjacencies=adjs),
         }
 
+    @staticmethod
+    def _validated_adj_body(body: bytes):
+        """Parse one adjacency body and prove it canonical (re-encode
+        == input) — the soundness-critical validation shared by BOTH
+        splice tiers; returns the Adjacency or None."""
+        try:
+            adj = _ADJ_DEC(json.loads(b"{%s}" % body))
+        except Exception:  # noqa: BLE001 — structural proof failed
+            return None
+        if to_wire(adj) != b"{%s}" % body:
+            return None  # non-canonical body: the span would be unproven
+        return adj
+
+    def _decode_adj_multi(self, payload: bytes, prev: dict):
+        """Tier-1b decode: MULTIPLE adjacency bodies changed (two flaps
+        of the same node coalesced into one debounce window — ~40% of
+        churn decodes fell through to the full parse before this tier).
+
+        Re-scans the NEW payload's body spans under the same
+        separator-count proof as `_adj_spans`; requires the framing to
+        be byte-identical to the cached payload's (the prefix before
+        the first body, and the whole suffix from the last body's end —
+        which carries every non-adjacency field; the inter-body
+        separators are the literal b'},{' by construction of the
+        scan). Bodies then pair positionally: byte-equal bodies reuse
+        the cached Adjacency objects, differing bodies are parsed and
+        canonically re-encode-validated exactly like the single-span
+        path (old span proven before the replacement is accepted).
+        Anything unproven → None → caller does the full parse."""
+        spans_old = prev["spans"]
+        if spans_old is None:
+            return None
+        new_spans = self._adj_spans(payload, prev["adjs"])
+        if new_spans is None:
+            return None
+        starts_o, ends_o = spans_old
+        starts_n, ends_n = new_spans
+        pv = prev["payload"]
+        if payload[: starts_n[0]] != pv[: starts_o[0]]:
+            return None
+        if payload[ends_n[-1] :] != pv[ends_o[-1] :]:
+            return None
+        proven = prev["proven"]
+        adjs = list(prev["adjs"])
+        raws = prev["raws"]
+        raws = list(raws) if raws is not None else None
+        new_proven = proven.copy()
+        changed = 0
+        mv_old, mv_new = memoryview(pv), memoryview(payload)
+        for i in range(len(adjs)):
+            so, eo = int(starts_o[i]), int(ends_o[i])
+            sn, en = int(starts_n[i]), int(ends_n[i])
+            # zero-copy compare for the unchanged majority; slice to
+            # bytes only for the few bodies that get parsed
+            if mv_old[so:eo] == mv_new[sn:en]:
+                continue
+            body = payload[sn:en]
+            if not proven[i]:
+                # pin the OLD span to the true object location before
+                # trusting a positional replacement (see _adj_spans)
+                if to_wire(adjs[i]) != b"{%s}" % pv[so:eo]:
+                    return None
+            adj = self._validated_adj_body(body)
+            if adj is None:
+                return None
+            adjs[i] = adj
+            if raws is not None:
+                raws[i] = None
+            new_proven[i] = True
+            changed += 1
+        if changed == 0:
+            # framing + every body byte-equal ⇒ payload == cached (the
+            # caller's identity check handles that first); be safe
+            return prev
+        adjs_t = tuple(adjs)
+        return {
+            "payload": payload,
+            "spans": new_spans,
+            "proven": new_proven,
+            "raws": raws,
+            "adjs": adjs_t,
+            "db": replace(prev["db"], adjacencies=adjs_t),
+        }
+
     def _decode_value(self, area: str, key: str, val: Value, schema):
         """Decode one publication value; AdjacencyDatabase goes through
         the churn reuse cache (see _adj_reuse)."""
@@ -452,12 +533,15 @@ class Decision(OpenrModule):
         cache = self._adj_reuse
         prev = cache.get((area, key))
         entry = None
+        tier = "full"
         if prev is not None:
             entry = self._decode_adj_fast(payload, prev)
-        tier = (
-            "full" if entry is None
-            else ("same" if entry is prev else "fast")
-        )
+            if entry is not None:
+                tier = "same" if entry is prev else "fast"
+            else:
+                entry = self._decode_adj_multi(payload, prev)
+                if entry is not None:
+                    tier = "same" if entry is prev else "multi"
         with self._decode_stats_lock:
             self.decode_stats[tier] += 1
         if entry is None:

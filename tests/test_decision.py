@@ -315,3 +315,44 @@ def test_adj_byte_splice_decode_property():
         got = d._decode_value(DEFAULT_AREA, key, v, AdjacencyDatabase)
         want = from_wire(v.value, AdjacencyDatabase)
         assert got == want, f"step {step}: {got} != {want}"
+
+
+def test_adj_multi_span_splice_tier():
+    """Two adjacencies changed in one window must take the tier-1b
+    multi-span splice (not the full parse), reuse every unchanged
+    Adjacency identity, and equal from_wire byte-for-byte."""
+    import dataclasses
+
+    from openr_tpu.types.serde import from_wire
+    from openr_tpu.types.topology import AdjacencyDatabase
+
+    d, _pubs, _routes = mk_decision()
+    adj_dbs, _ = topogen.ring(8)
+    db = adj_dbs[0]
+    key = adj_key(db.this_node_name)
+    v1 = Value(version=1, originator_id="x", value=to_wire(db)).with_hash()
+    got1 = d._decode_value(DEFAULT_AREA, key, v1, AdjacencyDatabase)
+
+    adjs = list(db.adjacencies)
+    assert len(adjs) >= 2
+    adjs[0] = dataclasses.replace(adjs[0], metric=771)
+    adjs[-1] = dataclasses.replace(adjs[-1], metric=9)  # width change
+    db2 = dataclasses.replace(db, adjacencies=tuple(adjs))
+    v2 = Value(version=2, originator_id="x", value=to_wire(db2)).with_hash()
+    before = dict(d.decode_stats)
+    got2 = d._decode_value(DEFAULT_AREA, key, v2, AdjacencyDatabase)
+    assert d.decode_stats["multi"] == before["multi"] + 1
+    assert d.decode_stats["full"] == before["full"]
+    assert got2 == from_wire(v2.value, AdjacencyDatabase)
+    assert got2.adjacencies[0].metric == 771
+    assert got2.adjacencies[-1].metric == 9
+    for i in range(1, len(adjs) - 1):
+        assert got2.adjacencies[i] is got1.adjacencies[i]  # reused
+
+    # and a third mutation on top of the spliced entry keeps working
+    adjs2 = list(db2.adjacencies)
+    adjs2[1] = dataclasses.replace(adjs2[1], metric=5)
+    db3 = dataclasses.replace(db2, adjacencies=tuple(adjs2))
+    v3 = Value(version=3, originator_id="x", value=to_wire(db3)).with_hash()
+    got3 = d._decode_value(DEFAULT_AREA, key, v3, AdjacencyDatabase)
+    assert got3 == from_wire(v3.value, AdjacencyDatabase)
