@@ -20,7 +20,7 @@ from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
 from openr_tpu.kvstore.store import KvStoreDb
 from openr_tpu.kvstore.transport import pub_from_json, pub_to_json
-from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue
 from openr_tpu.types.kvstore import KeyDumpParams, Publication, Value
 
 log = logging.getLogger(__name__)
@@ -134,8 +134,6 @@ class KvStore(OpenrModule):
         self.peers.clear()
 
     async def _peer_event_loop(self) -> None:
-        from openr_tpu.messaging import QueueClosedError
-
         while True:
             try:
                 ev: PeerEvent = await self.peer_events_reader.get()
@@ -292,9 +290,25 @@ class KvStore(OpenrModule):
             )
             if self.node_name not in out.node_ids:
                 out.node_ids.append(self.node_name)
-            self.pub_queue.push(out)
+            if not self._publish(out):
+                return accepted  # stopping: merged, not notifiable
             self._flood(area, out, exclude=from_peer)
         return accepted
+
+    def _publish(self, pub: Publication) -> bool:
+        """Push to the local publication queue, tolerating the shutdown
+        race (observed in 49-node emulator teardown): a peer's set_key,
+        a ttl expiry, or a flood can land after stop() closed our
+        queue — the merge itself already happened (correct for a
+        restarting node; GR keeps the LSDB), only the notification is
+        undeliverable. Returns False when dropped."""
+        try:
+            self.pub_queue.push(pub)
+        except QueueClosedError:
+            if not self.stopped:
+                raise
+            return False
+        return True
 
     def _flood(
         self, area: str, pub: Publication, exclude: str | None
@@ -560,7 +574,7 @@ class KvStore(OpenrModule):
                     expired_keys=dead,
                     node_ids=[self.node_name],
                 )
-                self.pub_queue.push(pub)
+                self._publish(pub)
                 # expiry is local-clock-driven on every store; no flood
                 # (reference: ttl countdown is per-store †)
 
