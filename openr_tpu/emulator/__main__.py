@@ -72,9 +72,15 @@ async def main() -> None:
     print(f"starting {args.nodes} nodes, {len(edges)} links ({args.topo})")
     t0 = time.perf_counter()
     await cluster.start()
-    # convergence wall scales with oversubscription, like the Spark
-    # timers (cluster.scaled_spark): ~29 s at 196 nodes on one core
-    conv_timeout = max(60.0, args.nodes * 0.75)
+    # convergence wall derives from the SAME oversubscription scaling
+    # as the Spark timers (one source of truth — review finding): a
+    # 196-node grid converges in ~12 hold periods on one core; 36
+    # gives 3x headroom
+    from openr_tpu.emulator.cluster import scaled_spark
+
+    conv_timeout = max(
+        60.0, 36 * scaled_spark(args.nodes).hold_time_ms / 1000.0
+    )
     await cluster.wait_converged(timeout=conv_timeout)
     t_conv = time.perf_counter() - t0
     total_routes = sum(
