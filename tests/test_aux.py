@@ -307,3 +307,21 @@ def test_neighbor_events_logged_and_exposed():
         assert "NEIGHBOR_UP" in res.output
     finally:
         ct.stop()
+
+
+def test_emulator_scaled_spark_timers():
+    """Spark timers scale with emulation size (r5: a 100-node grid
+    livelocked in a hello-starvation flap storm under the fixed fast
+    timers); small clusters keep the fast defaults untouched."""
+    from openr_tpu.emulator.cluster import FAST_SPARK, scaled_spark
+
+    assert scaled_spark(2) is FAST_SPARK
+    assert scaled_spark(64) is FAST_SPARK
+    s100 = scaled_spark(100)
+    assert s100.hold_time_ms > FAST_SPARK.hold_time_ms * 2
+    assert s100.hello_time_ms > FAST_SPARK.hello_time_ms
+    # hold must stay comfortably above the hello interval (3+ hellos
+    # per hold — the FSM's loss tolerance)
+    assert s100.hold_time_ms >= 3 * s100.hello_time_ms
+    s196 = scaled_spark(196)
+    assert s196.hold_time_ms > s100.hold_time_ms
