@@ -116,3 +116,28 @@ def test_fleet_mpls_cache_reuse_and_trim():
     solver.trim_caches()
     assert len(solver._mpls_cache) <= 8
     assert solver._mpls_fingerprint_cap == 8
+
+
+def test_fleet_with_mesh_solver_equals_single_device():
+    """A mesh-configured solver (sharded split kernel over the virtual
+    8-device mesh) must produce the identical fleet of RouteDatabases —
+    the combined fleet+mesh path the all-sources production shape
+    uses. Uses a graph large enough that _pick_table chooses the split
+    tables (the mesh only shards that kernel)."""
+    from openr_tpu.parallel import make_mesh
+
+    adj_dbs, prefix_dbs = topogen.erdos_renyi(
+        120, avg_degree=5, seed=17, max_metric=16
+    )
+    ls, ps = _state(adj_dbs, prefix_dbs)
+    base_solver = TpuSpfSolver(native_rib="off", use_dense=False)
+    want = compute_fleet_ribs(ls, ps, solver=base_solver)
+    mesh_solver = TpuSpfSolver(
+        native_rib="off", use_dense=False,
+        mesh=make_mesh(n_sources=4, n_graph=2),
+    )
+    got = compute_fleet_ribs(ls, ps, solver=mesh_solver)
+    assert set(got) == set(want)
+    for node in want:
+        assert got[node].unicast_routes == want[node].unicast_routes, node
+        assert got[node].mpls_routes == want[node].mpls_routes, node
