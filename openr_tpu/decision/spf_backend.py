@@ -468,8 +468,10 @@ class TpuSpfSolver:
                 self._mesh_fallback_warned = True
                 log.warning(
                     "configured mesh is only used by the split kernel; "
-                    "%r-table solve runs single-device (set "
-                    "spf_kernel='split' / use_dense=False to shard)",
+                    "%r-table solve runs single-device (leave "
+                    "use_dense unset/None with spf_kernel='split' to "
+                    "shard — use_dense=False forces the unsharded "
+                    "edge kernel)",
                     table,
                 )
         if table == "split":

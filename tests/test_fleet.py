@@ -130,13 +130,20 @@ def test_fleet_with_mesh_solver_equals_single_device():
         120, avg_degree=5, seed=17, max_metric=16
     )
     ls, ps = _state(adj_dbs, prefix_dbs)
-    base_solver = TpuSpfSolver(native_rib="off", use_dense=False)
+    # use_dense must stay None (auto): False forces the EDGE kernel,
+    # which the mesh does not shard — the first version of this test
+    # was vacuous for exactly that reason (r5 review finding)
+    base_solver = TpuSpfSolver(native_rib="off")
     want = compute_fleet_ribs(ls, ps, solver=base_solver)
     mesh_solver = TpuSpfSolver(
-        native_rib="off", use_dense=False,
+        native_rib="off",
         mesh=make_mesh(n_sources=4, n_graph=2),
     )
     got = compute_fleet_ribs(ls, ps, solver=mesh_solver)
+    # non-vacuousness: the solver must have picked the split tables
+    # (the only kernel the mesh shards) and never fallen back
+    assert mesh_solver._pick_table(ls.to_csr()) == "split"
+    assert not mesh_solver._mesh_fallback_warned
     assert set(got) == set(want)
     for node in want:
         assert got[node].unicast_routes == want[node].unicast_routes, node
