@@ -1,15 +1,19 @@
 """One-shot real-chip validation for the session's kernel work.
 
 Run when the axon tunnel is healthy:  python benchmarks/validate_session.py
-Prints, in order (each flushed as it lands, in case the tunnel dies):
+Each row is flushed as it lands, most valuable first (the tunnel can
+wedge mid-run — round-5 postmortem), so a partial run is still evidence:
   1. fused production solve wall p50 at 100k (tpu.solve: GS kernel +
      packed ~0.8 MB transfer) — the headline quantity;
-  2. pure-kernel p50 via scalar drain (compare: 287 ms pre-GS);
-  3. B=256 all-sources solve (compare: 505.6 ms);
+  5. in-run oracle spot check (3 roots vs native C++ Dijkstra) —
+     host+native-side, printed immediately after the headline so every
+     later timing carries an already-printed oracle row;
   4. warm full-RIB p50 (solve + assembly with the entry/class caches);
   4b. hop-count-regime solve p50 (uniform metrics — same compiled
      kernel, ~5-8 sweeps; the north-star regime, docs/scaling.md §3);
-  5. in-run oracle spot check (3 roots vs native C++ Dijkstra).
+  2. pure-kernel p50 via scalar drain (compare: 287 ms pre-GS);
+  3. B=256 all-sources solve (compare: 505.6 ms).
+(Row labels keep their historic numbers; order is window economics.)
 """
 
 from __future__ import annotations
@@ -48,12 +52,53 @@ def main() -> None:
     t = p50(lambda: tpu.solve(ls, "node-0"))
     print(f"1. fused solve wall p50      : {t:8.1f} ms", flush=True)
 
+    my_id = csr.name_to_id["node-0"]
+
+    # oracle spot check FIRST (window economics, round-5 postmortem:
+    # the tunnel can wedge mid-run; this check is host+native-side
+    # apart from one solve, so run it while the window is known-alive
+    # — every later timing then carries an already-printed oracle row)
+    from openr_tpu.ops.native_spf import OutCsr, native_available
+
+    solved = tpu.solve(ls, "node-0")
+    _csr_s, dist, fh, nbr_ids, _ = solved
+    if native_available():
+        oc = OutCsr.from_arrays(
+            csr.edge_src, csr.edge_dst, csr.edge_metric, csr.padded_nodes
+        )
+        ok = True
+        full = np.asarray(dist)
+        for col, r in enumerate([my_id] + [int(x) for x in nbr_ids[:2]]):
+            ref = oc.dijkstra(r)
+            m = min(len(ref), full.shape[0])
+            ok &= bool((ref[:m] == full[:m, col]).all())
+        print(f"5. oracle (3 roots)          : {'ok' if ok else 'MISMATCH'}",
+              flush=True)
+    else:
+        print("5. oracle: native lib not built", flush=True)
+
+    def full_rib():
+        return tpu.compute_routes(ls, ps, "node-0")
+
+    t = p50(full_rib, n=5, warm=2)
+    print(f"4. warm full RIB p50         : {t:8.1f} ms", flush=True)
+
+    # hop-count metric regime (Open/R default; same table shapes → the
+    # SAME compiled kernel, ~5-8 sweeps instead of ~19): the regime the
+    # <10 ms north star is reachable in on v5e-4 (docs/scaling.md §3)
+    ls_hop, _ps_hop, _csr_hop = erdos_renyi_lsdb(
+        100_000, avg_degree=20, seed=0, max_metric=1
+    )
+    tpu.solve(ls_hop, "node-0")  # upload + warm
+    t = p50(lambda: tpu.solve(ls_hop, "node-0"), n=5, warm=1)
+    print(f"4b. hop-regime solve wall p50 : {t:8.1f} ms  "
+          "(projected ~40 pre-d-loop)", flush=True)
+
     import jax.numpy as jnp
 
     dev = tpu._device_arrays(csr, "split")
     from openr_tpu.ops.spf_split import batched_sssp_split
 
-    my_id = csr.name_to_id["node-0"]
     roots = np.full(32, my_id, np.int32)
 
     def solve_scalar():
@@ -75,43 +120,6 @@ def main() -> None:
 
     t = p50(solve_b256, n=3, warm=1)
     print(f"3. B=256 solve p50           : {t:8.1f} ms  (r3s1: 505.6)", flush=True)
-
-    def full_rib():
-        return tpu.compute_routes(ls, ps, "node-0")
-
-    t = p50(full_rib, n=5, warm=2)
-    print(f"4. warm full RIB p50         : {t:8.1f} ms", flush=True)
-
-    # hop-count metric regime (Open/R default; same table shapes → the
-    # SAME compiled kernel, ~5-8 sweeps instead of ~19): the regime the
-    # <10 ms north star is reachable in on v5e-4 (docs/scaling.md §3)
-    ls_hop, _ps_hop, _csr_hop = erdos_renyi_lsdb(
-        100_000, avg_degree=20, seed=0, max_metric=1
-    )
-    tpu.solve(ls_hop, "node-0")  # upload + warm
-    t = p50(lambda: tpu.solve(ls_hop, "node-0"), n=5, warm=1)
-    print(f"4b. hop-regime solve wall p50 : {t:8.1f} ms  "
-          "(projected ~40 pre-d-loop)", flush=True)
-
-    # oracle spot check
-    from openr_tpu.ops.native_spf import OutCsr, native_available
-
-    solved = tpu.solve(ls, "node-0")
-    _csr, dist, fh, nbr_ids, _ = solved
-    if native_available():
-        oc = OutCsr.from_arrays(
-            csr.edge_src, csr.edge_dst, csr.edge_metric, csr.padded_nodes
-        )
-        ok = True
-        full = np.asarray(dist)
-        for col, r in enumerate([my_id] + [int(x) for x in nbr_ids[:2]]):
-            ref = oc.dijkstra(r)
-            m = min(len(ref), full.shape[0])
-            ok &= bool((ref[:m] == full[:m, col]).all())
-        print(f"5. oracle (3 roots)          : {'ok' if ok else 'MISMATCH'}",
-              flush=True)
-    else:
-        print("5. oracle: native lib not built", flush=True)
 
 
 if __name__ == "__main__":
