@@ -43,6 +43,11 @@ def p50(fn, n=7, warm=2):
 
 
 def main() -> None:
+    import bench
+
+    bench.acquire_bench_lock()  # single-chip serialization with the
+    # driver's bench run (yieldable under the watcher's ON_UP)
+
     import jax
 
     print(f"# device: {jax.devices()[0]}", flush=True)

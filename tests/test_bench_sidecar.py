@@ -101,6 +101,51 @@ def test_salvage_done_stage_is_complete_not_partial(bench_mod, capsys):
     assert row["detail"]["tpu_run"].startswith("complete")
 
 
+def test_lock_stale_holder_swept_and_acquired(bench_mod, tmp_path,
+                                              monkeypatch):
+    """A lockfile whose pid is dead is stale — acquire must sweep it
+    and take the lock rather than waiting out the budget."""
+    bench, _ = bench_mod
+    lock = tmp_path / "bench.lock"
+    monkeypatch.setattr(bench, "_LOCK_PATH", str(lock))
+    lock.write_text(json.dumps({"pid": 2 ** 22 + 12345,
+                                "yieldable": False}))
+    monkeypatch.setenv("OPENR_BENCH_LOCK_WAIT", "5")
+    bench.acquire_bench_lock()
+    st = json.loads(lock.read_text())
+    assert st["pid"] == __import__("os").getpid()
+    bench._release_bench_lock()
+    assert not lock.exists()
+
+
+def test_lock_yieldable_holder_killed_by_driver_run(bench_mod, tmp_path,
+                                                    monkeypatch):
+    """A non-yieldable (driver) run must kill a yieldable (watcher
+    ON_UP) holder's process group and proceed — the driver's slot
+    always wins the single chip."""
+    import os
+    import subprocess
+
+    bench, _ = bench_mod
+    lock = tmp_path / "bench.lock"
+    monkeypatch.setattr(bench, "_LOCK_PATH", str(lock))
+    # a holder in its OWN session/pgroup (as the watcher's is relative
+    # to the driver), sleeping forever
+    holder = subprocess.Popen(
+        [__import__("sys").executable, "-c", "import time; time.sleep(600)"],
+        start_new_session=True,
+    )
+    lock.write_text(json.dumps({"pid": holder.pid, "yieldable": True}))
+    monkeypatch.setenv("OPENR_BENCH_LOCK_WAIT", "30")
+    monkeypatch.delenv("OPENR_BENCH_YIELDABLE", raising=False)
+    t0 = __import__("time").monotonic()
+    bench.acquire_bench_lock()
+    assert __import__("time").monotonic() - t0 < 25  # killed, not waited
+    assert holder.wait(timeout=10) != 0  # SIGTERM/SIGKILLed
+    assert json.loads(lock.read_text())["pid"] == os.getpid()
+    bench._release_bench_lock()
+
+
 def test_salvage_refuses_headline_less_and_cpu_rows(bench_mod, capsys):
     bench, sidecar = bench_mod
     # died before the first timed iteration: stage info only
