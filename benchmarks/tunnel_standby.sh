@@ -1,0 +1,73 @@
+#!/bin/bash
+# Hot-standby tunnel detector, complementing tunnel_watch.sh's poller.
+#
+# A child process sits in jax backend init, which HANGS while the axon
+# tunnel is down and completes within seconds once it recovers — so if
+# the plugin's init retries its connection, detection latency is ~0
+# instead of the poller's ~interval. The child exits immediately after
+# ONE confirmed dispatch: holding an initialized backend would block
+# every other client's init on the single chip (observed 2026-07-31:
+# a probe hangs while another process holds the tunnel).
+#
+# Unknown plugin semantics guarded against: an init that began while
+# the tunnel was down may never notice a recovery, so the hanging child
+# is recycled every STANDBY_MAXWAIT seconds (default 240) — worst-case
+# detection stays bounded and the polling watcher remains the backstop.
+# If init completes but the first dispatch wedges, the same timeout
+# reaps it.
+#
+# On a DOWN->UP transition, runs $ON_UP ONCE per transition (same latch
+# contract as tunnel_watch.sh); the measurement commands inside it
+# should set OPENR_BENCH_YIELDABLE=1 so the driver's own bench slot can
+# take the chip over (bench.py lock protocol).
+LOG=${1:-benchmarks/logs/tunnel_standby.log}
+MAXWAIT=${STANDBY_MAXWAIT:-240}
+mkdir -p "$(dirname "$LOG")"
+was_up=0
+while true; do
+  t0=$(date +%s)
+  # the probe REPORTS its own platform via exit code (3 = resolved to
+  # the cpu fallback, not a live tunnel) — string-matching merged
+  # stdout/stderr is unreliable when warnings trail the result line
+  out=$(timeout -k 10 "$MAXWAIT" python -u -c "
+import sys, time
+t0 = time.time()
+import jax
+d = jax.devices()[0]
+if d.platform == 'cpu':
+    sys.exit(3)
+import jax.numpy as jnp
+import numpy as np
+x = jnp.ones((128, 128))
+y = np.asarray(x @ x)  # one real dispatch, host-materialized
+print(f'{d.platform} {d} init+dispatch {time.time()-t0:.1f}s')
+" 2>&1)
+  rc=$?
+  t1=$(date +%s)
+  last=$(printf '%s' "$out" | tail -1)
+  if [ "$rc" -eq 0 ]; then
+    if [ "$was_up" -eq 0 ]; then
+      echo "$(date -u +%H:%M:%S) UP-DETECTED after $((t1-t0))s in init-wait: $last" >> "$LOG"
+      if [ -n "$ON_UP" ]; then
+        echo "$(date -u +%H:%M:%S) standby ON_UP firing" >> "$LOG"
+        bash -c "$ON_UP" >> "$LOG" 2>&1
+        echo "$(date -u +%H:%M:%S) standby ON_UP done" >> "$LOG"
+      fi
+    fi
+    was_up=1
+    sleep 120  # still up; re-confirm occasionally without stacking clients
+  elif [ "$rc" -eq 3 ]; then
+    # jax fell back to the cpu backend instead of hanging — the fast
+    # exit would otherwise busy-spin a jax import every ~10 s; poll at
+    # the watcher's cadence instead
+    echo "$(date -u +%H:%M:%S) cpu-fallback cycle ($((t1-t0))s) — not a live tunnel" >> "$LOG"
+    was_up=0
+    sleep 180
+  else
+    # rc 124/137 = still down (init never returned); anything else is
+    # an import/device error worth reading in the tail
+    echo "$(date -u +%H:%M:%S) still-down cycle (rc=$rc, $((t1-t0))s) $last" >> "$LOG"
+    was_up=0
+    sleep 5
+  fi
+done
