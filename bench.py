@@ -315,7 +315,7 @@ _LOCK_PATH = os.path.join(
 )
 
 
-def acquire_bench_lock() -> None:
+def acquire_bench_lock(yieldable: bool | None = None) -> None:
     """Serialize chip access between the driver's bench run and the
     tunnel-watcher's ON_UP measurement (single real TPU: two
     concurrent measurers make the second hang in dispatch, which is
@@ -328,10 +328,16 @@ def acquire_bench_lock() -> None:
     slot always wins. Equal-priority contenders wait for the holder to
     exit, bounded by OPENR_BENCH_LOCK_WAIT (default 1800 s), then
     proceed anyway: contention is still better than a lost slot.
-    Stale locks (dead pid) are swept. validate_session.py imports and
-    calls this too.
+    Stale locks (dead pid) are swept.
+
+    The auxiliary harnesses (validate_session, bench_ksp_lfa,
+    bench_fleet) call this with yieldable=True unconditionally: kill
+    privilege belongs ONLY to a bench.py run without the env flag —
+    i.e. the driver's entry point — so a casual auxiliary run can
+    never destroy a live ON_UP measurement (review finding).
     """
-    yieldable = _env_flag("OPENR_BENCH_YIELDABLE")
+    if yieldable is None:
+        yieldable = _env_flag("OPENR_BENCH_YIELDABLE")
     deadline = time.monotonic() + int(
         os.environ.get("OPENR_BENCH_LOCK_WAIT", "1800")
     )

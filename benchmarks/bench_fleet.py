@@ -34,6 +34,13 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # real-chip run: serialize against the driver's bench slot;
+        # always yieldable — an auxiliary harness must never kill a
+        # live measurement (bench.py lock protocol)
+        import bench
+
+        bench.acquire_bench_lock(yieldable=True)
 
     from openr_tpu.decision.fleet import compute_fleet_ribs
     from openr_tpu.decision.linkstate import LinkState, PrefixState

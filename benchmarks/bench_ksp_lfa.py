@@ -80,6 +80,13 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # real-chip run: serialize against the driver's bench slot;
+        # always yieldable — an auxiliary harness must never kill a
+        # live measurement (bench.py lock protocol)
+        import bench
+
+        bench.acquire_bench_lock(yieldable=True)
 
     from openr_tpu.decision.linkstate import LinkState, PrefixState
     from openr_tpu.decision.oracle import compute_routes as oracle_routes

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Window playbook: everything to measure when the tunnel comes up,
+# most valuable first, each step individually time-boxed — a mid-window
+# wedge still leaves every earlier artifact on disk.
+#
+# Wire as the ON_UP hook of tunnel_watch.sh / tunnel_standby.sh:
+#   ON_UP='bash benchmarks/on_up_measure.sh' ...
+# Steps (all yieldable to the driver's own bench slot via the bench.py
+# lock protocol):
+#   1. bench.py            — the headline row (sidecar-salvaged on wedge)
+#   2. bench_ksp_lfa 10k   — BASELINE config 4 on-chip (verdict ask)
+#   3. bench_fleet k=16    — all-nodes batch amortization, the TPU's win
+#   4. validate_session    — scalar-drain kernel p50 + B=256 extras
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%H%M)
+L=benchmarks/logs
+mkdir -p "$L"
+
+# Cross-process once-per-window dedup: BOTH detectors may latch a
+# DOWN->UP transition for the same window (each other's probes hang
+# against a running chain and reset the sibling's latch), so the chain
+# itself refuses to start within COOLDOWN of the last start. mkdir is
+# the atomic claim; a stale claim older than COOLDOWN is taken over.
+COOLDOWN=${ONUP_COOLDOWN_S:-2700}
+CLAIM="$L/onup_claim"
+now=$(date +%s)
+if [ -d "$CLAIM" ]; then
+  last=$(stat -c %Y "$CLAIM" 2>/dev/null || echo 0)
+  if [ $((now - last)) -lt "$COOLDOWN" ]; then
+    echo "[$(date -u +%H:%M:%S)] on_up_measure deduped (last chain started $((now - last))s ago < ${COOLDOWN}s cooldown)"
+    exit 0
+  fi
+  rmdir "$CLAIM" 2>/dev/null || rm -rf "$CLAIM"
+fi
+if ! mkdir "$CLAIM" 2>/dev/null; then
+  echo "[$(date -u +%H:%M:%S)] on_up_measure deduped (concurrent chain holds the claim)"
+  exit 0
+fi
+
+export OPENR_BENCH_YIELDABLE=1
+# the lock-wait budget must exceed the largest step timeout, or an
+# equal-priority contender would "proceed unserialized" mid-window
+export OPENR_BENCH_LOCK_WAIT=${OPENR_BENCH_LOCK_WAIT:-3000}
+echo "[$(date -u +%H:%M:%S)] on_up_measure start (ts=$ts)"
+timeout -k 30 2400 python bench.py \
+  > "$L/bench_onup_${ts}.out" 2>&1
+rc=$?
+echo "[$(date -u +%H:%M:%S)] bench.py done rc=$rc"
+timeout -k 30 1200 python benchmarks/bench_ksp_lfa.py \
+  --rings 626 --ring-size 16 \
+  > "$L/ksp_onup_${ts}.out" 2>&1
+rc=$?
+echo "[$(date -u +%H:%M:%S)] bench_ksp_lfa done rc=$rc"
+timeout -k 30 900 python benchmarks/bench_fleet.py --k 16 \
+  > "$L/fleet_onup_${ts}.out" 2>&1
+rc=$?
+echo "[$(date -u +%H:%M:%S)] bench_fleet done rc=$rc"
+timeout -k 30 1200 python benchmarks/validate_session.py \
+  > "$L/validate_onup_${ts}.out" 2>&1
+rc=$?
+echo "[$(date -u +%H:%M:%S)] validate_session done rc=$rc"

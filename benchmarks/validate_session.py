@@ -45,8 +45,9 @@ def p50(fn, n=7, warm=2):
 def main() -> None:
     import bench
 
-    bench.acquire_bench_lock()  # single-chip serialization with the
-    # driver's bench run (yieldable under the watcher's ON_UP)
+    # single-chip serialization with the driver's bench run; always
+    # yieldable — kill privilege is reserved for bench.py itself
+    bench.acquire_bench_lock(yieldable=True)
 
     import jax
 
