@@ -1,0 +1,53 @@
+"""Batch-width scaling family for the v3 split kernel on the real chip.
+
+The gather-bound relax is rows-bound, so widening B amortizes sweeps
+over more sources at near-constant cost until the [VP, B] state and the
+W per-column gathers saturate HBM. This probe measures the real curve
+(B = 32..512 at 100k/2.2M) to anchor docs/scaling.md's all-sources and
+v5e-4 numbers with hardware rows instead of the B=256 single point.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import numpy as np
+
+from openr_tpu.decision.spf_backend import TpuSpfSolver
+from openr_tpu.utils.topogen import erdos_renyi_lsdb
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+ITERS = int(os.environ.get("BFAM_ITERS", "4"))
+FAMILY = (32, 64, 128, 256, 512)
+
+print(f"# device: {jax.devices()[0].device_kind}  N={N}", flush=True)
+ls, ps, csr0 = erdos_renyi_lsdb(N, avg_degree=22, seed=0, max_metric=64)
+tpu = TpuSpfSolver(native_rib="off")
+csr = ls.to_csr()
+
+for b in FAMILY:
+    roots = np.arange(b, dtype=np.int32) % csr.num_nodes
+    try:
+        dist = tpu._solve_dist(csr, roots)  # compile + warm
+        float(np.asarray(dist[:, 0]).sum())
+        times = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            dist = tpu._solve_dist(csr, roots)
+            float(np.asarray(dist[:, 0]).sum())
+            times.append((time.perf_counter() - t0) * 1e3)
+        p50 = float(np.percentile(times, 50))
+        print(
+            f"  B={b:4d}  solve p50 {p50:8.1f} ms  (min {min(times):7.1f})"
+            f"  {b / (p50 / 1e3):7.1f} sources/s",
+            flush=True,
+        )
+    except Exception as e:  # OOM at the wide end is a result, not a crash
+        print(f"  B={b:4d}  FAILED: {type(e).__name__}: {e}", flush=True)
+        break
