@@ -446,6 +446,58 @@ def _release_bench_lock() -> None:
         pass
 
 
+def _load_prior_tpu_row() -> dict | None:
+    """Best committed real-TPU headline from an earlier tunnel window.
+
+    A degraded (fallback/smoke) run embeds it under
+    `detail.prior_real_tpu_row` with full provenance so the artifact
+    still surfaces the hardware measurement — clearly labeled as a
+    PRIOR run, never as this run's value (the top-level metric/value
+    stay the truthful degraded numbers). Source files are the committed
+    window logs (`benchmarks/logs/bench_r5_tpu_window_*.json`), newest
+    parseable first; each must itself be a non-degraded TPU row.
+    """
+    logs = Path(__file__).parent / "benchmarks" / "logs"
+    # newest by mtime: the HHMM in the filename is not ordered across
+    # days (review finding)
+    cands = sorted(
+        logs.glob("bench_r5_tpu_window_*.json"),
+        key=lambda p: p.stat().st_mtime,
+        reverse=True,
+    )
+    for p in cands:
+        try:
+            last = p.read_text().strip().splitlines()[-1]
+            row = json.loads(last)
+            if not isinstance(row, dict):
+                continue
+            det = row.get("detail")
+            if not isinstance(det, dict):
+                continue
+            if row.get("degraded") or det.get("platform") != "tpu":
+                continue
+            return {
+                "note": (
+                    "prior real-TPU measurement from a committed tunnel "
+                    "window, NOT this run"
+                ),
+                "source_log": f"benchmarks/logs/{p.name}",
+                "metric": row.get("metric"),
+                "value": row.get("value"),
+                "unit": row.get("unit"),
+                "device": det.get("device"),
+                "full_rib_ms": det.get("full_rib_ms"),
+                "hop_metric_solve_ms": det.get("hop_metric_solve_ms"),
+                "tpu_b256_sources_per_sec": det.get(
+                    "tpu_b256_sources_per_sec"
+                ),
+                "oracle_check": det.get("oracle_check"),
+            }
+        except (OSError, ValueError, IndexError, AttributeError, TypeError):
+            continue
+    return None
+
+
 def main() -> None:
     """Slot strategy (round-4 postmortem): one short probe, measure on
     CPU IMMEDIATELY if it fails, then re-probe once — so an intermittent
@@ -787,6 +839,9 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     }
     if degraded:
         out["degraded"] = True
+        prior = _load_prior_tpu_row()
+        if prior is not None:
+            detail["prior_real_tpu_row"] = prior
     out["detail"] = detail
     part["stage"] = "done"
     _sidecar_flush(part)
