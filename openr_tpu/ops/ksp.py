@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.ops.spf import DIST_DTYPE, INF_DIST
+from openr_tpu.ops.spf_split import _UNROLL_MAX_W
 
 
 def build_ksp_blocked(
@@ -90,9 +91,31 @@ def ksp_edge_disjoint_dense(
         usable = (~blocked[:, :, None]) & (~banned) & (
             wgt[:, :, None] < INF_DIST
         )
+        width = nbr.shape[1]
 
         def relax(state):
             dist, _changed, it = state
+            if width <= _UNROLL_MAX_W:  # shared bound with spf_split
+                # d-loop of [Vp]-row gathers — the measured-fastest
+                # gather form on v5e (0.609 G rows/s vs 0.26-0.35 for
+                # the single [Vp, D]-index gather; probe_gather_forms,
+                # docs/spf_kernel_profile.md §2), ported from the
+                # headline split kernel. Same fixpoint, same guarded
+                # select (the 2-op algebraic form measured SLOWER on
+                # chip — see the 2026-07-31 negative result).
+                acc = jnp.full_like(dist, INF_DIST)
+                for col in range(width):
+                    g = dist[nbr[:, col]]  # [Vp, B] row gather
+                    c = jnp.where(
+                        usable[:, col, :] & (g < INF_DIST),
+                        jnp.minimum(
+                            g + wgt[:, col][:, None], INF_DIST
+                        ),
+                        INF_DIST,
+                    )
+                    acc = jnp.minimum(acc, c)
+                new = jnp.minimum(acc, dist)
+                return new, jnp.any(new < dist), it + 1
             d = dist[nbr]  # [Vp, D, B]
             cand = jnp.where(
                 usable & (d < INF_DIST),

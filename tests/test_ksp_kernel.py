@@ -181,3 +181,34 @@ def test_ksp_kernel_dist0_path_byte_equal(seed):
     np.testing.assert_array_equal(np.asarray(ref_c), np.asarray(got_c))
     np.testing.assert_array_equal(np.asarray(ref_p), np.asarray(got_p))
     np.testing.assert_array_equal(np.asarray(ref_h), np.asarray(got_h))
+
+
+def test_ksp_relax_branches_agree(monkeypatch):
+    """The unrolled d-loop relax (width <= _UNROLL_MAX_W) and the wide
+    [Vp, D, B] gather fallback are the same fixpoint: run the kernel's
+    undecorated function with the unroll bound forced to 0 (wide
+    branch) and compare byte-for-byte against the normal jitted path
+    (unrolled branch — every test graph is narrow). Guards the
+    otherwise-dead wide branch and the branch equivalence itself."""
+    import openr_tpu.ops.ksp as ksp_mod
+
+    rng = np.random.default_rng(7)
+    n = 24
+    adj, nbr, wgt, names = random_graph(rng, n)
+    over_mask = np.zeros(n, dtype=bool)
+    over_mask[3] = True
+    root_id = 0
+    dests = np.array([2, 5, 9, 17], dtype=np.int32)
+    blocked = build_ksp_blocked(nbr, over_mask, root_id)
+    args = (nbr, wgt, blocked, np.int32(root_id), dests)
+    ref = ksp_edge_disjoint_dense(*args, k=4, max_hops=n - 1)
+
+    monkeypatch.setattr(ksp_mod, "_UNROLL_MAX_W", 0)
+    wrapped = ksp_edge_disjoint_dense.__wrapped__  # undecorated: fresh trace
+    import jax
+
+    wide = jax.jit(wrapped, static_argnames=("k", "max_hops"))(
+        *args, k=4, max_hops=n - 1
+    )
+    for a, b in zip(ref, wide):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
