@@ -1,19 +1,33 @@
-import sys, os
-sys.path.insert(0, "/root/repo")
+"""Sweep-count probe behind the KSP Gauss-Seidel negative result.
+
+Counts fixpoint sweeps of the config-4 ring-of-rings SSSP under plain
+Jacobi, forward Gauss-Seidel chunking (gs=4/8/16), and
+alternating-direction chunking. Measured: 73 / 71 / 69 — chunk order
+cannot beat the hop-limited dependency chain (a boundary only helps
+when the frontier is AT it). Full analysis:
+docs/spf_kernel_profile.md, "Negative result #2".
+"""
+
+from pathlib import Path
+import os
+import sys
+
+REPO = str(Path(__file__).resolve().parent.parent)
+sys.path.insert(0, REPO)
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 import importlib.util
-spec = importlib.util.spec_from_file_location("bkl", "/root/repo/benchmarks/bench_ksp_lfa.py")
+spec = importlib.util.spec_from_file_location("bkl", REPO + "/benchmarks/bench_ksp_lfa.py")
 m = importlib.util.module_from_spec(spec)
 import types
 sys.modules["bkl"] = m
 # exec only the topology builder by importing module without main
-src = open("/root/repo/benchmarks/bench_ksp_lfa.py").read()
+src = open(REPO + "/benchmarks/bench_ksp_lfa.py").read()
 ns = {}
-ns["__file__"] = "/root/repo/benchmarks/bench_ksp_lfa.py"
+ns["__file__"] = REPO + "/benchmarks/bench_ksp_lfa.py"
 exec(compile(src.split("def main(")[0], "bkl", "exec"), ns)
 dbs = ns["build_backbone"](128, 16)
 from openr_tpu.decision.linkstate import LinkState
