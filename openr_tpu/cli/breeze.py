@@ -324,6 +324,33 @@ def decision_adj(ctx):
     click.echo(_table(rows, ["area", "node", "neighbor", "metric"]))
 
 
+@decision.command("path")
+@click.argument("dst")
+@click.option("--src", default="", help="source node (default: this node)")
+@click.option("--area", default="", help="restrict to one area")
+@click.pass_context
+def decision_path(ctx, dst, src, area):
+    """Shortest path to DST from Decision's LSDB (reference: breeze
+    decision path †)."""
+    params = {"dst": dst}
+    if src:
+        params["src"] = src
+    if area:
+        params["area"] = area
+    res = _run(ctx, "get_spf_path", params)
+    if not res.get("reachable"):
+        click.echo(f"{res.get('src', src)} -> {dst}: unreachable")
+        raise SystemExit(1)
+    hops = res["hops"]
+    metrics = res.get("hop_metrics", [])
+    rows = [
+        [i, u, metrics[i] if i < len(metrics) else ""]
+        for i, u in enumerate(hops)
+    ]
+    click.echo(_table(rows, ["hop", "node", "metric-to-next"]))
+    click.echo(f"total cost {res['cost']} ({len(hops) - 1} hops)")
+
+
 @decision.command("received-routes")
 @click.pass_context
 def decision_received(ctx):

@@ -130,6 +130,7 @@ class CtrlServer(OpenrModule):
             "get_kvstore_flood_topo", "validate",
             "get_route_db_computed", "get_route_db_programmed",
             "get_decision_adjacency_dbs", "get_received_routes",
+            "get_spf_path",
             "get_interfaces", "set_node_overload", "set_interface_metric",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
@@ -365,6 +366,15 @@ class CtrlServer(OpenrModule):
     async def get_received_routes(self, params: dict) -> dict:
         """reference: getReceivedRoutesFiltered † — prefix DB view."""
         return to_jsonable(self.node.decision.get_received_routes())
+
+    async def get_spf_path(self, params: dict) -> dict:
+        """reference: breeze `decision path` † — shortest path between
+        two nodes from Decision's LSDB (src defaults to this node)."""
+        src = params.get("src") or self.node.name
+        dst = params["dst"]
+        return self.node.decision.get_spf_path(
+            src, dst, params.get("area")
+        )
 
     async def subscribe_fib(self, params: dict, stream) -> None:
         """reference: subscribeAndGetFib † — programmed-route stream."""

@@ -776,6 +776,63 @@ class Decision(OpenrModule):
     def get_route_db(self) -> RouteDatabase:
         return self.rib
 
+    def get_spf_path(
+        self, src: str, dst: str, area: str | None = None
+    ) -> dict:
+        """Deterministic shortest path src→dst from the current LSDB
+        (reference: breeze `decision path` † — upstream answers the
+        same operator question with a host-side query). One path query
+        is host work: same adjacency build, overload semantics, and
+        smallest-name tie-break rule as the oracle/KSP backends, so
+        the answer is byte-consistent with the computed RIB.
+        """
+        from openr_tpu.decision.ksp import dijkstra, extract_path
+        from openr_tpu.decision.oracle import build_adjacency
+
+        from openr_tpu.common.constants import DIST_INF
+
+        areas = (
+            [area] if area is not None else sorted(self._link_states)
+        )
+        # border nodes can sit in several areas: answer with the best
+        # reachable path across every candidate area, not whatever the
+        # first sorted area says (review finding)
+        best: dict | None = None
+        for a in areas:
+            ls = self._link_states.get(a)
+            if ls is None or src not in ls.nodes or dst not in ls.nodes:
+                continue
+            if src == dst:
+                return {
+                    "area": a, "src": src, "dst": dst,
+                    "reachable": True, "cost": 0, "hops": [src],
+                    "hop_metrics": [],
+                }
+            adj = build_adjacency(ls)
+            overloaded = {
+                n for n in ls.nodes if ls.is_node_overloaded(n)
+            }
+            dist = dijkstra(adj, src, overloaded)
+            # same DIST_INF saturation cutoff as oracle.run_spf and the
+            # device kernels: a cost at or past the sentinel is
+            # unreachable in the computed RIB (review finding)
+            if dist.get(dst, DIST_INF) >= DIST_INF:
+                continue
+            hops = extract_path(adj, dist, src, dst, overloaded)
+            if hops is None:
+                continue
+            if best is None or int(dist[dst]) < best["cost"]:
+                # extract_path returns root→dest order
+                best = {
+                    "area": a, "src": src, "dst": dst,
+                    "reachable": True, "cost": int(dist[dst]),
+                    "hops": hops,
+                    "hop_metrics": [
+                        int(adj[u][v]) for u, v in zip(hops, hops[1:])
+                    ],
+                }
+        return best or {"src": src, "dst": dst, "reachable": False}
+
     def get_adj_dbs(self) -> dict[str, list[AdjacencyDatabase]]:
         return {
             area: [db for n in ls.nodes if (db := ls.adjacency_db(n))]

@@ -156,3 +156,10 @@ def test_prefixmgr_advertise_view_withdraw(live):
 def test_monitor_counters(live):
     out = invoke(live, "a", "monitor", "counters", "--prefix", "kvstore.")
     assert "kvstore." in out
+
+
+def test_decision_path(live):
+    out = invoke(live, "a", "decision", "path", "c")
+    assert "total cost" in out and "b" in out  # a->b->c on the line
+    out = invoke(live, "a", "decision", "path", "a", "--src", "c")
+    assert "total cost" in out

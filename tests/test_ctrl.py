@@ -267,3 +267,31 @@ def test_validate_healthy_cluster():
             await c.stop()
 
     run(main())
+
+
+def test_get_spf_path():
+    """breeze `decision path` analogue: a→c crosses b on the line
+    topology; unreachable and self queries answer sanely."""
+
+    async def body():
+        c = await _converged_cluster()
+        cli = await _client_for(c.nodes["a"])
+
+        res = await cli.call("get_spf_path", {"dst": "c"})
+        assert res["reachable"] and res["hops"] == ["a", "b", "c"]
+        assert res["cost"] == sum(res["hop_metrics"])
+        assert len(res["hop_metrics"]) == 2
+
+        res = await cli.call("get_spf_path", {"src": "c", "dst": "a"})
+        assert res["hops"] == ["c", "b", "a"]
+
+        res = await cli.call("get_spf_path", {"dst": "a"})
+        assert res["reachable"] and res["hops"] == ["a"] and res["cost"] == 0
+
+        res = await cli.call("get_spf_path", {"dst": "nope"})
+        assert not res["reachable"]
+
+        await cli.close()
+        await c.stop()
+
+    run(body())
