@@ -124,6 +124,66 @@ def status(ctx):
         click.echo(f"  {gate}: {'pass' if st.get(gate) else 'PENDING'}")
 
 
+@cli.command("tech-support")
+@click.pass_context
+def tech_support(ctx):
+    """One-shot diagnostic roll-up (reference: breeze tech-support †):
+    identity, init gates, links, adjacencies, route/prefix counts, key
+    counters, and the validate verdict — everything a bug report needs
+    in one paste."""
+    name = _run(ctx, "get_my_node_name")
+    st = _run(ctx, "get_initialization_status")
+    click.echo(f"== node ==\n{name}")
+    click.echo("== initialization ==")
+    for gate, ok in sorted(st.items()):
+        click.echo(f"  {gate}: {'pass' if ok else 'PENDING'}")
+
+    ifaces = _run(ctx, "get_interfaces")
+    click.echo("== links ==")
+    click.echo(f"  node overloaded: {ifaces['is_overloaded']}")
+    for i in ifaces["interfaces"]:
+        click.echo(
+            f"  {i['name']}: up={i.get('is_up', True)} "
+            f"adjacencies={len(i.get('adjacencies', []))}"
+        )
+
+    adj = _run(ctx, "get_decision_adjacency_dbs")
+    for area, dbs in sorted(adj.items()):
+        n_adj = sum(len(db["adjacencies"]) for db in dbs)
+        click.echo(
+            f"== lsdb area {area} ==\n"
+            f"  {len(dbs)} nodes, {n_adj} adjacencies"
+        )
+
+    rdb = _run(ctx, "get_route_db_computed")
+    prog = _run(ctx, "get_route_db_programmed")
+    click.echo(
+        "== routes ==\n"
+        f"  computed: {len(rdb['unicast_routes'])} unicast, "
+        f"{len(rdb['mpls_routes'])} mpls\n"
+        f"  programmed: {len(prog['unicast_routes'])} unicast, "
+        f"{len(prog['mpls_routes'])} mpls"
+    )
+    advertised = _run(ctx, "get_advertised_prefixes")
+    click.echo(f"  advertised prefixes: {len(advertised)}")
+
+    counters = _run(ctx, "get_counters")
+    click.echo("== counters (non-zero) ==")
+    for k, v in sorted(counters.items()):
+        if v:
+            click.echo(f"  {k}: {v}")
+
+    res = _run(ctx, "validate")
+    click.echo("== validate ==")
+    bad = [c for c in res["checks"] if not c["pass"]]
+    for c in res["checks"]:
+        mark = "PASS" if c["pass"] else "FAIL"
+        click.echo(f"  [{mark}] {c['name']}")
+    click.echo("all checks passed" if not bad else f"{len(bad)} FAILING")
+    if bad:
+        raise SystemExit(1)
+
+
 @cli.command()
 @click.pass_context
 def validate(ctx):

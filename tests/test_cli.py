@@ -163,3 +163,12 @@ def test_decision_path(live):
     assert "total cost" in out and "b" in out  # a->b->c on the line
     out = invoke(live, "a", "decision", "path", "a", "--src", "c")
     assert "total cost" in out
+
+
+def test_tech_support(live):
+    out = invoke(live, "a", "tech-support")
+    for section in ("== node ==", "== initialization ==", "== links ==",
+                    "== routes ==", "== counters (non-zero) ==",
+                    "== validate =="):
+        assert section in out, section
+    assert "all checks passed" in out
