@@ -306,6 +306,66 @@ def kvstore_peers(ctx, area):
         click.echo(p)
 
 
+@kvstore.command("set-key")
+@click.argument("key")
+@click.argument("value")
+@click.option("--area", default=None)
+@click.option("--ttl", default=None, type=int, help="ttl ms (default: ∞)")
+@click.option(
+    "--version", default=None, type=int,
+    help="explicit version (default: current+1, so the write wins)",
+)
+@click.pass_context
+def kvstore_set_key(ctx, key, value, area, ttl, version):
+    """Debug write: originate KEY=VALUE as 'breeze' (reference: breeze
+    kvstore set-key †). Defaults to version current+1 so the merge total
+    order (version, originator, hash) accepts and floods it."""
+    from openr_tpu.types.kvstore import TTL_INFINITY
+
+    if version is None:
+        cur = _run(
+            ctx, "get_kvstore_keyvals", {"keys": [key], "area": area}
+        )["key_vals"]
+        version = int(cur.get(key, {}).get("version", 0)) + 1
+    raw = {
+        "version": version,
+        "originator_id": "breeze",
+        "value": {"__bytes__": value.encode().hex()},
+        "ttl": ttl if ttl is not None else TTL_INFINITY,
+        "ttl_version": 0,
+    }
+    _run(ctx, "set_kvstore_keyvals", {"key_vals": {key: raw}, "area": area})
+    click.echo(f"set {key} v{version}")
+
+
+@kvstore.command("erase-key")
+@click.argument("key")
+@click.option("--area", default=None)
+@click.option("--ttl", default=1000, show_default=True, type=int,
+              help="tombstone lifetime ms")
+@click.pass_context
+def kvstore_erase_key(ctx, key, area, ttl):
+    """Debug erase: re-originate KEY at version current+1 with a short
+    finite ttl, so the winning tombstone floods network-wide and then
+    expires out of every store (reference: breeze kvstore erase-key †,
+    same advertise-then-expire mechanism)."""
+    cur = _run(
+        ctx, "get_kvstore_keyvals", {"keys": [key], "area": area}
+    )["key_vals"]
+    if key not in cur:
+        click.echo(f"{key}: not present")
+        raise SystemExit(1)
+    raw = {
+        "version": int(cur[key].get("version", 0)) + 1,
+        "originator_id": "breeze",
+        "value": cur[key].get("value"),
+        "ttl": ttl,
+        "ttl_version": 0,
+    }
+    _run(ctx, "set_kvstore_keyvals", {"key_vals": {key: raw}, "area": area})
+    click.echo(f"erase {key}: tombstone v{raw['version']} ttl={ttl}ms")
+
+
 @kvstore.command("floodtopo")
 @click.option("--area", default=None)
 @click.pass_context

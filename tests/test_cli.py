@@ -9,6 +9,7 @@ invokes commands from the test thread.
 
 import asyncio
 import threading
+import time
 
 import pytest
 from click.testing import CliRunner
@@ -172,3 +173,28 @@ def test_tech_support(live):
                     "== validate =="):
         assert section in out, section
     assert "all checks passed" in out
+
+
+def test_kvstore_set_and_erase_key(live):
+    out = invoke(live, "a", "kvstore", "set-key", "debug:x", "hello")
+    assert "set debug:x v1" in out
+    out = invoke(live, "a", "kvstore", "keys", "--prefix", "debug:")
+    assert "debug:x" in out
+    # the write floods: node c sees it too
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "debug:x" in invoke(live, "c", "kvstore", "keys", "--prefix", "debug:"):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("debug:x never flooded to c")
+    out = invoke(live, "a", "kvstore", "erase-key", "debug:x", "--ttl", "400")
+    assert "tombstone v2" in out
+    # the tombstone expires out of the origin store
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "debug:x" not in invoke(live, "a", "kvstore", "keys", "--prefix", "debug:"):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("debug:x never expired")
