@@ -334,7 +334,15 @@ def kvstore_set_key(ctx, key, value, area, ttl, version):
         "ttl": ttl if ttl is not None else TTL_INFINITY,
         "ttl_version": 0,
     }
-    _run(ctx, "set_kvstore_keyvals", {"key_vals": {key: raw}, "area": area})
+    res = _run(
+        ctx, "set_kvstore_keyvals", {"key_vals": {key: raw}, "area": area}
+    )
+    if not res.get("accepted", {}).get(key, res.get("ok")):
+        click.echo(
+            f"REJECTED: {key} v{version} lost the merge (key moved "
+            "underneath us — retry without --version)"
+        )
+        raise SystemExit(1)
     click.echo(f"set {key} v{version}")
 
 
@@ -362,7 +370,12 @@ def kvstore_erase_key(ctx, key, area, ttl):
         "ttl": ttl,
         "ttl_version": 0,
     }
-    _run(ctx, "set_kvstore_keyvals", {"key_vals": {key: raw}, "area": area})
+    res = _run(
+        ctx, "set_kvstore_keyvals", {"key_vals": {key: raw}, "area": area}
+    )
+    if not res.get("accepted", {}).get(key, res.get("ok")):
+        click.echo(f"REJECTED: {key} moved underneath us — retry")
+        raise SystemExit(1)
     click.echo(f"erase {key}: tombstone v{raw['version']} ttl={ttl}ms")
 
 
@@ -579,6 +592,28 @@ def lm_set_link_metric(ctx, interface, metric):
 def lm_unset_link_metric(ctx, interface):
     _run(ctx, "set_interface_metric", {"interface": interface, "metric": None})
     click.echo(f"metric override cleared on {interface}")
+
+
+@lm.command("set-link-overload")
+@click.argument("interface")
+@click.pass_context
+def lm_set_link_overload(ctx, interface):
+    """Soft-drain one link: advertised with is_overloaded=True, every
+    solver routes around it while the adjacency stays up (reference:
+    breeze lm set-link-overload †)."""
+    _run(ctx, "set_interface_overload", {"interface": interface})
+    click.echo(f"link overload set on {interface}")
+
+
+@lm.command("unset-link-overload")
+@click.argument("interface")
+@click.pass_context
+def lm_unset_link_overload(ctx, interface):
+    _run(
+        ctx, "set_interface_overload",
+        {"interface": interface, "overload": False},
+    )
+    click.echo(f"link overload cleared on {interface}")
 
 
 # ------------------------------------------------------------------ prefixmgr

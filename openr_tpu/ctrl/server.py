@@ -132,6 +132,7 @@ class CtrlServer(OpenrModule):
             "get_decision_adjacency_dbs", "get_received_routes",
             "get_spf_path",
             "get_interfaces", "set_node_overload", "set_interface_metric",
+            "set_interface_overload",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
         ):
@@ -188,9 +189,14 @@ class CtrlServer(OpenrModule):
 
     async def set_kvstore_keyvals(self, params: dict) -> dict:
         area = self._area(params)
+        accepted: dict[str, bool] = {}
         for k, raw in (params.get("key_vals") or {}).items():
-            self.node.kvstore.set_key(area, k, value_from_json(raw).with_hash())
-        return {"ok": True}
+            accepted[k] = self.node.kvstore.set_key(
+                area, k, value_from_json(raw).with_hash()
+            )
+        # a merge-rejected write (stale version) must not read as
+        # success — the caller reports it (review finding)
+        return {"ok": all(accepted.values()), "accepted": accepted}
 
     async def dump_kvstore(self, params: dict) -> dict:
         area = self._area(params)
@@ -405,6 +411,14 @@ class CtrlServer(OpenrModule):
         metric = params.get("metric")
         self.node.linkmonitor.set_link_metric(
             params["interface"], int(metric) if metric is not None else None
+        )
+        return {"ok": True}
+
+    async def set_interface_overload(self, params: dict) -> dict:
+        """reference: setInterfaceOverload / unsetInterfaceOverload † —
+        soft-drain one link for maintenance."""
+        self.node.linkmonitor.set_link_overload(
+            params["interface"], bool(params.get("overload", True))
         )
         return {"ok": True}
 

@@ -177,7 +177,9 @@ def ksp2_nexthops(
         cands = [
             a
             for a in my_db.adjacencies
-            if a.other_node_name == v1 and not a.is_overloaded
+            if a.other_node_name == v1
+            and not a.is_overloaded
+            and not ls.link_drained_by_peer(my_node, a)
         ]
         if not cands:
             continue

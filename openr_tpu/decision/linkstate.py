@@ -306,6 +306,21 @@ class LinkState:
         db = self._adj_dbs.get(node)
         return bool(db and db.is_overloaded)
 
+    def link_drained_by_peer(self, me: str, adj) -> bool:
+        """Whether the far side of `me`'s adjacency has soft-drained
+        the link (its matching reverse adjacency is_overloaded) — a
+        drain from either side removes BOTH directions (reference:
+        setInterfaceOverload †; same rule as build_csr)."""
+        db = self._adj_dbs.get(adj.other_node_name)
+        if db is None:
+            return False
+        return any(
+            x.if_name == adj.other_if_name
+            and x.other_node_name == me
+            and x.is_overloaded
+            for x in db.adjacencies
+        )
+
     def node_label(self, node: str) -> int:
         db = self._adj_dbs.get(node)
         return db.node_label if db else 0
@@ -396,11 +411,20 @@ class LinkState:
         name_to_id = {n: i for i, n in enumerate(names)}
         v = len(names)
 
-        # Directed adjacency index for the bidirectional check.
+        # Directed adjacency index for the bidirectional check, plus
+        # the drained-link endpoints: an overloaded adjacency drains
+        # BOTH directions of that one link (reference:
+        # setInterfaceOverload † — maintenance soft-drain), identified
+        # from the far side as (advertiser, advertiser's if_name) ==
+        # our (other_node_name, other_if_name). Parallel links between
+        # the same pair drain independently.
         has_reverse: set[tuple[str, str]] = set()
+        drained: set[tuple[str, str]] = set()
         for node, db in self._adj_dbs.items():
             for adj in db.adjacencies:
                 has_reverse.add((node, adj.other_node_name))
+                if adj.is_overloaded:
+                    drained.add((node, adj.if_name))
 
         srcs: list[int] = []
         dsts: list[int] = []
@@ -414,8 +438,10 @@ class LinkState:
                     continue  # neighbor's adj db not yet received
                 if (adj.other_node_name, node) not in has_reverse:
                     continue  # bidirectional check failed
-                if adj.is_overloaded:
-                    continue  # hard-drained link
+                if adj.is_overloaded or (
+                    adj.other_node_name, adj.other_if_name
+                ) in drained:
+                    continue  # drained link (either side, both dirs)
                 w = name_to_id[adj.other_node_name]
                 key = (u, w)
                 detail = (

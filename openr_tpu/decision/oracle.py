@@ -56,16 +56,23 @@ def build_adjacency(ls: LinkState) -> dict[str, dict[str, int]]:
     """Directed min-metric adjacency with the bidirectional check applied."""
     nodes = set(ls.nodes)
     reported: set[tuple[str, str]] = set()
+    drained: set[tuple[str, str]] = set()  # (advertiser, if_name)
     for u in nodes:
         db = ls.adjacency_db(u)
         for a in db.adjacencies:
             reported.add((u, a.other_node_name))
+            if a.is_overloaded:
+                drained.add((u, a.if_name))
     adj: dict[str, dict[str, int]] = {u: {} for u in nodes}
     for u in nodes:
         db = ls.adjacency_db(u)
         for a in db.adjacencies:
             v = a.other_node_name
             if v not in nodes or a.is_overloaded:
+                continue
+            # either side draining the link removes BOTH directions
+            # (same rule as LinkState.build_csr — CSR/oracle equality)
+            if (v, a.other_if_name) in drained:
                 continue
             if (v, u) not in reported:
                 continue

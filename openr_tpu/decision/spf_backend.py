@@ -1061,6 +1061,8 @@ class TpuSpfSolver:
                     continue
                 if a.other_node_name not in csr.name_to_id or a.is_overloaded:
                     continue
+                if ls.link_drained_by_peer(my_node, a):
+                    continue  # far side soft-drained the link
                 rdb.mpls_routes[a.adj_label] = RibMplsEntry(
                     label=a.adj_label,
                     nexthops=(

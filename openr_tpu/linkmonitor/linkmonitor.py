@@ -65,6 +65,7 @@ class LinkMonitor(OpenrModule):
         self.adjacencies: dict[tuple[str, str, str], tuple[NeighborInfo, int]] = {}
         self.node_overloaded = False
         self._metric_override: dict[str, int] = {}  # if_name -> metric
+        self._link_overload: set[str] = set()  # if_name -> drained link
         self._next_adj_label = SR_LOCAL_RANGE[0]
         self._advertise_debounce = AsyncDebounce(
             min_ms=10,
@@ -252,6 +253,7 @@ class LinkMonitor(OpenrModule):
                     metric=self._metric_for(info),
                     adj_label=label if sr.enable else 0,
                     rtt_us=info.rtt_us,
+                    is_overloaded=local_if in self._link_overload,
                 )
             )
         return AdjacencyDatabase(
@@ -302,6 +304,7 @@ class LinkMonitor(OpenrModule):
                 "name": name,
                 "is_up": info.is_up,
                 "metric_override": self._metric_override.get(name),
+                "is_overloaded": name in self._link_overload,
                 "adjacencies": adjs,
             })
         return out
@@ -330,3 +333,28 @@ class LinkMonitor(OpenrModule):
         else:
             self._metric_override[if_name] = metric
         self._advertise_debounce.poke()
+
+    def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        """Drain one link: originate its adjacency with
+        is_overloaded=True so every solver excludes BOTH directions
+        from transit while the adjacency itself stays up (reference:
+        setInterfaceOverload † — soft-drain for maintenance, distinct
+        from node overload and from metric overrides). Unknown
+        interfaces are rejected — a typo'd drain that silently does
+        nothing is a false all-clear during maintenance."""
+        if if_name not in self.interfaces:
+            raise ValueError(
+                f"unknown interface {if_name!r} "
+                f"(have: {sorted(self.interfaces) or 'none'})"
+            )
+        changed = (if_name in self._link_overload) != overloaded
+        if overloaded:
+            self._link_overload.add(if_name)
+        else:
+            self._link_overload.discard(if_name)
+        if changed:
+            self._log_event(
+                "LINK_OVERLOAD_SET" if overloaded else "LINK_OVERLOAD_UNSET",
+                if_name=if_name,
+            )
+            self._advertise_debounce.poke()

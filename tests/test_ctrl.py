@@ -295,3 +295,61 @@ def test_get_spf_path():
         await c.stop()
 
     run(body())
+
+
+def test_set_interface_overload_drains_link():
+    """Draining a's a—b link removes the a→b edge from the LSDB (the
+    line topology loses a→c reachability); undraining restores it
+    (reference: setInterfaceOverload † soft-drain)."""
+
+    async def body():
+        c = await _converged_cluster()
+        na = c.nodes["a"]
+        cli = await _client_for(na)
+
+        ifaces = await cli.call("get_interfaces")
+        if_name = next(
+            i["name"] for i in ifaces["interfaces"] if i["adjacencies"]
+        )
+        await cli.call("set_interface_overload", {"interface": if_name})
+
+        from openr_tpu.types.network import IpPrefix
+
+        target = IpPrefix.make("10.0.2.1/32")
+        for _ in range(100):
+            if na.get_route_db().unicast_routes.get(target) is None:
+                break
+            await asyncio.sleep(0.1)
+        assert na.get_route_db().unicast_routes.get(target) is None
+
+        ifc = next(
+            i for i in (await cli.call("get_interfaces"))["interfaces"]
+            if i["name"] == if_name
+        )
+        assert ifc["is_overloaded"]
+
+        # the drain is BIDIRECTIONAL: the far side (c, routing through
+        # b) also loses its path back to a over the drained link
+        nc = c.nodes["c"]
+        back = IpPrefix.make("10.0.0.1/32")
+        for _ in range(100):
+            if nc.get_route_db().unicast_routes.get(back) is None:
+                break
+            await asyncio.sleep(0.1)
+        assert nc.get_route_db().unicast_routes.get(back) is None
+
+        await cli.call(
+            "set_interface_overload",
+            {"interface": if_name, "overload": False},
+        )
+        for _ in range(100):
+            e = na.get_route_db().unicast_routes.get(target)
+            if e is not None:
+                break
+            await asyncio.sleep(0.1)
+        assert na.get_route_db().unicast_routes.get(target) is not None
+
+        await cli.close()
+        await c.stop()
+
+    run(body())

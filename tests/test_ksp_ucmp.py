@@ -330,15 +330,17 @@ def test_ksp_k_overload_respected_both_backends():
             assert lbl4 not in nh.mpls_action.push_labels
 
 
-def test_ksp_clamp_asymmetric_dest_matches_oracle():
-    """Regression (r5 review): the KSP k clamp bounds the DEST by its
-    IN-neighbor count — a hard-drained adjacency at the dest drops one
-    direction from the CSR (out-deg < in-deg), and clamping by out-deg
-    would compute fewer disjoint paths than exist. Both backends must
-    still agree, and the path count must match the true in-degree."""
+def test_ksp_drained_link_excluded_both_directions_matches_oracle():
+    """A soft-drained adjacency (is_overloaded from EITHER side) removes
+    the link from the CSR in BOTH directions (setInterfaceOverload †
+    maintenance semantics — originally this pinned the r5 clamp
+    regression via asymmetric degrees; bidirectional drain makes CSR
+    edge existence symmetric, so the asymmetry can no longer arise).
+    KSP must route every remaining path around the drained link and
+    both backends must agree."""
     adj_dbs, _ = topogen.ring(4)
-    # drain node-2's own link toward node-1: edge (2→1) leaves the CSR,
-    # (1→2) stays — node-2 now has out-deg 1, in-deg 2
+    # node-2 drains its link toward node-1: BOTH (2→1) and (1→2)
+    # leave the CSR; the only path into node-2 is via node-3
     dbs = []
     for db in adj_dbs:
         if db.this_node_name == "node-2":
@@ -356,5 +358,9 @@ def test_ksp_clamp_asymmetric_dest_matches_oracle():
     tpu = TpuSpfSolver().compute_routes(ls, ps, "node-0")
     assert cpu.unicast_routes == tpu.unicast_routes
     e = tpu.unicast_routes[IpPrefix.make("10.9.0.0/16")]
-    # both edge-disjoint paths into node-2 must survive the clamp
-    assert {nh.neighbor_node for nh in e.nexthops} == {"node-1", "node-3"}
+    # the drained link carries nothing; only the node-3 path survives
+    assert {nh.neighbor_node for nh in e.nexthops} == {"node-3"}
+    csr = ls.to_csr()
+    i1, i2 = csr.name_to_id["node-1"], csr.name_to_id["node-2"]
+    pairs = set(zip(csr.edge_src.tolist(), csr.edge_dst.tolist()))
+    assert (i1, i2) not in pairs and (i2, i1) not in pairs

@@ -80,8 +80,10 @@ def test_overloaded_link_excluded():
     )
     ls = _load([drained] + adj_dbs[1:])
     csr = ls.to_csr()
-    # node-0 → node-1 gone; reverse node-1 → node-0 stays (directed drain)
-    assert csr.num_edges == 7
+    # a drain from either side removes BOTH directions of that link
+    # (setInterfaceOverload † maintenance semantics): node-0 ↔ node-1
+    # gone entirely, the ring's other 6 directed edges stay
+    assert csr.num_edges == 6
 
 
 def test_update_is_idempotent_and_detects_change():
