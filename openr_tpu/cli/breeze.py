@@ -379,6 +379,55 @@ def kvstore_erase_key(ctx, key, area, ttl):
     click.echo(f"erase {key}: tombstone v{raw['version']} ttl={ttl}ms")
 
 
+@kvstore.command("snoop")
+@click.option("--prefix", default="", help="key prefix filter")
+@click.option("--area", default=None)
+@click.option("--duration", default=0.0, show_default=True, type=float,
+              help="stop after N seconds (0 = until interrupted)")
+@click.pass_context
+def kvstore_snoop(ctx, prefix, area, duration):
+    """Live-watch KvStore publications (reference: breeze kvstore
+    snoop †): prints each flooded delta as it arrives. Ctrl-C (or
+    --duration) to stop."""
+
+    async def go():
+        cli_ = RpcClient(
+            host=ctx.obj["host"], port=ctx.obj["port"],
+            ssl=ctx.obj.get("ssl"),
+        )
+        await cli_.connect(timeout=ctx.obj["timeout"])
+        try:
+            stream = await cli_.subscribe(
+                "subscribe_kvstore",
+                {"prefix": prefix, "area": area, "snapshot": False},
+            )
+            loop = asyncio.get_event_loop()
+            t_end = loop.time() + duration if duration else None
+            while True:
+                timeout = (
+                    max(0.0, t_end - loop.time()) if t_end else None
+                )
+                try:
+                    item = await asyncio.wait_for(
+                        anext(stream), timeout=timeout
+                    )
+                except (TimeoutError, StopAsyncIteration):
+                    return
+                for k, v in sorted(item.get("key_vals", {}).items()):
+                    click.echo(
+                        f"{k} v{v.get('version')} "
+                        f"from {v.get('originator_id')} "
+                        f"ttl_version={v.get('ttl_version')}"
+                    )
+        finally:
+            await cli_.close()
+
+    try:
+        asyncio.run(go())
+    except KeyboardInterrupt:
+        pass
+
+
 @kvstore.command("floodtopo")
 @click.option("--area", default=None)
 @click.pass_context

@@ -198,3 +198,18 @@ def test_kvstore_set_and_erase_key(live):
         time.sleep(0.2)
     else:
         raise AssertionError("debug:x never expired")
+
+
+def test_kvstore_snoop(live):
+    # write a key on a background thread shortly after snoop starts, so
+    # the watch window catches a live delta
+    def poke():
+        time.sleep(0.6)
+        invoke(live, "a", "kvstore", "set-key", "snoop:x", "v")
+
+    t = threading.Thread(target=poke, daemon=True)
+    t.start()
+    out = invoke(live, "a", "kvstore", "snoop", "--prefix", "snoop:",
+                 "--duration", "4")
+    t.join()
+    assert "snoop:x v1 from breeze" in out
