@@ -199,6 +199,34 @@ def validate(ctx):
     click.echo("all checks passed")
 
 
+# --------------------------------------------------------------------- spark
+
+
+@cli.group()
+def spark():
+    """Neighbor discovery FSM view (reference: breeze spark †)."""
+
+
+@spark.command("neighbors")
+@click.pass_context
+def spark_neighbors(ctx):
+    """Live discovery state per neighbor, pre-LinkMonitor (FSM state,
+    hold, RTT, last-heard)."""
+    res = _run(ctx, "get_spark_neighbors")
+    rows = [
+        [n["node"], n["local_if"], n["remote_if"], n["state"], n["area"],
+         n["hold_time_ms"], n["rtt_us"],
+         n["last_heard_ms_ago"] if n["last_heard_ms_ago"] is not None
+         else "-"]
+        for n in sorted(res["neighbors"], key=lambda n: n["node"])
+    ]
+    click.echo(_table(
+        rows,
+        ["neighbor", "local-if", "remote-if", "state", "area", "hold-ms",
+         "rtt-us", "heard-ms-ago"],
+    ))
+
+
 # ------------------------------------------------------------------- kvstore
 
 

@@ -132,7 +132,7 @@ class CtrlServer(OpenrModule):
             "get_decision_adjacency_dbs", "get_received_routes",
             "get_spf_path",
             "get_interfaces", "set_node_overload", "set_interface_metric",
-            "set_interface_overload",
+            "set_interface_overload", "get_spark_neighbors",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
         ):
@@ -413,6 +413,30 @@ class CtrlServer(OpenrModule):
             params["interface"], int(metric) if metric is not None else None
         )
         return {"ok": True}
+
+    async def get_spark_neighbors(self, params: dict) -> dict:
+        """reference: getNeighbors † / breeze spark neighbors — the
+        discovery FSM's live view, pre-LinkMonitor."""
+        import time as _time
+
+        now = _time.monotonic()
+        return {
+            "neighbors": [
+                {
+                    "node": nb.node_name,
+                    "local_if": nb.local_if,
+                    "remote_if": nb.remote_if,
+                    "state": nb.state.name,
+                    "area": nb.area,
+                    "hold_time_ms": nb.hold_time_ms,
+                    "rtt_us": nb.rtt_us,
+                    "last_heard_ms_ago": int((now - nb.last_heard) * 1e3)
+                    if nb.last_heard
+                    else None,
+                }
+                for nb in self.node.spark.neighbors.values()
+            ]
+        }
 
     async def set_interface_overload(self, params: dict) -> dict:
         """reference: setInterfaceOverload / unsetInterfaceOverload † —

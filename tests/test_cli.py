@@ -202,10 +202,30 @@ def test_kvstore_set_and_erase_key(live):
 
 def test_kvstore_snoop(live):
     # write a key on a background thread shortly after snoop starts, so
-    # the watch window catches a live delta
+    # the watch window catches a live delta. The write goes through a
+    # raw RPC call, NOT a nested CliRunner — CliRunner redirects the
+    # GLOBAL sys.stdout, so two concurrent invokes clobber each
+    # other's capture and the snoop output reads empty.
+    from openr_tpu.rpc import RpcClient
+
     def poke():
         time.sleep(0.6)
-        invoke(live, "a", "kvstore", "set-key", "snoop:x", "v")
+
+        async def go():
+            c = RpcClient(port=live.port("a"))
+            await c.connect(timeout=5.0)
+            try:
+                await c.call("set_kvstore_keyvals", {"key_vals": {
+                    "snoop:x": {
+                        "version": 1, "originator_id": "breeze",
+                        "value": {"__bytes__": "76"}, "ttl": -1,
+                        "ttl_version": 0,
+                    }
+                }})
+            finally:
+                await c.close()
+
+        asyncio.run(go())
 
     t = threading.Thread(target=poke, daemon=True)
     t.start()
@@ -213,3 +233,8 @@ def test_kvstore_snoop(live):
                  "--duration", "4")
     t.join()
     assert "snoop:x v1 from breeze" in out
+
+
+def test_spark_neighbors(live):
+    out = invoke(live, "a", "spark", "neighbors")
+    assert "ESTABLISHED" in out and "b" in out
