@@ -124,6 +124,30 @@ def status(ctx):
         click.echo(f"  {gate}: {'pass' if st.get(gate) else 'PENDING'}")
 
 
+@cli.command()
+@click.pass_context
+def version(ctx):
+    """Node software version + the queried node's name (reference:
+    breeze openr version †)."""
+    from importlib.metadata import PackageNotFoundError
+    from importlib.metadata import version as _pkg_version
+
+    try:
+        v = _pkg_version("openr-tpu")
+    except PackageNotFoundError:
+        # source checkout: read pyproject directly
+        import re
+        from pathlib import Path
+
+        txt = (
+            Path(__file__).resolve().parents[2] / "pyproject.toml"
+        ).read_text()
+        m = re.search(r'^version = "([^"]+)"', txt, re.M)
+        v = m.group(1) if m else "unknown"
+    name = _run(ctx, "get_my_node_name")
+    click.echo(f"openr_tpu {v} (node {name})")
+
+
 @cli.command("tech-support")
 @click.pass_context
 def tech_support(ctx):
@@ -632,8 +656,11 @@ def lm_links(ctx):
     rows = []
     for i in res["interfaces"]:
         nbrs = ",".join(a["neighbor"] for a in i["adjacencies"]) or "-"
+        state = "up" if i["is_up"] else "DOWN"
+        if i.get("is_overloaded"):
+            state += " DRAINED"
         rows.append([
-            i["name"], "up" if i["is_up"] else "DOWN",
+            i["name"], state,
             i["metric_override"] if i["metric_override"] is not None else "",
             nbrs,
         ])

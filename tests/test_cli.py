@@ -238,3 +238,22 @@ def test_kvstore_snoop(live):
 def test_spark_neighbors(live):
     out = invoke(live, "a", "spark", "neighbors")
     assert "ESTABLISHED" in out and "b" in out
+
+
+def test_version_and_drained_links(live):
+    out = invoke(live, "a", "version")
+    assert out.startswith("openr_tpu ") and "(node a)" in out
+    # drain then confirm lm links surfaces it
+    ifname = None
+    out = invoke(live, "a", "lm", "links")
+    for line in out.splitlines():
+        first = line.split()[0] if line.strip() else ""
+        if first and first not in ("node", "interface") and "-" != first[0]:
+            ifname = first
+            break
+    invoke(live, "a", "lm", "set-link-overload", ifname)
+    out = invoke(live, "a", "lm", "links")
+    assert "DRAINED" in out
+    invoke(live, "a", "lm", "unset-link-overload", ifname)
+    out = invoke(live, "a", "lm", "links")
+    assert "DRAINED" not in out
