@@ -811,10 +811,15 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     b256 = np.arange(256, dtype=np.int32) % csr.num_nodes
     warm = tpu._solve_dist(csr, b256)  # compile + run
     float(np.asarray(warm[:, 0]).sum())  # drain the warmup execution
-    t0 = time.perf_counter()
-    d256 = tpu._solve_dist(csr, b256)
-    float(np.asarray(d256[:, 0]).sum())  # force completion
-    b256_ms = (time.perf_counter() - t0) * 1e3
+    b256_times = []
+    for _ in range(3):  # p50-of-3: a single tunnel hiccup moved this
+        t0 = time.perf_counter()  # row 13% in the r5 window (538 vs
+        d256 = tpu._solve_dist(csr, b256)  # 599 src/s in probe_b_family)
+        float(np.asarray(d256[:, 0]).sum())  # force completion
+        b256_times.append((time.perf_counter() - t0) * 1e3)
+        part["stage"] = f"b256-all-sources {len(b256_times)}/3"
+        _sidecar_flush(part)
+    b256_ms = float(np.percentile(b256_times, 50))
     detail["tpu_b256_solve_ms"] = round(b256_ms, 3)
     detail["tpu_b256_sources_per_sec"] = round(256 / (b256_ms / 1e3), 1)
 
