@@ -100,11 +100,23 @@ class Cluster:
         node_specs: list[ClusterNodeSpec],
         link_specs: list[LinkSpec],
         solver: str = "cpu",
-        debounce_ms: tuple[int, int] = (10, 60),
+        debounce_ms: tuple[int, int] | None = None,
         enable_ctrl: bool = False,
     ) -> "Cluster":
         c = Cluster(solver=solver)
         spark_cfg = scaled_spark(len(node_specs))
+        if debounce_ms is None:
+            # Decision debounce scales with CPU oversubscription for
+            # the same reason the Spark timers do (scaled_spark): in a
+            # convergence wave every node receives ~N publications, and
+            # a 60 ms coalescing cap on one shared core means hundreds
+            # of redundant full rebuilds competing with the hello
+            # service — rebuild starvation is the 256-node collapse
+            # mode. Small clusters keep the responsive default.
+            n = len(node_specs)
+            debounce_ms = (
+                (10, 60) if n <= 64 else (10, int(60 * (n / 64) * 2))
+            )
         for spec in node_specs:
             ncfg = spec.config
             if (
