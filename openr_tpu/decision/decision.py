@@ -34,7 +34,6 @@ from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
 from openr_tpu.types.kvstore import Publication, Value
 from openr_tpu.types.routes import (
     RouteDatabase,
-    RouteUpdate,
     RouteUpdateType,
     diff_route_dbs,
 )

@@ -4,7 +4,7 @@ against a live module graph)."""
 
 import asyncio
 
-import pytest
+
 
 from openr_tpu.emulator import Cluster
 from openr_tpu.rpc import RpcClient
