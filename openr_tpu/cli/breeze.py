@@ -637,6 +637,55 @@ def fib_counters(ctx):
         click.echo(f"{k}: {v:g}")
 
 
+@fib.command("add")
+@click.argument("prefix")
+@click.argument("nexthops", nargs=-1, required=True)
+@click.option("--metric", default=1, show_default=True, type=int)
+@click.pass_context
+def fib_add(ctx, prefix, nexthops, metric):
+    """Manually program PREFIX via NEXTHOPS (each `ADDR` or `ADDR%IF`)
+    under the static client table — bypasses Decision; for platform
+    debugging (reference: breeze fib add-route †)."""
+    nhs = []
+    for nh in nexthops:
+        addr, _, ifn = nh.partition("%")
+        nhs.append({"address": addr, "if_name": ifn, "metric": metric})
+    res = _run(ctx, "fib_add_unicast",
+               {"routes": [{"prefix": prefix, "nexthops": nhs}]})
+    click.echo(f"added {res['added']} route(s) to the static table")
+
+
+@fib.command("del")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.pass_context
+def fib_del(ctx, prefixes):
+    """Remove manually-programmed PREFIXES from the static client table
+    (reference: breeze fib del-route †)."""
+    res = _run(ctx, "fib_del_unicast", {"prefixes": list(prefixes)})
+    # both backends treat delete-of-missing as success, so the count is
+    # the REQUEST size, not confirmed removals (review finding)
+    click.echo(
+        f"requested deletion of {res['deleted']} prefix(es) "
+        "from the static table"
+    )
+
+
+@fib.command("static-routes")
+@click.option("--client-id", default=None, type=int,
+              help="FibService client table (default: the static table)")
+@click.pass_context
+def fib_static_routes(ctx, client_id):
+    """Dump a FibService table by client id (default: the static table
+    `fib add` writes)."""
+    params = {} if client_id is None else {"client_id": client_id}
+    res = _run(ctx, "get_fib_client_routes", params)
+    rows = [
+        [r["dest"], " ".join(_nh_str(nh) for nh in r["nexthops"])]
+        for r in sorted(res["unicast_routes"], key=lambda r: str(r["dest"]))
+    ]
+    click.echo(_table(rows, ["prefix", "nexthops"]))
+
+
 # ------------------------------------------------------------------------ lm
 
 

@@ -70,6 +70,14 @@ DEFAULT_AREA = "0"
 DIST_INF = 1 << 30
 METRIC_MAX = (1 << 30) - 1
 
+# ---- FIB client ids (reference: openr/if/Platform.thrift † FibClient) ------
+# Namespaces FibService tables between routing daemons / tools. On the
+# netlink backend each client maps to its own rtproto (openr: 99,
+# static/manual: the kernel's RTPROT_STATIC=4), so separation holds on
+# the real kernel too, not just in the mock.
+FIB_CLIENT_OPENR = 786
+FIB_CLIENT_STATIC = 64
+
 # ---- Watchdog (reference: openr/watchdog/Watchdog.cpp †) -------------------
 WATCHDOG_INTERVAL_S = 20
 WATCHDOG_THREAD_TIMEOUT_S = 300

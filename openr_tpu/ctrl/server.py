@@ -133,6 +133,7 @@ class CtrlServer(OpenrModule):
             "get_spf_path",
             "get_interfaces", "set_node_overload", "set_interface_metric",
             "set_interface_overload", "get_spark_neighbors",
+            "fib_add_unicast", "fib_del_unicast", "get_fib_client_routes",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
         ):
@@ -413,6 +414,57 @@ class CtrlServer(OpenrModule):
             params["interface"], int(metric) if metric is not None else None
         )
         return {"ok": True}
+
+    async def fib_add_unicast(self, params: dict) -> dict:
+        """reference: breeze fib add-route † — manual route injection
+        straight through the FibService under CLIENT_ID_STATIC (openr's
+        own sync never touches that table). For platform debugging; the
+        route bypasses Decision entirely."""
+        from openr_tpu.fib.fib import CLIENT_ID_STATIC
+        from openr_tpu.types.network import IpPrefix, NextHop, UnicastRoute
+
+        routes = [
+            UnicastRoute(
+                dest=IpPrefix.make(r["prefix"]),
+                nexthops=tuple(
+                    NextHop(
+                        address=nh["address"],
+                        if_name=nh.get("if_name", ""),
+                        metric=int(nh.get("metric", 1)),
+                    )
+                    for nh in r["nexthops"]
+                ),
+            )
+            for r in params["routes"]
+        ]
+        await self.node.fib.handler.add_unicast_routes(
+            CLIENT_ID_STATIC, routes
+        )
+        return {"ok": True, "added": len(routes)}
+
+    async def fib_del_unicast(self, params: dict) -> dict:
+        """reference: breeze fib del-route †."""
+        from openr_tpu.fib.fib import CLIENT_ID_STATIC
+        from openr_tpu.types.network import IpPrefix
+
+        prefixes = [IpPrefix.make(p) for p in params["prefixes"]]
+        await self.node.fib.handler.delete_unicast_routes(
+            CLIENT_ID_STATIC, prefixes
+        )
+        return {"ok": True, "deleted": len(prefixes)}
+
+    async def get_fib_client_routes(self, params: dict) -> dict:
+        """Dump a FibService table by client id (default: the static
+        table breeze `fib add` writes; pass client_id 786 for openr's
+        own)."""
+        from openr_tpu.fib.fib import CLIENT_ID_STATIC
+
+        cid = int(params.get("client_id", CLIENT_ID_STATIC))
+        routes = await self.node.fib.handler.get_route_table_by_client(cid)
+        return {
+            "client_id": cid,
+            "unicast_routes": [_unicast_json(r) for r in routes],
+        }
 
     async def get_spark_neighbors(self, params: dict) -> dict:
         """reference: getNeighbors † / breeze spark neighbors — the

@@ -14,6 +14,7 @@ import asyncio
 import logging
 from typing import Iterable, Protocol
 
+from openr_tpu.common import constants as C
 from openr_tpu.common.backoff import ExponentialBackoff
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
@@ -149,7 +150,12 @@ class MockFibHandler:
 
 # reference: openr/if/Platform.thrift † FibClient enum — OPENR's client id
 # namespaces its routes in the FibService against other routing daemons.
-CLIENT_ID_OPENR = 786
+# Manual/static routes injected via breeze `fib add` live under their
+# own client id so openr's sync_fib (which replaces the WHOLE
+# CLIENT_ID_OPENR table) never clobbers them; the netlink backend maps
+# each client to its own rtproto for real kernel-side separation.
+CLIENT_ID_OPENR = C.FIB_CLIENT_OPENR
+CLIENT_ID_STATIC = C.FIB_CLIENT_STATIC
 
 
 class Fib(OpenrModule):

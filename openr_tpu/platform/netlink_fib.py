@@ -26,7 +26,14 @@ import logging
 
 from openr_tpu.monitor.counters import Counters
 from openr_tpu.nl import NetlinkRoute, NetlinkSocket, Nexthop
+from openr_tpu.common import constants as C
 from openr_tpu.nl.netlink import RTPROT_OPENR
+
+# the kernel's own "static" rtproto (include/uapi/linux/rtnetlink.h):
+# manual breeze `fib add` routes carry it so `ip route` shows
+# `proto static` and openr's full sync (filtered to its own proto)
+# can never reclaim them
+RTPROT_STATIC = 4
 from openr_tpu.types.network import (
     IpPrefix,
     MplsAction,
@@ -69,7 +76,7 @@ class NetlinkFibService:
         counters: Counters | None = None,
     ):
         self.table = table
-        self.protocol = protocol
+        self.protocol = protocol  # openr's own client (CLIENT_ID_OPENR)
         self.counters = counters
         self._sock: NetlinkSocket | None = None
         self._ifindex: dict[str, int] = {}
@@ -87,6 +94,15 @@ class NetlinkFibService:
             self._sock.close()
             self._sock = None
 
+    def _proto_for(self, client_id: int) -> int:
+        """Kernel-side client separation (review finding: client_id was
+        ignored, so openr's sync_fib deleted breeze-injected static
+        routes): each FibService client maps to its own rtproto, and
+        every add/delete/dump/sync below filters by it."""
+        if client_id == C.FIB_CLIENT_STATIC:
+            return RTPROT_STATIC
+        return self.protocol
+
     def _resolve_ifindex(self, if_name: str) -> int:
         if not if_name:
             return 0
@@ -99,24 +115,24 @@ class NetlinkFibService:
             idx = self._ifindex.get(if_name, 0)
         return idx
 
-    def _to_nl(self, route: UnicastRoute) -> NetlinkRoute:
+    def _to_nl(self, route: UnicastRoute, proto: int) -> NetlinkRoute:
         return NetlinkRoute(
             dst=str(route.dest),
             table=self.table,
-            protocol=self.protocol,
+            protocol=proto,
             nexthops=[
                 _nh_to_nl(nh, self._resolve_ifindex(nh.if_name))
                 for nh in route.nexthops
             ],
         )
 
-    def _mpls_to_nl(self, route: MplsRoute) -> NetlinkRoute:
+    def _mpls_to_nl(self, route: MplsRoute, proto: int) -> NetlinkRoute:
         # the kernel rejects AF_MPLS RTM_NEWROUTE unless rtm_table is
         # RT_TABLE_MAIN (net/mpls/af_mpls.c rtm_to_route_config)
         return NetlinkRoute(
             mpls_label=route.top_label,
             table=RT_TABLE_MAIN,
-            protocol=self.protocol,
+            protocol=proto,
             nexthops=[
                 _nh_to_nl(nh, self._resolve_ifindex(nh.if_name))
                 for nh in route.nexthops
@@ -146,16 +162,16 @@ class NetlinkFibService:
     async def add_unicast_routes(
         self, client_id: int, routes: list[UnicastRoute]
     ) -> None:
-        nl = [self._to_nl(r) for r in routes]
+        proto = self._proto_for(client_id)
+        nl = [self._to_nl(r, proto) for r in routes]
         await asyncio.to_thread(self._batch, nl, False, "routes_added")
 
     async def delete_unicast_routes(
         self, client_id: int, prefixes: list[IpPrefix]
     ) -> None:
+        proto = self._proto_for(client_id)
         nl = [
-            NetlinkRoute(
-                dst=str(p), table=self.table, protocol=self.protocol
-            )
+            NetlinkRoute(dst=str(p), table=self.table, protocol=proto)
             for p in prefixes
         ]
         await asyncio.to_thread(self._batch, nl, True, "routes_deleted")
@@ -163,15 +179,17 @@ class NetlinkFibService:
     async def add_mpls_routes(
         self, client_id: int, routes: list[MplsRoute]
     ) -> None:
-        nl = [self._mpls_to_nl(r) for r in routes]
+        proto = self._proto_for(client_id)
+        nl = [self._mpls_to_nl(r, proto) for r in routes]
         await asyncio.to_thread(self._batch, nl, False, "mpls_added")
 
     async def delete_mpls_routes(
         self, client_id: int, labels: list[int]
     ) -> None:
+        proto = self._proto_for(client_id)
         nl = [
             NetlinkRoute(
-                mpls_label=lbl, table=RT_TABLE_MAIN, protocol=self.protocol
+                mpls_label=lbl, table=RT_TABLE_MAIN, protocol=proto
             )
             for lbl in labels
         ]
@@ -212,7 +230,7 @@ class NetlinkFibService:
                 for l in self._sock_or_open().links_dump()
             }
             for r in self._sock_or_open().routes_dump(
-                table=self.table, protocol=self.protocol
+                table=self.table, protocol=self._proto_for(client_id)
             ):
                 if r.mpls_label is not None:
                     continue
@@ -251,7 +269,7 @@ class NetlinkFibService:
                 for l in self._sock_or_open().links_dump()
             }
             for r in self._sock_or_open().routes_dump(
-                family=28, protocol=self.protocol  # AF_MPLS
+                family=28, protocol=self._proto_for(client_id)  # AF_MPLS
             ):
                 if r.mpls_label is None:
                     continue

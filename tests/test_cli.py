@@ -257,3 +257,17 @@ def test_version_and_drained_links(live):
     invoke(live, "a", "lm", "unset-link-overload", ifname)
     out = invoke(live, "a", "lm", "links")
     assert "DRAINED" not in out
+
+
+def test_fib_add_del_static(live):
+    out = invoke(live, "a", "fib", "add", "10.200.0.0/24", "b%if-ab")
+    assert "added 1" in out
+    out = invoke(live, "a", "fib", "static-routes")
+    assert "10.200.0.0/24" in out
+    # openr's own table is untouched by the static injection
+    out = invoke(live, "a", "fib", "routes")
+    assert "10.200.0.0/24" not in out
+    out = invoke(live, "a", "fib", "del", "10.200.0.0/24")
+    assert "requested deletion of 1" in out
+    out = invoke(live, "a", "fib", "static-routes")
+    assert "10.200.0.0/24" not in out

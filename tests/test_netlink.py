@@ -493,3 +493,45 @@ def test_fib_warm_boot_real_kernel_zero_flush():
             svc2.close()
 
     run(main())
+
+
+@KERNEL
+def test_fib_service_static_client_survives_openr_sync():
+    """Kernel-side client separation (review finding: client_id was
+    ignored, so openr's full sync deleted breeze-injected routes): a
+    CLIENT_ID_STATIC route carries the kernel's RTPROT_STATIC and
+    survives a CLIENT_ID_OPENR sync_fib that flushes openr's table."""
+    from openr_tpu.fib.fib import CLIENT_ID_OPENR, CLIENT_ID_STATIC
+    from openr_tpu.platform import NetlinkFibService
+    from openr_tpu.types.network import IpPrefix, NextHop, UnicastRoute
+
+    svc = NetlinkFibService(table=TEST_TABLE)
+
+    def ur(dst):
+        return UnicastRoute(
+            dest=IpPrefix.make(dst),
+            nexthops=(NextHop(address="", if_name="lo"),),
+        )
+
+    async def main():
+        try:
+            await svc.add_unicast_routes(CLIENT_ID_OPENR, [ur("10.251.1.0/24")])
+            await svc.add_unicast_routes(CLIENT_ID_STATIC, [ur("10.251.9.0/24")])
+            # openr's full sync replaces ITS table only
+            await svc.sync_fib(CLIENT_ID_OPENR, [ur("10.251.2.0/24")])
+            openr_dsts = {
+                str(r.dest)
+                for r in await svc.get_route_table_by_client(CLIENT_ID_OPENR)
+            }
+            static_dsts = {
+                str(r.dest)
+                for r in await svc.get_route_table_by_client(CLIENT_ID_STATIC)
+            }
+            assert openr_dsts == {"10.251.2.0/24"}, openr_dsts
+            assert static_dsts == {"10.251.9.0/24"}, static_dsts
+        finally:
+            await svc.sync_fib(CLIENT_ID_OPENR, [])
+            await svc.sync_fib(CLIENT_ID_STATIC, [])
+            svc.close()
+
+    run(main())
