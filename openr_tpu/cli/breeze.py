@@ -670,6 +670,30 @@ def fib_del(ctx, prefixes):
     )
 
 
+@fib.command("validate")
+@click.pass_context
+def fib_validate_cmd(ctx):
+    """Compare Fib's programmed book against an actual FibService dump
+    (reference: breeze fib validate †); exit 1 on divergence."""
+    res = _run(ctx, "fib_validate")
+    click.echo(
+        f"book: {res['book_unicast']} unicast / {res['book_mpls']} mpls; "
+        f"dataplane: {res['dataplane_unicast']} / {res['dataplane_mpls']}"
+    )
+    for label, items in (
+        ("missing in dataplane", res["missing_in_dataplane"]),
+        ("extra in dataplane", res["extra_in_dataplane"]),
+        ("missing mpls", res["missing_mpls"]),
+        ("extra mpls", res["extra_mpls"]),
+    ):
+        if items:
+            click.echo(f"  {label}: {items[:10]}")
+    if not res["pass"]:
+        click.echo("FIB DIVERGED")
+        raise SystemExit(1)
+    click.echo("fib matches the dataplane")
+
+
 @fib.command("static-routes")
 @click.option("--client-id", default=None, type=int,
               help="FibService client table (default: the static table)")

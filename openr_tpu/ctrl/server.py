@@ -134,6 +134,7 @@ class CtrlServer(OpenrModule):
             "get_interfaces", "set_node_overload", "set_interface_metric",
             "set_interface_overload", "get_spark_neighbors",
             "fib_add_unicast", "fib_del_unicast", "get_fib_client_routes",
+            "fib_validate",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
         ):
@@ -452,6 +453,52 @@ class CtrlServer(OpenrModule):
             CLIENT_ID_STATIC, prefixes
         )
         return {"ok": True, "deleted": len(prefixes)}
+
+    async def fib_validate(self, params: dict) -> dict:
+        """reference: breeze fib validate † — Fib's programmed book vs
+        an actual FibService dump, compared on the dataplane projection
+        (the fields the kernel really stores)."""
+        from openr_tpu.fib.fib import (
+            CLIENT_ID_OPENR,
+            _dataplane_key_mpls,
+            _dataplane_key_unicast,
+        )
+
+        fib = self.node.fib
+        book_u = {
+            _dataplane_key_unicast(r): r
+            for r in fib.get_programmed_unicast()
+        }
+        have_u = {
+            _dataplane_key_unicast(r): r
+            for r in await fib.handler.get_route_table_by_client(
+                CLIENT_ID_OPENR
+            )
+        }
+        book_m = {
+            _dataplane_key_mpls(r): r for r in fib.get_programmed_mpls()
+        }
+        have_m = {
+            _dataplane_key_mpls(r): r
+            for r in await fib.handler.get_mpls_route_table_by_client(
+                CLIENT_ID_OPENR
+            )
+        }
+        missing = [str(book_u[k].dest) for k in book_u.keys() - have_u.keys()]
+        extra = [str(have_u[k].dest) for k in have_u.keys() - book_u.keys()]
+        missing_m = [book_m[k].top_label for k in book_m.keys() - have_m.keys()]
+        extra_m = [have_m[k].top_label for k in have_m.keys() - book_m.keys()]
+        return {
+            "pass": not (missing or extra or missing_m or extra_m),
+            "book_unicast": len(book_u),
+            "dataplane_unicast": len(have_u),
+            "missing_in_dataplane": sorted(missing),
+            "extra_in_dataplane": sorted(extra),
+            "book_mpls": len(book_m),
+            "dataplane_mpls": len(have_m),
+            "missing_mpls": sorted(missing_m),
+            "extra_mpls": sorted(extra_m),
+        }
 
     async def get_fib_client_routes(self, params: dict) -> dict:
         """Dump a FibService table by client id (default: the static

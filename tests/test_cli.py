@@ -271,3 +271,8 @@ def test_fib_add_del_static(live):
     assert "requested deletion of 1" in out
     out = invoke(live, "a", "fib", "static-routes")
     assert "10.200.0.0/24" not in out
+
+
+def test_fib_validate(live):
+    out = invoke(live, "a", "fib", "validate")
+    assert "fib matches the dataplane" in out
