@@ -431,6 +431,25 @@ def kvstore_erase_key(ctx, key, area, ttl):
     click.echo(f"erase {key}: tombstone v{raw['version']} ttl={ttl}ms")
 
 
+@kvstore.command("alloc")
+@click.option("--area", default=None)
+@click.pass_context
+def kvstore_alloc(ctx, area):
+    """Elected prefix-allocator claims (reference: breeze kvstore
+    alloc †): slot index → owning node, from the `allocprefix:` range
+    election keys."""
+    res = _run(ctx, "dump_kvstore", {"prefix": "allocprefix:", "area": area})
+    rows = []
+    for k, v in sorted(res["key_vals"].items()):
+        owner = _value_bytes(v)
+        rows.append([
+            k.split(":", 1)[1],
+            owner.decode(errors="replace") if owner else "?",
+            v.get("version"),
+        ])
+    click.echo(_table(rows, ["slot", "owner", "version"]))
+
+
 @kvstore.command("snoop")
 @click.option("--prefix", default="", help="key prefix filter")
 @click.option("--area", default=None)

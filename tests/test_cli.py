@@ -276,3 +276,9 @@ def test_fib_add_del_static(live):
 def test_fib_validate(live):
     out = invoke(live, "a", "fib", "validate")
     assert "fib matches the dataplane" in out
+
+
+def test_kvstore_alloc_view(live):
+    invoke(live, "a", "kvstore", "set-key", "allocprefix:3", "node-x")
+    out = invoke(live, "a", "kvstore", "alloc")
+    assert "3" in out and "node-x" in out
