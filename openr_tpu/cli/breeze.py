@@ -617,10 +617,22 @@ def decision_received(ctx):
 
 
 @decision.command("rib-policy")
+@click.option("--set", "set_file", default=None,
+              type=click.Path(exists=True),
+              help="install the RibPolicy from this JSON file")
 @click.pass_context
-def decision_rib_policy(ctx):
-    """Show the installed RibPolicy (reference: breeze decision
-    rib-policy †)."""
+def decision_rib_policy(ctx, set_file):
+    """Show — or with --set FILE, install — the RibPolicy (reference:
+    breeze decision rib-policy [--set] †). The file holds the
+    `policy.RibPolicy` JSON shape: {"statements": [{"name",
+    "match_prefixes", "match_tags", "default_weight",
+    "area_to_weight", "neighbor_to_weight"}], "ttl_secs": N}."""
+    if set_file:
+        with open(set_file) as f:
+            policy = json.load(f)
+        _run(ctx, "set_rib_policy", {"policy": policy})
+        click.echo(f"rib policy installed from {set_file}")
+        return
     res = _run(ctx, "get_rib_policy")
     if not res.get("policy"):
         click.echo("no rib policy installed")

@@ -8,6 +8,7 @@ invokes commands from the test thread.
 """
 
 import asyncio
+import json
 import threading
 import time
 
@@ -282,3 +283,20 @@ def test_kvstore_alloc_view(live):
     invoke(live, "a", "kvstore", "set-key", "allocprefix:3", "node-x")
     out = invoke(live, "a", "kvstore", "alloc")
     assert "3" in out and "node-x" in out
+
+
+def test_decision_rib_policy_set(live, tmp_path):
+    pol = tmp_path / "pol.json"
+    pol.write_text(json.dumps({
+        "statements": [{
+            "name": "weight-b",
+            "match_prefixes": ["10.0.0.0/8"],
+            "default_weight": 1,
+            "neighbor_to_weight": {"b": 3},
+        }],
+        "ttl_secs": 60,
+    }))
+    out = invoke(live, "a", "decision", "rib-policy", "--set", str(pol))
+    assert "installed" in out
+    out = invoke(live, "a", "decision", "rib-policy")
+    assert "weight-b" in out
