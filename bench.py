@@ -823,6 +823,18 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     detail["tpu_b256_solve_ms"] = round(b256_ms, 3)
     detail["tpu_b256_sources_per_sec"] = round(256 / (b256_ms / 1e3), 1)
 
+    # trace-derived convergence: full-stack emulator link-downs measured
+    # through the PerfEvents pipeline (spark→fib per-stage markers), the
+    # operator metric DeltaPath argues for — NOT a wall-clock guess.
+    # Runs on the CPU oracle backend, so it is non-null on the CPU
+    # fallback path too and never touches the (possibly wedged) tunnel.
+    part["stage"] = "emulator-convergence"
+    _sidecar_flush(part)
+    from openr_tpu.emulator import measure_convergence
+
+    conv = measure_convergence(trials=2)
+    detail["convergence"] = conv
+
     detail["iters"] = iters  # device/platform recorded at graph-build
     # truthful degraded-mode output (round-3/4 verdict): a CPU fallback
     # run is a DIFFERENT experiment (10k nodes, cpu backend) — rename
@@ -841,6 +853,7 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
         "vs_baseline": (
             None if degraded else round(TARGET_MS / solve_p50, 4)
         ),
+        "convergence_p50_ms": conv.get("convergence_p50_ms"),
     }
     if degraded:
         out["degraded"] = True

@@ -108,9 +108,12 @@ async def churn(
     dec.debounce.poke()
     await asyncio.wait_for(dec.rib_computed.wait(), 600)
 
+    from openr_tpu.monitor import perf
+
     rng = np.random.default_rng(7)
     flap_t: dict[int, float] = {}  # flap seq -> send time
     got_t: list[float] = []  # flap→update latencies
+    trace_ms: list[float] = []  # PerfEvents-derived flap→update totals
     spf_ms: list[float] = []
     breakdown: dict[str, list[float]] = {}
     versions = {db.this_node_name: 1 for db in adj_dbs}
@@ -133,7 +136,11 @@ async def churn(
                 if t0 <= cutoff:
                     got_t.append((now - t0) * 1e3)
                     del flap_t[seq]
-            _ = upd
+            # trace-derived latency: the per-stage-stamped PerfEvents the
+            # sampled flaps carried through Decision (KVSTORE_FLOODED →
+            # ROUTE_UPDATE_SENT), independent of this loop's wall clock
+            for pe in upd.perf_events:
+                trace_ms.append(pe.total_ms())
 
     drainer = asyncio.ensure_future(drain())
     # Pre-generate the flap publications: in production the serialization
@@ -167,6 +174,13 @@ async def churn(
             if n_flaps >= max_flaps:
                 break
             flap_t[n_flaps] = time.perf_counter()
+            if n_flaps % 50 == 0:
+                # sampled tracing (1-in-50): enough samples for a p50
+                # without letting trace bookkeeping distort the very
+                # hot path this bench measures
+                pregen[n_flaps].perf_events = perf.PerfEvents.start(
+                    perf.KVSTORE_FLOODED, node="bench"
+                )
             dec.process_publication(pregen[n_flaps])
             n_flaps += 1
         dec.debounce.poke()
@@ -198,7 +212,10 @@ async def churn(
     spf_runs = dec._spf_runs - base_spf_runs
     drainer.cancel()
     await dec.stop()
-    return n_flaps, spf_runs, spf_ms, got_t, no_change_flaps[0], breakdown
+    return (
+        n_flaps, spf_runs, spf_ms, got_t, no_change_flaps[0], breakdown,
+        trace_ms,
+    )
 
 
 def main() -> None:
@@ -231,7 +248,7 @@ def main() -> None:
         debounce_min=args.debounce_min_ms, debounce_max=args.debounce_max_ms,
     )
 
-    n_flaps, spf_runs, spf_ms, lat, no_change, breakdown = asyncio.new_event_loop().run_until_complete(
+    n_flaps, spf_runs, spf_ms, lat, no_change, breakdown, trace_ms = asyncio.new_event_loop().run_until_complete(
         churn(
             dec, pubs, routes, pub_for, list(adj_dbs),
             args.flaps_per_sec, args.seconds, burst=args.burst,
@@ -257,6 +274,14 @@ def main() -> None:
             "spf_p99_ms": round(float(np.percentile(spf, 99)), 3),
             "flap_to_rib_p50_ms": round(float(np.percentile(latency, 50)), 3),
             "flap_to_rib_p99_ms": round(float(np.percentile(latency, 99)), 3),
+            # PerfEvents-derived convergence (sampled 1-in-50 flaps,
+            # KVSTORE_FLOODED → ROUTE_UPDATE_SENT per-stage markers) —
+            # the trace-based counterpart of flap_to_rib_p50_ms
+            "convergence_p50_ms": (
+                round(float(np.percentile(np.array(trace_ms), 50)), 3)
+                if trace_ms else None
+            ),
+            "convergence_traces": len(trace_ms),
             "rebuild_breakdown_p50_ms": {
                 k: round(float(np.percentile(np.array(v), 50)), 2)
                 for k, v in breakdown.items()

@@ -135,15 +135,21 @@ def version(ctx):
     try:
         v = _pkg_version("openr-tpu")
     except PackageNotFoundError:
-        # source checkout: read pyproject directly
+        # source checkout: read pyproject directly; a non-source install
+        # without package metadata has neither — report "unknown", don't
+        # crash (ADVICE: uncaught FileNotFoundError)
         import re
         from pathlib import Path
 
-        txt = (
-            Path(__file__).resolve().parents[2] / "pyproject.toml"
-        ).read_text()
-        m = re.search(r'^version = "([^"]+)"', txt, re.M)
-        v = m.group(1) if m else "unknown"
+        try:
+            txt = (
+                Path(__file__).resolve().parents[2] / "pyproject.toml"
+            ).read_text()
+        except OSError:
+            v = "unknown"
+        else:
+            m = re.search(r'^version = "([^"]+)"', txt, re.M)
+            v = m.group(1) if m else "unknown"
     name = _run(ctx, "get_my_node_name")
     click.echo(f"openr_tpu {v} (node {name})")
 
@@ -472,7 +478,7 @@ def kvstore_snoop(ctx, prefix, area, duration):
                 "subscribe_kvstore",
                 {"prefix": prefix, "area": area, "snapshot": False},
             )
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             t_end = loop.time() + duration if duration else None
             while True:
                 timeout = (
@@ -482,7 +488,14 @@ def kvstore_snoop(ctx, prefix, area, duration):
                     item = await asyncio.wait_for(
                         anext(stream), timeout=timeout
                     )
-                except (TimeoutError, StopAsyncIteration):
+                except (
+                    # asyncio.TimeoutError is NOT builtin TimeoutError
+                    # until 3.11 — catching only the builtin crashed
+                    # --duration expiry on 3.10
+                    asyncio.TimeoutError,
+                    TimeoutError,
+                    StopAsyncIteration,
+                ):
                     return
                 for k, v in sorted(item.get("key_vals", {}).items()):
                     click.echo(
@@ -860,6 +873,35 @@ def prefixmgr_withdraw(ctx, prefixes):
     click.echo(f"withdrew {res['withdrawn']} prefix(es)")
 
 
+# ----------------------------------------------------------------------- perf
+
+
+@cli.command()
+@click.option("--limit", default=10, show_default=True, type=int,
+              help="most recent traces to render")
+@click.pass_context
+def perf(ctx, limit):
+    """Recent convergence traces with per-stage deltas (reference:
+    breeze perf †): every trace is one update's walk spark → kvstore →
+    decision → fib, markers stamped at each stage."""
+    res = _run(ctx, "get_perf_events", {"limit": limit})
+    traces = res["traces"]
+    if not traces:
+        click.echo("no completed convergence traces yet")
+        return
+    for i, tr in enumerate(traces):
+        click.echo(
+            f"trace {i + 1}/{len(traces)}  total {tr['total_ms']:.3f} ms  "
+            f"({len(tr['events'])} events)"
+        )
+        rows = [
+            [d["event"], e.get("node", ""), f"+{d['delta_ms']:.3f}"]
+            for d, e in zip(tr["deltas_ms"], tr["events"])
+        ]
+        click.echo(_table(rows, ["stage", "node", "delta-ms"]))
+        click.echo("")
+
+
 # -------------------------------------------------------------------- monitor
 
 
@@ -875,6 +917,15 @@ def monitor_counters(ctx, prefix):
     res = _run(ctx, "get_counters", {"prefix": prefix})
     for k, v in sorted(res.items()):
         click.echo(f"{k}: {v:g}")
+
+
+@monitor.command("prometheus")
+@click.pass_context
+def monitor_prometheus(ctx):
+    """Prometheus text exposition of the node's counters + windowed
+    latency percentiles — what a /metrics scrape would return."""
+    res = _run(ctx, "get_counters_prometheus")
+    click.echo(res["text"], nl=False)
 
 
 @monitor.command("logs")

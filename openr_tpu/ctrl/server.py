@@ -137,6 +137,7 @@ class CtrlServer(OpenrModule):
             "fib_validate",
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
+            "get_perf_events", "get_counters_prometheus",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -174,6 +175,29 @@ class CtrlServer(OpenrModule):
         prefix = params.get("prefix") or ""
         snap = self.node.counters.snapshot()
         return {k: v for k, v in snap.items() if k.startswith(prefix)}
+
+    async def get_perf_events(self, params: dict) -> dict:
+        """Recent completed convergence traces with per-stage deltas
+        (reference: getPerfDb † / breeze perf)."""
+        limit = int(params.get("limit") or 20)
+        return {
+            "node": self.node.name,
+            "traces": [
+                pe.to_jsonable()
+                for pe in self.node.monitor.recent_perf(limit)
+            ],
+        }
+
+    async def get_counters_prometheus(self, params: dict) -> dict:
+        """Prometheus text exposition (format 0.0.4) of this node's
+        counters + windowed latency stats. The `text` field is what an
+        HTTP /metrics endpoint would serve verbatim."""
+        from openr_tpu.monitor import render_prometheus
+
+        return {
+            "content_type": "text/plain; version=0.0.4",
+            "text": render_prometheus(self.node.counters, self.node.name),
+        }
 
     # --- kvstore ------------------------------------------------------------
 

@@ -31,6 +31,7 @@ from openr_tpu.decision.linkstate import LinkState, PrefixState
 from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
 from openr_tpu.decision.oracle import metric_key
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
+from openr_tpu.monitor import perf
 from openr_tpu.types.kvstore import Publication, Value
 from openr_tpu.types.routes import (
     RouteDatabase,
@@ -55,6 +56,11 @@ _ADJDB_DEC = decoder_for(AdjacencyDatabase)
 # LRU-capped rather than trusted to drain: 2048 × ~30 KB ≈ 60 MB worst
 # case, covering every actively-flapping node of the config-5 bench
 _ADJ_REUSE_CAP = 2048
+
+# convergence traces buffered toward the next rebuild: bounded so a
+# trace-per-flap storm can't grow the list between debounce fires
+# (excess publications still rebuild, just untraced)
+_PERF_PENDING_CAP = 64
 
 
 def merge_area_ribs(
@@ -226,6 +232,10 @@ class Decision(OpenrModule):
         # it produced no route change at all
         self._last_emitted_snapshot_t0 = 0.0
         self._last_completed_snapshot_t0 = 0.0
+        # convergence traces of buffered publications (stamped
+        # DECISION_RECEIVED; carried into the RouteUpdate the next
+        # rebuild emits)
+        self._pending_perf: list = []
 
     # ------------------------------------------------------------------ run
 
@@ -289,6 +299,15 @@ class Decision(OpenrModule):
             ):
                 self._pending_kvs[(area, key)] = None  # tombstone
                 buffered = True
+        if (
+            buffered
+            and pub.perf_events is not None
+            and len(self._pending_perf) < _PERF_PENDING_CAP
+        ):
+            pub.perf_events.add_perf_event(
+                perf.DECISION_RECEIVED, node=self.node_name
+            )
+            self._pending_perf.append(pub.perf_events)
         return buffered
 
     def _drain_pending(self, decoded: dict | None = None) -> bool:
@@ -703,6 +722,17 @@ class Decision(OpenrModule):
                 )
                 t1 = time.perf_counter()
                 self._drain_pending(decoded)
+            # take the traces AFTER the decode await: _snapshot_states'
+            # drain folds in publications that arrived during it, so
+            # their route changes ship in THIS update — their traces
+            # must ride along, not wait for a (typically empty) next
+            # rebuild. Anything arriving after the snapshot stays
+            # pending for the rebuild that will actually contain it.
+            traces, self._pending_perf = self._pending_perf, []
+            for pe in traces:
+                pe.add_perf_event(
+                    perf.DECISION_DEBOUNCED, node=self.node_name
+                )
             states = self._snapshot_states()
             t2 = time.perf_counter()
             new_rib, update = await asyncio.to_thread(
@@ -725,9 +755,20 @@ class Decision(OpenrModule):
             return
         self._last_spf_ms = (time.perf_counter() - t0) * 1e3
         self._spf_runs += 1
+        for pe in traces:
+            pe.add_perf_event(perf.SPF_SOLVE_DONE, node=self.node_name)
         if self.counters:
             self.counters.increment("decision.spf_runs")
             self.counters.set("decision.spf_ms", self._last_spf_ms)
+            # windowed latency stats (exported as .p50/.p99 per window):
+            # the solve+assembly+diff core, and the full rebuild
+            self.counters.add_value(
+                "decision.spf_solve_ms",
+                getattr(self, "_compute_split_ms", {}).get(
+                    "compute_rib", (t3 - t2) * 1e3
+                ),
+            )
+            self.counters.add_value("decision.rebuild_ms", self._last_spf_ms)
             with self._decode_stats_lock:
                 for tier, n in self.decode_stats.items():
                     self.counters.set(f"decision.decode.{tier}", n)
@@ -741,6 +782,12 @@ class Decision(OpenrModule):
         self._last_completed_snapshot_t0 = t0
         if first or not update.empty():
             self._last_emitted_snapshot_t0 = t0
+            for pe in traces:
+                pe.add_perf_event(
+                    perf.ROUTE_UPDATE_SENT, node=self.node_name
+                )
+            update.perf_events = traces
+        # else: the rebuild proved no route change — the traces end here
         if first:
             update.type = RouteUpdateType.FULL_SYNC
             self.rib_computed.set()

@@ -366,6 +366,10 @@ def compute_routes(
                 continue
             if a.other_node_name not in csr.name_to_id or a.is_overloaded:
                 continue
+            if ls.link_drained_by_peer(my_node, a):
+                # far side soft-drained the link: same both-directions
+                # rule as the TPU backend (CPU/TPU parity contract)
+                continue
             rdb.mpls_routes[a.adj_label] = RibMplsEntry(
                 label=a.adj_label,
                 nexthops=(

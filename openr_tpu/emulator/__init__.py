@@ -8,3 +8,4 @@ multi-node-without-a-cluster testing pattern, also used by the
 """
 
 from openr_tpu.emulator.cluster import Cluster, ClusterNodeSpec, LinkSpec  # noqa: F401
+from openr_tpu.emulator.convergence import measure_convergence  # noqa: F401

@@ -1,0 +1,123 @@
+"""Trace-derived convergence measurement over the in-process emulator.
+
+Spins a small full-stack cluster (real Spark/LinkMonitor/KvStore/
+Decision/Fib modules over mock I/O), forces link-down events, and reads
+the resulting PerfEvents traces out of each node's Monitor ring — so the
+reported convergence latency is the per-stage instrumented pipeline
+time (NEIGHBOR_EVENT → FIB_PROGRAMMED), not a wall-clock guess around
+the whole cluster. bench.py embeds this as its `convergence_p50_ms`
+field; it runs on the CPU oracle backend and never touches jax.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from openr_tpu.emulator.cluster import Cluster
+from openr_tpu.monitor import perf
+
+
+def _percentile(vals: list[float], q: float) -> float:
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(len(vals) * q))]
+
+
+async def collect_convergence_traces(
+    trials: int = 3, timeout_s: float = 20.0
+) -> list:
+    """Run `trials` link-down events on a 4-node cluster; return every
+    completed PerfEvents trace (ending FIB_PROGRAMMED) they produced."""
+    # triangle + stub: failing a-b leaves both endpoints reachable, so
+    # every link-down yields route CHANGES (reroute via c) on live nodes
+    c = Cluster.from_edges(
+        [("a", "b"), ("b", "c"), ("a", "c"), ("c", "d")], solver="cpu"
+    )
+    await c.start()
+    traces: list = []
+    try:
+        await c.wait_converged(timeout=timeout_s)
+        for _ in range(trials):
+            # baseline on the monotonic completed-trace COUNTER, not the
+            # ring length — the ring is a bounded deque whose length
+            # stops growing once full, which would blind later trials
+            seen_before = {
+                name: _trace_count(node)
+                for name, node in c.nodes.items()
+            }
+            c.fail_link("a", "b")
+            got = await _wait_new_traces(c, seen_before, timeout_s)
+            traces.extend(got)
+            c.heal_link("a", "b")
+            await c.wait_converged(timeout=timeout_s)
+            # let the heal's own traces land before the next baseline
+            await asyncio.sleep(0.3)
+    finally:
+        await c.stop()
+    return [
+        t
+        for t in traces
+        if t.last_event() == perf.FIB_PROGRAMMED and len(t.events) >= 5
+    ]
+
+
+def _trace_count(node) -> int:
+    return int(node.counters.get("monitor.perf_traces", 0))
+
+
+async def _wait_new_traces(
+    c: Cluster, seen_before: dict[str, int], timeout_s: float
+) -> list:
+    """Wait until at least one node's Monitor completed a new link-down
+    trace, then give stragglers a short grace window."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+
+    def new_traces() -> list:
+        out = []
+        for name, node in c.nodes.items():
+            n_new = _trace_count(node) - seen_before[name]
+            if n_new > 0:
+                ring = list(node.monitor.perf_traces)
+                out.extend(ring[-n_new:])
+        return out
+
+    while loop.time() < deadline:
+        if new_traces():
+            break
+        await asyncio.sleep(0.05)
+    await asyncio.sleep(0.5)  # grace: the other nodes' fibs finish too
+    return new_traces()
+
+
+def measure_convergence(trials: int = 3, timeout_s: float = 20.0) -> dict:
+    """Synchronous wrapper for bench harnesses: p50/p99 of trace-derived
+    link-down convergence plus sample counts. Returns convergence_p50_ms
+    None only when no trace completed (reported, never raised)."""
+    try:
+        traces = asyncio.run(
+            collect_convergence_traces(trials=trials, timeout_s=timeout_s)
+        )
+    except Exception as e:  # noqa: BLE001 — a bench must not die on this
+        return {"convergence_p50_ms": None, "error": f"{type(e).__name__}: {e}"}
+    if not traces:
+        return {"convergence_p50_ms": None, "traces": 0}
+    totals = [t.total_ms() for t in traces]
+    return {
+        "convergence_p50_ms": round(_percentile(totals, 0.5), 3),
+        "convergence_p99_ms": round(_percentile(totals, 0.99), 3),
+        "traces": len(traces),
+        "trials": trials,
+        "stages_p50": {
+            ev: round(v, 3)
+            for ev, v in _stage_p50(traces).items()
+        },
+    }
+
+
+def _stage_p50(traces: list) -> dict[str, float]:
+    """Median per-stage delta across traces, keyed by stage marker."""
+    per_stage: dict[str, list[float]] = {}
+    for t in traces:
+        for ev, d in t.deltas()[1:]:
+            per_stage.setdefault(ev, []).append(d)
+    return {ev: _percentile(v, 0.5) for ev, v in sorted(per_stage.items())}

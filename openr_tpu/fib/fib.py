@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Iterable, Protocol
 
 from openr_tpu.common import constants as C
@@ -19,6 +20,7 @@ from openr_tpu.common.backoff import ExponentialBackoff
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
+from openr_tpu.monitor import perf
 from openr_tpu.types.network import IpPrefix, MplsRoute, UnicastRoute
 from openr_tpu.types.routes import (
     RibEntry,
@@ -168,12 +170,17 @@ class Fib(OpenrModule):
     update is ever lost.
     """
 
+    # traces awaiting a successful program: bounded like Decision's
+    # pending list so a storm can't grow it between retries
+    PERF_PENDING_CAP = 64
+
     def __init__(
         self,
         config: Config,
         route_updates_reader: RQueue,
         fib_handler: FibService,
         fib_updates_queue: ReplicateQueue | None = None,
+        perf_events_queue: ReplicateQueue | None = None,
         counters=None,
     ):
         super().__init__(f"{config.node_name}.fib", counters=counters)
@@ -181,6 +188,8 @@ class Fib(OpenrModule):
         self.handler = fib_handler
         self.reader = route_updates_reader
         self.fib_updates = fib_updates_queue
+        self.perf_queue = perf_events_queue
+        self._pending_perf: list = []
         self.dry_run = config.node.fib.dry_run
         # the RIB as Decision last gave it to us (desired state)
         self.desired_unicast: dict[IpPrefix, RibEntry] = {}
@@ -255,6 +264,9 @@ class Fib(OpenrModule):
             self._dirty.set()
 
     def _fold_update(self, upd: RouteUpdate) -> None:
+        if upd.perf_events:
+            room = self.PERF_PENDING_CAP - len(self._pending_perf)
+            self._pending_perf.extend(upd.perf_events[:room])
         if upd.type == RouteUpdateType.FULL_SYNC:
             self.desired_unicast = dict(upd.unicast_to_update)
             self.desired_mpls = dict(upd.mpls_to_update)
@@ -280,12 +292,23 @@ class Fib(OpenrModule):
             await self._dirty.wait()
             self._dirty.clear()
             try:
+                t0 = time.perf_counter()
+                # traces folded in while _program_once awaits the handler
+                # belong to the NEXT pass — only this many were covered
+                # by the desired-state snapshot programmed below
+                n_covered = len(self._pending_perf)
                 await self._program_once()
                 self.backoff.report_success()
                 if self._have_rib and not self.synced.is_set():
                     self.synced.set()
                 if self.counters:
                     self.counters.increment("fib.program_ok")
+                    if self._have_rib:
+                        self.counters.add_value(
+                            "fib.program_ms",
+                            (time.perf_counter() - t0) * 1e3,
+                        )
+                self._complete_traces(n_covered)
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001
@@ -388,6 +411,30 @@ class Fib(OpenrModule):
                 snap_u, snap_m,
                 u_add=u_add, u_del=u_del, m_add=m_add, m_del=m_del,
             )
+
+    def _complete_traces(self, n_covered: int) -> None:
+        """Stamp FIB_PROGRAMMED on the first `n_covered` pending traces —
+        the ones whose deltas the just-finished program pass actually
+        covered — and hand them to Monitor's perf ring. Runs only after
+        a SUCCESSFUL _program_once — a failed program keeps the traces
+        pending, so the retry latency stays in the trace."""
+        if not self._have_rib or not self._pending_perf or n_covered <= 0:
+            return
+        traces = self._pending_perf[:n_covered]
+        self._pending_perf = self._pending_perf[n_covered:]
+        for pe in traces:
+            pe.add_perf_event(
+                perf.FIB_PROGRAMMED, node=self.config.node_name
+            )
+            if self.perf_queue is not None:
+                try:
+                    self.perf_queue.push(pe)
+                except QueueClosedError:
+                    if not self.stopped:
+                        raise
+                    return
+        if self.counters:
+            self.counters.increment("fib.perf_traces_completed", len(traces))
 
     def _publish_programmed(
         self,

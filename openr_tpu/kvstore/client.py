@@ -46,16 +46,22 @@ class KvStoreClient(OpenrModule):
     # ------------------------------------------------------------- persist
 
     def persist_key(
-        self, area: str, key: str, value: bytes, ttl_ms: int = TTL_INFINITY
+        self,
+        area: str,
+        key: str,
+        value: bytes,
+        ttl_ms: int = TTL_INFINITY,
+        perf_events=None,
     ) -> None:
         """Advertise and keep advertising `key` until unset.
 
         reference: KvStoreClientInternal::persistKey †: version = current+1
         when the stored value isn't ours or differs; TTL refreshed at a
         fraction of expiry; overwrites are contested by version bump.
-        """
+        `perf_events` rides this write's publication only (self-healing
+        re-advertisements are not part of the traced convergence)."""
         self._persisted[(area, key)] = (value, ttl_ms)
-        self._advertise(area, key)
+        self._advertise(area, key, perf_events=perf_events)
 
     def unset_key(self, area: str, key: str) -> None:
         """Stop refreshing; the key dies by TTL everywhere.
@@ -63,7 +69,7 @@ class KvStoreClient(OpenrModule):
         reference: KvStoreClientInternal::unsetKey/clearKey †."""
         self._persisted.pop((area, key), None)
 
-    def _advertise(self, area: str, key: str) -> None:
+    def _advertise(self, area: str, key: str, perf_events=None) -> None:
         value, ttl_ms = self._persisted[(area, key)]
         cur = self.kvstore.get_key(area, key)
         if (
@@ -83,6 +89,7 @@ class KvStoreClient(OpenrModule):
                 ttl=ttl_ms,
                 ttl_version=0,
             ).with_hash(),
+            perf_events=perf_events,
         )
         if self.counters is not None:
             self.counters.increment("kvclient.advertisements")

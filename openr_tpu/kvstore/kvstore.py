@@ -21,6 +21,7 @@ from openr_tpu.config import Config
 from openr_tpu.kvstore.store import KvStoreDb
 from openr_tpu.kvstore.transport import pub_from_json, pub_to_json
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue
+from openr_tpu.monitor import perf
 from openr_tpu.types.kvstore import KeyDumpParams, Publication, Value
 
 log = logging.getLogger(__name__)
@@ -56,6 +57,7 @@ class _Peer:
         # replacing an unsent value with a newer one is always correct)
         self.pending_keys: dict[str, Value] = {}
         self.pending_expired: set[str] = set()
+        self.pending_perf = None  # merged trace of the pending backlog
         self.flood_wake = asyncio.Event()
         self.flood_task: "asyncio.Task | None" = None
 
@@ -282,11 +284,15 @@ class KvStore(OpenrModule):
             return {}
         accepted, _stale = db.merge(pub.key_vals)
         if accepted or pub.expired_keys:
+            pe = pub.perf_events
+            if pe is not None:
+                pe.add_perf_event(perf.KVSTORE_FLOODED, node=self.node_name)
             out = Publication(
                 area=area,
                 key_vals=accepted,
                 expired_keys=list(pub.expired_keys),
                 node_ids=list(pub.node_ids),
+                perf_events=pe,
             )
             if self.node_name not in out.node_ids:
                 out.node_ids.append(self.node_name)
@@ -343,6 +349,16 @@ class KvStore(OpenrModule):
                 coalesced += 1
             peer.pending_keys[k] = v
         peer.pending_expired.update(pub.expired_keys)
+        if pub.perf_events is not None:
+            # traces of coalesced publications merge, same as the keys.
+            # Copied: the original keeps riding the LOCAL publication
+            # queue where Decision/Fib stamp their markers — those must
+            # not leak into the trace this peer receives
+            peer.pending_perf = (
+                pub.perf_events.copy()
+                if peer.pending_perf is None
+                else peer.pending_perf.merge(pub.perf_events)
+            )
         if coalesced and self.counters is not None:
             self.counters.increment("kvstore.flood_keys_coalesced", coalesced)
         # backpressure: a peer that can't drain fast enough gets a bounded
@@ -392,6 +408,7 @@ class KvStore(OpenrModule):
                 tokens -= 1.0
             kv, peer.pending_keys = peer.pending_keys, {}
             exp, peer.pending_expired = peer.pending_expired, set()
+            pe, peer.pending_perf = peer.pending_perf, None
             # node_ids carries only us: per-key provenance is lost when
             # coalescing across publications, and understating node_ids is
             # safe — a duplicate delivery is rejected by merge() and never
@@ -401,6 +418,7 @@ class KvStore(OpenrModule):
                 key_vals=kv,
                 expired_keys=sorted(exp),
                 node_ids=[self.node_name],
+                perf_events=pe,
             )
             session = peer.session
             if session is None:
@@ -408,9 +426,14 @@ class KvStore(OpenrModule):
                 # supersedes this backlog
                 continue
             try:
+                t0 = asyncio.get_running_loop().time()
                 await session.flood(pub)
                 if self.counters is not None:
                     self.counters.increment("kvstore.floods_sent")
+                    self.counters.add_value(
+                        "kvstore.flood_fanout_ms",
+                        (asyncio.get_running_loop().time() - t0) * 1e3,
+                    )
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001
@@ -517,10 +540,15 @@ class KvStore(OpenrModule):
         area: str,
         key: str,
         value: Value,
+        perf_events=None,
     ) -> bool:
         """Local write (client API). Returns True if accepted."""
         accepted = self._apply(
-            area, Publication(area=area, key_vals={key: value}), from_peer=None
+            area,
+            Publication(
+                area=area, key_vals={key: value}, perf_events=perf_events
+            ),
+            from_peer=None,
         )
         return key in accepted
 

@@ -25,6 +25,7 @@ from openr_tpu.config import Config
 from openr_tpu.kvstore.client import KvStoreClient
 from openr_tpu.kvstore.kvstore import PeerEvent, PeerSpec
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
+from openr_tpu.monitor import perf
 from openr_tpu.types.events import (
     InterfaceInfo,
     NeighborEvent,
@@ -67,6 +68,10 @@ class LinkMonitor(OpenrModule):
         self._metric_override: dict[str, int] = {}  # if_name -> metric
         self._link_overload: set[str] = set()  # if_name -> drained link
         self._next_adj_label = SR_LOCAL_RANGE[0]
+        # convergence trace coalesced across the advertise debounce
+        # window (several neighbor events can fold into one adj:<node>
+        # publication — their markers merge into one trace)
+        self._pending_perf: perf.PerfEvents | None = None
         self._advertise_debounce = AsyncDebounce(
             min_ms=10,
             max_ms=self.config.node.link_monitor.linkflap_initial_backoff_ms
@@ -212,6 +217,19 @@ class LinkMonitor(OpenrModule):
                 self.adjacencies[key] = (info, label)
             if not self.config.node.link_monitor.use_rtt_metric:
                 return
+        # trace bookkeeping only for events that actually reach the
+        # advertise poke — the early-return branches above (GR hold,
+        # ignored RTT jitter) must not leave a stale trace poisoning
+        # the NEXT advertisement's convergence numbers
+        if ev.perf_events is not None:
+            ev.perf_events.add_perf_event(
+                perf.ADJ_DB_UPDATED, node=self.node_name
+            )
+            self._pending_perf = (
+                ev.perf_events
+                if self._pending_perf is None
+                else self._pending_perf.merge(ev.perf_events)
+            )
         self._advertise_debounce.poke()
 
     def _peer_endpoint(self, info: NeighborInfo):
@@ -279,9 +297,17 @@ class LinkMonitor(OpenrModule):
 
         reference: LinkMonitor::advertiseAdjacencies † via
         KvStoreClientInternal::persistKey."""
+        pe, self._pending_perf = self._pending_perf, None
         for area in self.config.area_ids():
             db = self.build_adjacency_db(area)
-            self.kv_client.persist_key(area, adj_key(self.node_name), to_wire(db))
+            self.kv_client.persist_key(
+                area,
+                adj_key(self.node_name),
+                to_wire(db),
+                # per-area copy: each area's publication is stamped by
+                # its own downstream pipeline
+                perf_events=pe.copy() if pe is not None else None,
+            )
         if self.counters is not None:
             self.counters.increment("linkmonitor.adj_advertised")
 

@@ -4,5 +4,9 @@ reference: openr/monitor/ † + the fb303 counter surface every module uses
 (`fb303::fbData->setCounter/addStatValue` †).
 """
 
-from openr_tpu.monitor.counters import Counters  # noqa: F401
+from openr_tpu.monitor.counters import (  # noqa: F401
+    Counters,
+    render_prometheus,
+)
 from openr_tpu.monitor.monitor import LogSample, Monitor  # noqa: F401
+from openr_tpu.monitor.perf import PerfEvent, PerfEvents  # noqa: F401

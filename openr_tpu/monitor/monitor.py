@@ -33,20 +33,34 @@ class LogSample:
 
 
 class Monitor(OpenrModule):
-    """Drains the log-sample queue into a bounded recent-event buffer."""
+    """Drains the log-sample queue into a bounded recent-event buffer,
+    and the perf-events queue into a bounded recent-trace ring."""
 
     MAX_EVENTS = 1000  # ring size (reference keeps a bounded export buffer †)
+    MAX_PERF_TRACES = 256  # completed convergence traces kept for export
 
-    def __init__(self, config, log_sample_reader: RQueue, counters=None):
+    def __init__(
+        self,
+        config,
+        log_sample_reader: RQueue,
+        perf_events_reader: RQueue | None = None,
+        counters=None,
+    ):
         super().__init__(f"{config.node_name}.monitor", counters=counters)
         self.node_name = config.node_name
         self.reader = log_sample_reader
+        self.perf_reader = perf_events_reader
         self.events: collections.deque[LogSample] = collections.deque(
             maxlen=self.MAX_EVENTS
+        )
+        self.perf_traces: collections.deque = collections.deque(
+            maxlen=self.MAX_PERF_TRACES
         )
 
     async def main(self) -> None:
         self.spawn(self._drain(), name=f"{self.name}.drain")
+        if self.perf_reader is not None:
+            self.spawn(self._drain_perf(), name=f"{self.name}.perf")
 
     async def _drain(self) -> None:
         while True:
@@ -64,8 +78,39 @@ class Monitor(OpenrModule):
                 self.counters.increment("monitor.log_samples")
             log.debug("event %s %s", sample.event, sample.attrs)
 
+    async def _drain_perf(self) -> None:
+        """Collect completed PerfEvents traces (reference: the perf-event
+        ring `breeze perf` reads †). Each completed trace also feeds the
+        windowed convergence stat, so `monitor.convergence_ms.p50.60`
+        is the live end-to-end convergence percentile."""
+        while True:
+            try:
+                trace = await self.perf_reader.get()
+            except QueueClosedError:
+                return
+            self.perf_traces.append(trace)
+            if self.counters:
+                self.counters.increment("monitor.perf_traces")
+                # the windowed stat only ingests single-origin traces:
+                # markers stamped on different HOSTS carry unrelated
+                # monotonic epochs, so a cross-node total is ordering
+                # information, not a duration (see monitor/perf.py)
+                origins = {e.node for e in trace.events if e.node}
+                if len(origins) <= 1:
+                    self.counters.add_value(
+                        "monitor.convergence_ms", trace.total_ms()
+                    )
+                else:
+                    self.counters.increment(
+                        "monitor.perf_traces_multi_origin"
+                    )
+
     def recent(self, limit: int = 100, event: str | None = None) -> list[LogSample]:
         out = [
             s for s in self.events if event is None or s.event == event
         ]
         return out[-limit:]
+
+    def recent_perf(self, limit: int = 20) -> list:
+        """Most recent completed convergence traces, oldest first."""
+        return list(self.perf_traces)[-limit:]

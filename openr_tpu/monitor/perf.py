@@ -1,0 +1,162 @@
+"""PerfEvents: per-update convergence tracing across the pipeline.
+
+reference: openr/common/Types.thrift † PerfEvents / openr/common/Util.h †
+addPerfEvent — the reference attaches an ordered (eventDescr, unixTs)
+marker list to every update flowing spark → kvstore → decision → fib, and
+`breeze perf` renders the per-stage deltas. That trace, not solver
+throughput, is how operators measure convergence (also the metric DeltaPath
+argues for, PAPERS.md 1808.06893). Here the record rides the existing
+queue payloads (NeighborEvent → Publication → RouteUpdate) and completed
+traces land in Monitor's perf ring.
+
+Stage marker vocabulary (every name used by a stamp call MUST appear in
+docs/Monitor.md — ci.sh lints this):
+
+  NEIGHBOR_EVENT      Spark emitted a neighbor up/down/restart event
+  ADJ_DB_UPDATED      LinkMonitor folded it into the adjacency set
+  KVSTORE_FLOODED     KvStore accepted + published the adj/prefix update
+  DECISION_RECEIVED   Decision buffered the publication
+  DECISION_DEBOUNCED  the debounce window fired; rebuild started
+  SPF_SOLVE_DONE      SPF solve + RIB assembly + diff finished
+  ROUTE_UPDATE_SENT   the route delta was pushed toward Fib
+  FIB_PROGRAMMED      Fib programmed the delta into the dataplane
+
+Timestamps are time.monotonic_ns(): exact for deltas within one
+process (the emulator, and each real node's own pipeline), but NOT
+comparable across hosts — a trace flooded over the TCP transport mixes
+clock domains, so cross-host deltas are only indicative of ordering,
+never of duration (the reference uses unix timestamps and accepts NTP
+skew instead; we keep exact in-process deltas, the quantity the
+benchmarks and the windowed convergence stat are built on).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+NEIGHBOR_EVENT = "NEIGHBOR_EVENT"
+ADJ_DB_UPDATED = "ADJ_DB_UPDATED"
+KVSTORE_FLOODED = "KVSTORE_FLOODED"
+DECISION_RECEIVED = "DECISION_RECEIVED"
+DECISION_DEBOUNCED = "DECISION_DEBOUNCED"
+SPF_SOLVE_DONE = "SPF_SOLVE_DONE"
+ROUTE_UPDATE_SENT = "ROUTE_UPDATE_SENT"
+FIB_PROGRAMMED = "FIB_PROGRAMMED"
+
+# canonical spark→fib stage order; doubles as the doc-lint source of truth
+ALL_MARKERS = (
+    NEIGHBOR_EVENT,
+    ADJ_DB_UPDATED,
+    KVSTORE_FLOODED,
+    DECISION_RECEIVED,
+    DECISION_DEBOUNCED,
+    SPF_SOLVE_DONE,
+    ROUTE_UPDATE_SENT,
+    FIB_PROGRAMMED,
+)
+
+# one trace never legitimately exceeds the full stage vocabulary by much
+# (merges can duplicate early stages); cap so a pathological merge loop
+# can't grow a trace without bound. Merges stop short of the cap so the
+# downstream stage stamps always fit — a full trace evicts its
+# second-oldest marker rather than dropping the new stamp, keeping both
+# the origin timestamp and the completing FIB_PROGRAMMED marker.
+MAX_EVENTS_PER_TRACE = 64
+_MERGE_CAP = MAX_EVENTS_PER_TRACE - 8  # headroom for the stage vocabulary
+
+
+@dataclass
+class PerfEvent:
+    """One stage marker (reference: PerfEvent † — eventDescr + unixTs;
+    ts here is monotonic nanoseconds, which deltas need and wall time
+    doesn't give)."""
+
+    event: str
+    ts_ns: int = 0
+    node: str = ""
+
+
+@dataclass
+class PerfEvents:
+    """Ordered marker list carried on queue payloads.
+
+    reference: PerfEvents †. Markers are appended in stamp order;
+    `deltas()` yields the per-stage breakdown operators read."""
+
+    events: list[PerfEvent] = field(default_factory=list)
+
+    @classmethod
+    def start(cls, event: str, node: str = "") -> "PerfEvents":
+        pe = cls()
+        pe.add_perf_event(event, node=node)
+        return pe
+
+    def add_perf_event(
+        self, event: str, node: str = "", ts_ns: int | None = None
+    ) -> None:
+        """Stamp one stage marker (reference: addPerfEvent †)."""
+        if len(self.events) >= MAX_EVENTS_PER_TRACE:
+            # evict the second-oldest, never the origin or the new stamp:
+            # total_ms stays origin→newest and the trace still completes
+            self.events.pop(1)
+        self.events.append(
+            PerfEvent(
+                event=event,
+                ts_ns=time.monotonic_ns() if ts_ns is None else ts_ns,
+                node=node,
+            )
+        )
+
+    def copy(self) -> "PerfEvents":
+        """Independent snapshot. Every consumer that stamps a trace on
+        its own schedule (local Decision/Fib vs the per-peer flood
+        pump, one advertisement per area) must take its own copy —
+        sharing the mutable list leaks one pipeline's markers into
+        another's trace."""
+        return PerfEvents(events=list(self.events))
+
+    def merge(self, other: "PerfEvents") -> "PerfEvents":
+        """Combine two traces (e.g. several coalesced neighbor events
+        feeding one advertisement): union of markers, timestamp order.
+        The merge of stable-sorted streams keeps stamp order for equal
+        timestamps."""
+        ev = sorted([*self.events, *other.events], key=lambda e: e.ts_ns)
+        if len(ev) > _MERGE_CAP:
+            # same invariant as add_perf_event's eviction: keep the
+            # origin marker and the NEWEST stamps, drop the middle
+            ev = [ev[0], *ev[-(_MERGE_CAP - 1):]]
+        return PerfEvents(events=ev)
+
+    def deltas(self) -> list[tuple[str, float]]:
+        """Per-stage (event, ms-since-previous-marker); first stage is 0."""
+        out: list[tuple[str, float]] = []
+        prev: int | None = None
+        for e in self.events:
+            out.append(
+                (e.event, 0.0 if prev is None else (e.ts_ns - prev) / 1e6)
+            )
+            prev = e.ts_ns
+        return out
+
+    def total_ms(self) -> float:
+        if len(self.events) < 2:
+            return 0.0
+        return (self.events[-1].ts_ns - self.events[0].ts_ns) / 1e6
+
+    def last_event(self) -> str:
+        return self.events[-1].event if self.events else ""
+
+    def to_jsonable(self) -> dict:
+        """Operator-facing encoding used by get_perf_events."""
+        return {
+            "events": [
+                {"event": e.event, "ts_ns": e.ts_ns, "node": e.node}
+                for e in self.events
+            ],
+            "deltas_ms": [
+                {"event": ev, "delta_ms": round(d, 3)}
+                for ev, d in self.deltas()
+            ],
+            "total_ms": round(self.total_ms(), 3),
+        }

@@ -62,6 +62,9 @@ class OpenrNode:
         self.route_updates = ReplicateQueue(name=f"{self.name}.routes")
         self.fib_updates = ReplicateQueue(name=f"{self.name}.fib")
         self.log_samples = ReplicateQueue(name=f"{self.name}.logs")
+        # completed convergence traces: Fib → Monitor (reference: the
+        # perf-event ring the fib drains into the monitor †)
+        self.perf_events = ReplicateQueue(name=f"{self.name}.perf")
 
         # ---- modules, dependency order ----------------------------------
         self.store = None
@@ -70,7 +73,10 @@ class OpenrNode:
 
             self.store = PersistentStore(store_path, counters=self.counters)
         self.monitor = Monitor(
-            config, self.log_samples.get_reader(), counters=self.counters
+            config,
+            self.log_samples.get_reader(),
+            perf_events_reader=self.perf_events.get_reader(),
+            counters=self.counters,
         )
         self.kvstore = KvStore(
             config,
@@ -98,6 +104,7 @@ class OpenrNode:
             self.route_updates.get_reader(),
             self.fib_handler,
             fib_updates_queue=self.fib_updates,
+            perf_events_queue=self.perf_events,
             counters=self.counters,
         )
         self.spark = Spark(
@@ -233,6 +240,7 @@ class OpenrNode:
             self.route_updates,
             self.fib_updates,
             self.log_samples,
+            self.perf_events,
         ):
             q.close()
 

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
 from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import perf
 from openr_tpu.types.events import (
     NeighborEvent,
     NeighborEventType,
@@ -440,6 +441,9 @@ class Spark(OpenrModule):
         self.events.push(
             NeighborEvent(
                 type=etype,
+                perf_events=perf.PerfEvents.start(
+                    perf.NEIGHBOR_EVENT, node=self.node_name
+                ),
                 info=NeighborInfo(
                     node_name=nb.node_name,
                     local_if=nb.local_if,

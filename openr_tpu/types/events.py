@@ -6,6 +6,8 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass, field
 
+from openr_tpu.monitor.perf import PerfEvents
+
 
 class NeighborEventType(enum.IntEnum):
     """reference: NeighborEventType in Types.thrift †."""
@@ -42,6 +44,12 @@ class NeighborInfo:
 class NeighborEvent:
     type: NeighborEventType
     info: NeighborInfo
+    # convergence trace carried along the pipeline (reference: the
+    # thrift event structs carry optional PerfEvents †); excluded from
+    # eq/hash — a trace annotates the event, it doesn't identify it
+    perf_events: PerfEvents | None = field(
+        default=None, compare=False
+    )
 
 
 @dataclass(frozen=True)

@@ -10,6 +10,7 @@ import hashlib
 from dataclasses import dataclass, field
 
 from openr_tpu.common.constants import DEFAULT_AREA
+from openr_tpu.monitor.perf import PerfEvents
 
 # TTL sentinel: key never expires (reference: openr/common/Constants.h †
 # kTtlInfinity == INT32_MIN in some versions; we use -1).
@@ -69,6 +70,11 @@ class Publication:
     node_ids: list[str] = field(default_factory=list)  # flood loop guard
     # set on full-sync responses: keys the responder wants from the requester
     to_be_updated_keys: list[str] | None = None
+    # convergence trace riding the update (reference: thrift Publication
+    # carries no perf, but the flooded AdjacencyDatabase values do †;
+    # publication-level here so Decision needn't decode to trace).
+    # compare=False: a trace annotates the update, it doesn't identify it
+    perf_events: PerfEvents | None = field(default=None, compare=False)
 
 
 @dataclass

@@ -79,6 +79,11 @@ class RouteUpdate:
     unicast_to_delete: list[IpPrefix] = field(default_factory=list)
     mpls_to_update: dict[int, RibMplsEntry] = field(default_factory=dict)
     mpls_to_delete: list[int] = field(default_factory=list)
+    # convergence traces of the publications folded into this delta
+    # (reference: DecisionRouteUpdate.perfEvents †); Fib stamps
+    # FIB_PROGRAMMED and completes them into Monitor's ring.
+    # compare=False: a trace annotates the delta, it doesn't identify it
+    perf_events: list = field(default_factory=list, compare=False)
 
     def empty(self) -> bool:
         return not (

@@ -252,12 +252,28 @@ def test_version_and_drained_links(live):
         if first and first not in ("node", "interface") and "-" != first[0]:
             ifname = first
             break
+    assert ifname is not None, (
+        f"no interface row found in `lm links` output:\n{out}"
+    )
     invoke(live, "a", "lm", "set-link-overload", ifname)
     out = invoke(live, "a", "lm", "links")
     assert "DRAINED" in out
     invoke(live, "a", "lm", "unset-link-overload", ifname)
     out = invoke(live, "a", "lm", "links")
     assert "DRAINED" not in out
+
+
+def test_perf_and_prometheus(live):
+    """`breeze perf` renders convergence traces with per-stage deltas
+    (initial convergence completes traces into the ring); `breeze
+    monitor prometheus` emits exposition text."""
+    out = invoke(live, "a", "perf")
+    assert "total" in out and "delta-ms" in out
+    assert "FIB_PROGRAMMED" in out
+
+    out = invoke(live, "a", "monitor", "prometheus")
+    assert "# TYPE openr_counter gauge" in out
+    assert 'openr_stat{node="a",key="decision.rebuild_ms",stat="p50"' in out
 
 
 def test_fib_add_del_static(live):

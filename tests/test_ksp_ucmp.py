@@ -364,3 +364,32 @@ def test_ksp_drained_link_excluded_both_directions_matches_oracle():
     i1, i2 = csr.name_to_id["node-1"], csr.name_to_id["node-2"]
     pairs = set(zip(csr.edge_src.tolist(), csr.edge_dst.tolist()))
     assert (i1, i2) not in pairs and (i2, i1) not in pairs
+
+    # MPLS parity under peer-side drain, from a node ADJACENT to the
+    # drained link (ADVICE high): give every adjacency an SR label —
+    # node-1's adjacency-label route onto the link node-2 drained must
+    # be absent in BOTH engines. The CPU oracle used to miss the
+    # link_drained_by_peer() check the TPU backend applies, leaving it
+    # label-switching onto the drained link.
+    labeled = []
+    next_label = 50_000
+    for db in dbs:
+        adjs = []
+        for a in db.adjacencies:
+            adjs.append(replace(a, adj_label=next_label))
+            next_label += 1
+        labeled.append(replace(db, adjacencies=tuple(adjs)))
+    ls2, ps2 = _state(labeled, [prefix_db])
+    cpu1 = compute_routes(ls2, ps2, "node-1")
+    tpu1 = TpuSpfSolver().compute_routes(ls2, ps2, "node-1")
+    assert cpu1.mpls_routes == tpu1.mpls_routes
+    db1 = ls2.adjacency_db("node-1")
+    lbl_to_2 = [
+        a.adj_label for a in db1.adjacencies
+        if a.other_node_name == "node-2" and a.adj_label
+    ]
+    assert lbl_to_2, "test topology must label the node-1→node-2 adjacency"
+    for lbl in lbl_to_2:
+        assert lbl not in cpu1.mpls_routes
+    # and the unicast side stays byte-equal too
+    assert cpu1.unicast_routes == tpu1.unicast_routes

@@ -1,0 +1,246 @@
+"""Convergence tracing + windowed counters + Prometheus export tests.
+
+Covers the three layers of the observability PR: the PerfEvents record
+itself (ordering/merge), the fb303-style windowed histogram percentiles,
+the Prometheus text exposition, and the end-to-end emulator contract —
+a forced link-down produces a queryable trace with ordered stage markers
+spanning spark → fib.
+"""
+
+import asyncio
+import re
+
+from openr_tpu.emulator import Cluster
+from openr_tpu.monitor import Counters, perf, render_prometheus
+from openr_tpu.rpc import RpcClient
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------------- PerfEvents
+
+
+def test_perf_events_ordering_and_deltas():
+    pe = perf.PerfEvents()
+    pe.add_perf_event(perf.NEIGHBOR_EVENT, node="a", ts_ns=1_000_000)
+    pe.add_perf_event(perf.ADJ_DB_UPDATED, node="a", ts_ns=3_000_000)
+    pe.add_perf_event(perf.KVSTORE_FLOODED, node="a", ts_ns=7_000_000)
+    assert [e.event for e in pe.events] == [
+        perf.NEIGHBOR_EVENT, perf.ADJ_DB_UPDATED, perf.KVSTORE_FLOODED,
+    ]
+    assert pe.deltas() == [
+        (perf.NEIGHBOR_EVENT, 0.0),
+        (perf.ADJ_DB_UPDATED, 2.0),
+        (perf.KVSTORE_FLOODED, 4.0),
+    ]
+    assert pe.total_ms() == 6.0
+    assert pe.last_event() == perf.KVSTORE_FLOODED
+    # default stamping uses a monotonic clock: appended order is ts order
+    auto = perf.PerfEvents.start(perf.NEIGHBOR_EVENT)
+    auto.add_perf_event(perf.ADJ_DB_UPDATED)
+    assert auto.events[0].ts_ns <= auto.events[1].ts_ns
+
+
+def test_perf_events_merge_sorts_and_caps():
+    a = perf.PerfEvents()
+    a.add_perf_event("X", ts_ns=10)
+    a.add_perf_event("Z", ts_ns=30)
+    b = perf.PerfEvents()
+    b.add_perf_event("Y", ts_ns=20)
+    merged = a.merge(b)
+    assert [e.event for e in merged.events] == ["X", "Y", "Z"]
+    # inputs unchanged (merge is pure)
+    assert [e.event for e in a.events] == ["X", "Z"]
+
+    big = perf.PerfEvents()
+    big.add_perf_event("ORIGIN", ts_ns=0)
+    for i in range(2 * perf.MAX_EVENTS_PER_TRACE):
+        big.add_perf_event("E", ts_ns=i + 1)
+    big.add_perf_event("LAST", ts_ns=10_000)
+    # a full trace evicts middle markers, never the origin or new stamps:
+    # it still spans origin→newest and still COMPLETES
+    assert len(big.events) == perf.MAX_EVENTS_PER_TRACE
+    assert big.events[0].event == "ORIGIN"
+    assert big.last_event() == "LAST"
+    assert big.total_ms() == 10_000 / 1e6
+    # merges leave headroom so the downstream stage stamps always fit
+    assert len(big.merge(a).events) < perf.MAX_EVENTS_PER_TRACE
+
+
+# ------------------------------------------------- windowed percentiles
+
+
+def test_windowed_percentiles():
+    c = Counters()
+    base = 10_000.0  # injected monotonic time
+    for _ in range(50):
+        c.add_value("lat_ms", 1.0, now=base)
+    for _ in range(50):
+        c.add_value("lat_ms", 100.0, now=base + 120)
+
+    snap = c.snapshot(now=base + 125)
+    # 60 s window: only the recent 100 ms samples
+    assert 70 < snap["lat_ms.p50.60"] < 130
+    assert 70 < snap["lat_ms.p99.60"] < 130
+    # 600 s window: both populations — the median straddles the older 1 ms
+    assert 0.7 < snap["lat_ms.p50.600"] < 1.3
+    assert 70 < snap["lat_ms.p99.600"] < 130
+    # all-time mirrors the 600 s view here
+    assert 0.7 < snap["lat_ms.p50"] < 1.3
+    assert 70 < snap["lat_ms.p99"] < 130
+    # legacy aggregates preserved
+    assert snap["lat_ms.count"] == 100
+    assert snap["lat_ms.min"] == 1.0 and snap["lat_ms.max"] == 100.0
+
+    # sliding: past the 600 s horizon the old samples leave the windows
+    # (a fresh add rolls the sub-bucket ring forward) — the 600 s view
+    # now holds only the new sample, while all-time keeps everything
+    c.add_value("lat_ms", 100.0, now=base + 1000)
+    snap = c.snapshot(now=base + 1000)
+    assert 70 < snap["lat_ms.p50.600"] < 130
+    assert snap["lat_ms.count"] == 101
+    assert snap["lat_ms.min"] == 1.0  # all-time still remembers
+
+
+def test_percentile_empty_window_absent():
+    c = Counters()
+    c.add_value("x", 5.0, now=100.0)
+    snap = c.snapshot(now=100.0 + 10_000)
+    assert "x.p50" in snap  # all-time survives
+    assert "x.p50.60" not in snap  # empty window exports nothing
+
+
+# ------------------------------------------------------------ prometheus
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*\})?"
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$"
+)
+
+
+def _assert_exposition_valid(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid exposition line: {line!r}"
+
+
+def test_render_prometheus_valid_and_escaped():
+    c = Counters()
+    c.increment("decision.spf_runs", 3)
+    c.set('weird"key\\with\nstuff', 1.5)
+    for v in (0.5, 1.0, 2.0, 400.0):
+        c.add_value("fib.program_ms", v, now=50.0)
+    text = render_prometheus(c, node='no"de', now=55.0)
+    _assert_exposition_valid(text)
+    assert "# TYPE openr_counter gauge" in text
+    assert "# TYPE openr_stat gauge" in text
+    assert "# TYPE openr_latency histogram" in text
+    # label escaping applied to both node and key labels
+    assert 'node="no\\"de"' in text
+    assert 'key="weird\\"key\\\\with\\nstuff"' in text
+    # windowed percentiles present for the stat key
+    assert re.search(
+        r'openr_stat\{[^}]*key="fib\.program_ms",stat="p99",window="60s"\}',
+        text,
+    )
+    # histogram: cumulative buckets end at the exact count
+    assert (
+        'openr_latency_bucket{node="no\\"de",key="fib.program_ms",'
+        'le="+Inf"} 4' in text
+    )
+    assert 'openr_latency_count{node="no\\"de",key="fib.program_ms"} 4' in text
+
+
+# ------------------------------------------------ end-to-end (emulator)
+
+
+def test_link_down_trace_and_ctrl_export():
+    """A forced link-down must produce a queryable PerfEvents trace with
+    ≥5 ordered stage markers spanning spark→fib, and the ctrl API must
+    export it plus exposition-valid Prometheus counters with windowed
+    spf/fib latency percentiles."""
+
+    async def body():
+        c = Cluster.from_edges(
+            [("a", "b"), ("b", "c"), ("a", "c")], enable_ctrl=True
+        )
+        await c.start()
+        try:
+            await c.wait_converged(timeout=20.0)
+            node_a = c.nodes["a"]
+            before = len(node_a.monitor.perf_traces)
+            c.fail_link("a", "b")
+            deadline = asyncio.get_running_loop().time() + 15.0
+            trace = None
+            while asyncio.get_running_loop().time() < deadline:
+                new = list(node_a.monitor.perf_traces)[before:]
+                done = [
+                    t for t in new
+                    if t.last_event() == perf.FIB_PROGRAMMED
+                    and len(t.events) >= 5
+                ]
+                if done:
+                    trace = done[0]
+                    break
+                await asyncio.sleep(0.05)
+            assert trace is not None, "no completed link-down trace"
+
+            names = [e.event for e in trace.events]
+            # ordered timestamps, known vocabulary, spark→fib span
+            ts = [e.ts_ns for e in trace.events]
+            assert ts == sorted(ts)
+            assert set(names) <= set(perf.ALL_MARKERS)
+            for required in (
+                perf.NEIGHBOR_EVENT,
+                perf.KVSTORE_FLOODED,
+                perf.SPF_SOLVE_DONE,
+                perf.FIB_PROGRAMMED,
+            ):
+                assert required in names, (required, names)
+            assert names[-1] == perf.FIB_PROGRAMMED
+            assert trace.total_ms() > 0
+
+            # ctrl API surfaces the trace with per-stage deltas
+            cli = RpcClient(port=node_a.ctrl.port)
+            await cli.connect()
+            try:
+                res = await cli.call("get_perf_events", {"limit": 50})
+                assert res["node"] == "a"
+                got = [
+                    t for t in res["traces"]
+                    if t["events"]
+                    and t["events"][-1]["event"] == perf.FIB_PROGRAMMED
+                    and len(t["events"]) >= 5
+                ]
+                assert got, "ctrl get_perf_events lost the trace"
+                assert all(
+                    d["delta_ms"] >= 0 for d in got[-1]["deltas_ms"]
+                )
+
+                prom = await cli.call("get_counters_prometheus")
+                assert prom["content_type"].startswith("text/plain")
+                _assert_exposition_valid(prom["text"])
+                for key in ("decision.spf_solve_ms", "fib.program_ms"):
+                    for stat in ("p50", "p99"):
+                        assert re.search(
+                            r'openr_stat\{[^}]*key="%s",stat="%s",'
+                            r'window="60s"\}' % (re.escape(key), stat),
+                            prom["text"],
+                        ), (key, stat)
+                # the completed trace fed the convergence stat
+                counters = await cli.call(
+                    "get_counters", {"prefix": "monitor.convergence_ms"}
+                )
+                assert counters.get("monitor.convergence_ms.count", 0) >= 1
+            finally:
+                await cli.close()
+        finally:
+            await c.stop()
+
+    run(body())
