@@ -30,7 +30,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import numpy as np  # noqa: E402
 
 
-def build_decision(adj_dbs, prefix_dbs, debounce_min=None, debounce_max=None):
+def build_decision(
+    adj_dbs, prefix_dbs, debounce_min=None, debounce_max=None,
+    solver="tpu", counters=None,
+):
     from openr_tpu.config import Config
     from openr_tpu.decision.decision import Decision
     from openr_tpu.messaging import ReplicateQueue
@@ -44,7 +47,9 @@ def build_decision(adj_dbs, prefix_dbs, debounce_min=None, debounce_max=None):
         cfg.node.decision.debounce_max_ms = debounce_max
     pubs = ReplicateQueue(name="pubs")
     routes = ReplicateQueue(name="routes")
-    dec = Decision(cfg, pubs.get_reader("d"), routes, solver="tpu")
+    dec = Decision(
+        cfg, pubs.get_reader("d"), routes, solver=solver, counters=counters
+    )
 
     def pub_for(db, version=1):
         return Publication(
@@ -218,6 +223,115 @@ async def churn(
     )
 
 
+def measure_prefix_churn(
+    nodes: int = 80,
+    rounds: int = 120,
+    burst: int = 8,
+    solver: str = "cpu",
+    force_full: bool = False,
+    seed: int = 3,
+    warmup_rounds: int = 4,
+):
+    """Prefix-only churn microbench: the dirty-scoped rebuild's headline.
+
+    Fixed fat-tree topology; a rotating pool of extra /24s is
+    re-advertised / withdrawn through the REAL publication path, and the
+    rebuild coroutine is driven directly (no debounce timing noise) —
+    each round is `burst` prefix events then one rebuild, sampling
+    `Decision._last_spf_ms`. On the scoped pipeline every round is a
+    `decision.rebuild.prefix_only` with ZERO SPF solves; with
+    `force_full=True` the SAME workload runs down the from-scratch path
+    (`Decision.force_full_rebuild`) for the speedup comparison.
+
+    Returns a dict with `prefix_churn_p50_ms`/p99 plus the pipeline
+    counters proving which path ran (`rebuild_prefix_only`,
+    `rebuild_full`, `area_solves`, `engine_solves`).
+    """
+    from openr_tpu.common import constants as C
+    from openr_tpu.monitor import Counters
+    from openr_tpu.types.kvstore import Publication, Value
+    from openr_tpu.types.network import IpPrefix
+    from openr_tpu.types.serde import to_wire
+    from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+    from openr_tpu.utils import topogen
+
+    k = max(4, int(round((nodes * 4 / 5) ** 0.5 / 2)) * 2)
+    adj_dbs, prefix_dbs = topogen.fat_tree(k, metric=10)
+    counters = Counters()
+    dec, _pubs, _routes, _pub_for = build_decision(
+        adj_dbs, prefix_dbs, solver=solver, counters=counters
+    )
+    dec.force_full_rebuild = force_full
+    rng = np.random.default_rng(seed)
+    names = [db.this_node_name for db in adj_dbs]
+    pool_n = 200  # rotating advertise/withdraw pool, one /24 each
+    advertised = [False] * pool_n
+    versions: dict[str, int] = {}
+
+    async def run():
+        samples: list[float] = []
+        await dec._rebuild_routes()  # initial full build (jit compile)
+        solves0 = dec._area_solves
+        for r in range(rounds):
+            for _ in range(burst):
+                i = int(rng.integers(0, pool_n))
+                node = names[i % len(names)]
+                pstr = f"10.77.{i}.0/24"
+                key = C.prefix_key(node, "0", pstr)
+                if advertised[i]:
+                    pub = Publication(area="0", expired_keys=[key])
+                else:
+                    versions[key] = versions.get(key, 0) + 1
+                    pub = Publication(
+                        area="0",
+                        key_vals={
+                            key: Value(
+                                version=versions[key],
+                                originator_id=node,
+                                value=to_wire(
+                                    PrefixDatabase(
+                                        this_node_name=node,
+                                        prefix_entries=(
+                                            PrefixEntry(
+                                                prefix=IpPrefix(prefix=pstr)
+                                            ),
+                                        ),
+                                        area="0",
+                                    )
+                                ),
+                            ).with_hash()
+                        },
+                    )
+                advertised[i] = not advertised[i]
+                dec.process_publication(pub)
+            await dec._rebuild_routes()
+            if r >= warmup_rounds:
+                samples.append(dec._last_spf_ms)
+        return samples, solves0
+
+    samples, solves0 = asyncio.new_event_loop().run_until_complete(run())
+    arr = np.array(samples) if samples else np.array([0.0])
+    engine_solves = (
+        dec._tpu.solve_count if dec._tpu is not None else dec._area_solves
+    )
+    return {
+        "prefix_churn_p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "prefix_churn_p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "nodes": len(adj_dbs),
+        "rounds": rounds,
+        "burst": burst,
+        "engine": solver,
+        "forced_full": force_full,
+        "rebuild_prefix_only": int(
+            counters.get("decision.rebuild.prefix_only")
+        ),
+        "rebuild_full": int(counters.get("decision.rebuild.full")),
+        "area_solves": dec._area_solves,
+        "churn_area_solves": dec._area_solves - solves0,
+        "engine_solves": engine_solves,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1280)
@@ -232,11 +346,59 @@ def main() -> None:
         "overrides JAX_PLATFORMS env, so the config must be set "
         "in-process before backend init)",
     )
+    ap.add_argument(
+        "--prefix-churn", action="store_true",
+        help="run the prefix-only (re-advertise/withdraw) workload on a "
+        "fixed topology instead of link flaps: measures the dirty-scoped "
+        "rebuild fast path, and the same workload forced down the "
+        "full-rebuild path for the speedup ratio",
+    )
+    ap.add_argument("--prefix-rounds", type=int, default=120)
+    ap.add_argument(
+        "--force-full", action="store_true",
+        help="with --prefix-churn: skip the scoped run and measure only "
+        "the forced full-rebuild path",
+    )
     args = ap.parse_args()
     if args.backend == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.prefix_churn:
+        full = measure_prefix_churn(
+            nodes=args.nodes, rounds=max(20, args.prefix_rounds // 3),
+            solver="tpu", force_full=True,
+        )
+        scoped = None
+        if not args.force_full:
+            scoped = measure_prefix_churn(
+                nodes=args.nodes, rounds=args.prefix_rounds, solver="tpu",
+            )
+        head = scoped or full
+        detail = {
+            "scoped": scoped,
+            "forced_full": full,
+            "backend": _backend(),
+        }
+        if scoped is not None:
+            detail["speedup_vs_full"] = round(
+                full["prefix_churn_p50_ms"]
+                / max(scoped["prefix_churn_p50_ms"], 1e-6),
+                1,
+            )
+        print(
+            json.dumps(
+                {
+                    "metric": "prefix_churn_p50_ms",
+                    "value": head["prefix_churn_p50_ms"],
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "detail": detail,
+                }
+            )
+        )
+        return
 
     from openr_tpu.utils import topogen
 

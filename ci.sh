@@ -50,9 +50,33 @@ if unknown:
 print(f"ok: {len(perf.ALL_MARKERS)} markers documented, {len(used)} in use")
 PYEOF
 
+echo "== decision.rebuild counter docs lint =="
+# every decision.rebuild.* counter name emitted in code must be
+# documented in docs/Monitor.md (same contract as the perf markers)
+python - <<'PYEOF'
+import pathlib
+import re
+import sys
+
+doc = pathlib.Path("docs/Monitor.md").read_text()
+names: set[str] = set()
+for p in pathlib.Path("openr_tpu").rglob("*.py"):
+    names.update(
+        re.findall(r"[\"'](decision\.rebuild\.[a-z_]+)[\"']", p.read_text())
+    )
+if not names:
+    sys.exit("no decision.rebuild.* counters found in code (lint broken?)")
+missing = sorted(n for n in names if n not in doc)
+if missing:
+    sys.exit(f"decision.rebuild counters missing from docs/Monitor.md: {missing}")
+print(f"ok: {len(names)} decision.rebuild counters documented")
+PYEOF
+
 echo "== pytest tier-1 (not slow) =="
 # the fast lane the PR driver gates on — includes the observability
-# suite (tests/test_perf.py) and the CLI/ctrl export tests
+# suite (tests/test_perf.py), the CLI/ctrl export tests, and the
+# dirty-scoped rebuild parity suite (tests/test_rebuild_scoped.py:
+# randomized churn byte-equality on both engines)
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors
 
 echo "== pytest slow lane =="

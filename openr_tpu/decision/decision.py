@@ -28,6 +28,9 @@ from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.common.throttle import AsyncDebounce
 from openr_tpu.config import Config
 from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.decision.oracle import (
+    assemble_prefix_routes as oracle_assemble_prefix_routes,
+)
 from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
 from openr_tpu.decision.oracle import metric_key
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
@@ -62,6 +65,39 @@ _ADJ_REUSE_CAP = 2048
 # (excess publications still rebuild, just untraced)
 _PERF_PENDING_CAP = 64
 
+# empty dirt marker for areas untouched since the last rebuild
+_NO_DIRT: frozenset = frozenset()
+
+
+def _fold_unicast(cur, entry):
+    """One cross-area selection step for a unicast prefix: `entry` (from
+    a later-sorted area) folded into the current winner `cur`."""
+    ek = metric_key(entry.best_entry) if entry.best_entry else (0, 0, 0)
+    ck = metric_key(cur.best_entry) if cur.best_entry else (0, 0, 0)
+    if ek > ck or (ek == ck and entry.igp_cost < cur.igp_cost):
+        return entry
+    if ek == ck and entry.igp_cost == cur.igp_cost:
+        return replace(
+            cur, nexthops=_union_nexthops(cur.nexthops, entry.nexthops)
+        )
+    return cur
+
+
+def _fold_mpls(cur, mentry):
+    """One cross-area selection step for an MPLS label route: lower IGP
+    cost wins outright; equal IGP cost unions the nexthop sets,
+    mirroring the unicast equal-cost multi-area ECMP rule (before this,
+    the strict `<` compare silently kept only the first sorted area's
+    nexthops at a tie)."""
+    mi, ci = _mpls_igp(mentry), _mpls_igp(cur)
+    if mi < ci:
+        return mentry
+    if mi > ci or mentry.nexthops == cur.nexthops:
+        return cur
+    return replace(
+        cur, nexthops=_union_nexthops(cur.nexthops, mentry.nexthops)
+    )
+
 
 def merge_area_ribs(
     per_area: dict[str, RouteDatabase], my_node: str
@@ -71,7 +107,7 @@ def merge_area_ribs(
     reference: openr/decision/SpfSolver.cpp † selectBestRoutes runs across
     ALL areas' prefix entries: highest metric key wins; at equal metrics and
     equal IGP cost the nexthop sets are unioned (equal-cost multi-area ECMP);
-    otherwise the lower-IGP-cost area wins.
+    MPLS label routes follow the same equal-IGP-cost union rule.
     """
     areas = sorted(per_area)
     if len(areas) == 1:
@@ -81,22 +117,44 @@ def merge_area_ribs(
         rdb = per_area[area]
         for prefix, entry in rdb.unicast_routes.items():
             cur = out.unicast_routes.get(prefix)
-            if cur is None:
-                out.unicast_routes[prefix] = entry
-                continue
-            ek = metric_key(entry.best_entry) if entry.best_entry else (0, 0, 0)
-            ck = metric_key(cur.best_entry) if cur.best_entry else (0, 0, 0)
-            if ek > ck or (ek == ck and entry.igp_cost < cur.igp_cost):
-                out.unicast_routes[prefix] = entry
-            elif ek == ck and entry.igp_cost == cur.igp_cost:
-                out.unicast_routes[prefix] = replace(
-                    cur,
-                    nexthops=_union_nexthops(cur.nexthops, entry.nexthops),
-                )
+            out.unicast_routes[prefix] = (
+                entry if cur is None else _fold_unicast(cur, entry)
+            )
         for label, mentry in rdb.mpls_routes.items():
             cur = out.mpls_routes.get(label)
-            if cur is None or _mpls_igp(mentry) < _mpls_igp(cur):
-                out.mpls_routes[label] = mentry
+            out.mpls_routes[label] = (
+                mentry if cur is None else _fold_mpls(cur, mentry)
+            )
+    return out
+
+
+def merge_area_ribs_scoped(
+    per_area: dict[str, RouteDatabase],
+    my_node: str,
+    base: RouteDatabase,
+    scope,
+) -> RouteDatabase:
+    """Cross-area re-selection for the `scope` prefixes only, against
+    the previous merged RIB `base` (valid because a prefix-only round
+    cannot change any out-of-scope unicast route or any MPLS route).
+    Folds areas in the same sorted order as `merge_area_ribs`, so the
+    scoped result is byte-equal to a full re-merge restricted to
+    `scope`."""
+    areas = sorted(per_area)
+    out = RouteDatabase(this_node_name=my_node)
+    out.unicast_routes = dict(base.unicast_routes)
+    out.mpls_routes = dict(base.mpls_routes)
+    for prefix in scope:
+        merged = None
+        for a in areas:
+            entry = per_area[a].unicast_routes.get(prefix)
+            if entry is None:
+                continue
+            merged = entry if merged is None else _fold_unicast(merged, entry)
+        if merged is None:
+            out.unicast_routes.pop(prefix, None)
+        else:
+            out.unicast_routes[prefix] = merged
     return out
 
 
@@ -236,6 +294,36 @@ class Decision(OpenrModule):
         # DECISION_RECEIVED; carried into the RouteUpdate the next
         # rebuild emits)
         self._pending_perf: list = []
+        # ---- dirty-scoped incremental rebuild state ----------------------
+        # area → None (topology dirt: SPF distances may change) | set of
+        # IpPrefix touched by prefix-only advertisements since the last
+        # rebuild. Accumulated by _drain_pending, consumed by
+        # _rebuild_routes AFTER the snapshot (so dirt recorded during
+        # the decode await still rides this rebuild). The contract: ALL
+        # LSDB mutations flow through process_publication — out-of-band
+        # mutations are caught by the LinkState/PrefixState revision
+        # checks in _compute_and_diff and fall back to a full rebuild.
+        self._dirty: dict[str, set | None] = {}
+        # area → PrefixState.rev bumps produced by the drains feeding
+        # the next rebuild: the revision check then requires the live
+        # rev to equal cached rev + tracked bumps EXACTLY, so an
+        # out-of-band prefix mutation is caught even on rounds that
+        # also carry legitimate (tracked) prefix dirt
+        self._dirty_ps_bumps: dict[str, int] = {}
+        # area → {"rdb", "art", "ls_rev", "ps_rev"}: the last rebuild's
+        # per-area RouteDatabase + SolveArtifact. Areas with no dirt
+        # reuse "rdb" with no solve at all; prefix-only dirt re-assembles
+        # just the touched prefixes against "art". Invalidated by
+        # topology dirt, revision mismatch, a failed rebuild, or an
+        # installed RibPolicy (see docs/Decision.md).
+        self._area_cache: dict[str, dict] = {}
+        # benchmarking/ops escape hatch: force every rebuild down the
+        # from-scratch path (bench_churn --prefix-churn --force-full
+        # measures the speedup the scoped pipeline buys with this)
+        self.force_full_rebuild = False
+        self._area_solves = 0  # _compute_area invocations (SPF solves)
+        self._rebuild_path = "full"  # path the last rebuild took
+        self._rebuild_cached_areas = 0
 
     # ------------------------------------------------------------------ run
 
@@ -310,26 +398,49 @@ class Decision(OpenrModule):
             self._pending_perf.append(pub.perf_events)
         return buffered
 
+    def _note_dirt(self, area: str, prefixes: set | None) -> None:
+        """Record rebuild dirt for one applied key: `prefixes` is None
+        for topology dirt (adj key update/expiry — SPF distances may
+        change) or the set of IpPrefix a prefix-only advertisement /
+        withdrawal touched. Topology dirt absorbs prefix dirt."""
+        cur = self._dirty.get(area, _NO_DIRT)
+        if cur is None or prefixes is None:
+            self._dirty[area] = None
+        elif cur is _NO_DIRT:
+            self._dirty[area] = set(prefixes)
+        else:
+            cur |= prefixes
+
     def _drain_pending(self, decoded: dict | None = None) -> bool:
         """Decode + apply the coalesced publication buffer. Idempotent,
         cheap when empty; called from every LSDB reader and at rebuild
         start. `decoded` (from _decode_batch) lets the rebuild path run
         the serde work in the solver thread — only the cheap LSDB apply
-        happens on the event loop."""
+        happens on the event loop. Each applied key is classified into
+        the per-area dirt set consumed by the next rebuild."""
         if not self._pending_kvs:
             return False
         batch, self._pending_kvs = self._pending_kvs, {}
         changed = False
         for (area, key), val in batch.items():
             ls, ps = self._get_area(area)
+            rev0 = ps.rev
             if val is None:
-                changed |= self._expire_key(ls, ps, key)
+                ch, dirt = self._expire_key(ls, ps, key)
             else:
                 db = (decoded or {}).get((area, key, id(val)))
                 if db is not None:
-                    changed |= self._apply_decoded(ls, ps, key, db)
+                    ch, dirt = self._apply_decoded(ls, ps, key, db)
                 else:
-                    changed |= self._apply_key(ls, ps, key, val)
+                    ch, dirt = self._apply_key(ls, ps, key, val)
+            bump = ps.rev - rev0
+            if bump:
+                self._dirty_ps_bumps[area] = (
+                    self._dirty_ps_bumps.get(area, 0) + bump
+                )
+            if ch:
+                changed = True
+                self._note_dirt(area, dirt)
         if changed:
             self.counters and self.counters.increment("decision.lsdb_changes")
         return changed
@@ -616,7 +727,9 @@ class Decision(OpenrModule):
                 continue
         return out
 
-    def _apply_decoded(self, ls, ps, key: str, db) -> bool:
+    def _apply_decoded(self, ls, ps, key: str, db):
+        """Apply one decoded db; returns (changed, dirt) where dirt is
+        None for topology changes or the set of touched prefixes."""
         if isinstance(db, AdjacencyDatabase):
             node, _schema = self._key_schema(key)
             if node is not None and db.this_node_name != node:
@@ -624,50 +737,90 @@ class Decision(OpenrModule):
                     "%s: adj key %s names node %s",
                     self.name, key, db.this_node_name,
                 )
-            return ls.update_adjacency_db(db)
-        return bool(ps.update_prefix_db(db))
+            return ls.update_adjacency_db(db), None
+        changed = ps.update_prefix_db(db)
+        return bool(changed), set(changed)
 
     def _apply_key(
         self, ls: LinkState, ps: PrefixState, key: str, val: Value
-    ) -> bool:
+    ):
         _node, schema = self._key_schema(key)
         if schema is None:
-            return False
+            return False, None
         try:
             db = self._decode_value(ls.area, key, val, schema)
         except Exception:  # noqa: BLE001 — corrupt key: ignore
             log.warning("%s: bad db in key %s", self.name, key)
-            return False
+            return False, None
         # update_prefix_db handles delete_prefix tombstones too, keyed
         # consistently by db.this_node_name
         return self._apply_decoded(ls, ps, key, db)
 
-    def _expire_key(self, ls: LinkState, ps: PrefixState, key: str) -> bool:
+    def _expire_key(self, ls: LinkState, ps: PrefixState, key: str):
+        """Returns (changed, dirt) like _apply_decoded: an adj-key
+        expiry removes a node from the graph (topology dirt); a prefix
+        withdrawal cannot move SPF distances, so it stays prefix dirt."""
         node = C.parse_adj_key(key)
         if node is not None:
             with self._adj_reuse_lock:
                 self._adj_reuse.pop((ls.area, key), None)
-            return ls.delete_adjacency_db(node)
+            return ls.delete_adjacency_db(node), None
         parsed = C.parse_prefix_key(key)
         if parsed is not None:
             pnode, _area, pfx = parsed
             if pfx:
                 from openr_tpu.types.network import IpPrefix
 
-                return ps.withdraw(pnode, IpPrefix(prefix=pfx))
-            return bool(ps.withdraw_node(pnode))
-        return False
+                p = IpPrefix(prefix=pfx)
+                return ps.withdraw(pnode, p), {p}
+            changed = ps.withdraw_node(pnode)
+            return bool(changed), set(changed)
+        return False, None
 
     # -------------------------------------------------------------- rebuild
 
-    def _compute_area(self, ls: LinkState, ps: PrefixState) -> RouteDatabase:
+    def _compute_area(
+        self, ls: LinkState, ps: PrefixState, want_artifact: bool = False
+    ):
+        """One area's full solve + assembly. With `want_artifact=True`
+        returns (rdb, SolveArtifact | None) for the dirty-scoped cache."""
+        self._area_solves += 1
         if self._tpu is not None:
-            return self._tpu.compute_routes(ls, ps, self.node_name)
+            return self._tpu.compute_routes(
+                ls, ps, self.node_name, return_artifact=want_artifact
+            )
         return oracle_compute_routes(
             ls, ps, self.node_name,
             enable_lfa=self.config.node.decision.enable_lfa,
             ksp_k=self.config.node.decision.ksp_paths,
+            return_artifact=want_artifact,
         )
+
+    def _reassemble_area(
+        self, cache: dict, ps: PrefixState, prefixes: set
+    ) -> RouteDatabase:
+        """Prefix-only fast path for one area: NO SPF solve or kernel
+        launch — route assembly re-runs ONLY for the touched prefixes
+        against the cached SolveArtifact; every other unicast route (and
+        every MPLS route, which cannot change without topology dirt) is
+        reused from the cached per-area RIB verbatim, so the downstream
+        diff short-circuits on identity outside the scope."""
+        old = cache["rdb"]
+        art = cache["art"]
+        rdb = RouteDatabase(this_node_name=self.node_name)
+        rdb.unicast_routes = dict(old.unicast_routes)
+        rdb.mpls_routes = dict(old.mpls_routes)
+        if self._tpu is not None:
+            entries = self._tpu.assemble_prefix_routes(art, ps, prefixes)
+        else:
+            entries = oracle_assemble_prefix_routes(art, ps, prefixes)
+        for p in prefixes:
+            e = entries.get(p)
+            if e is None:
+                rdb.unicast_routes.pop(p, None)
+            else:
+                rdb.unicast_routes[p] = e
+        return rdb
 
     def _snapshot_states(self) -> dict[str, tuple[LinkState, PrefixState]]:
         """Taken on the event loop, so the off-thread solve never races
@@ -692,14 +845,105 @@ class Decision(OpenrModule):
             self.rib_policy.apply(rdb)
         return rdb
 
-    def _compute_and_diff(self, states):
-        """Thread-side rebuild body: solve + assemble + diff against the
-        published RIB (self.rib is only rebound by the serialized
-        rebuild coroutine, so reading it here is race-free)."""
+    def _compute_and_diff(
+        self,
+        states,
+        dirt: dict | None = None,
+        ps_bumps: dict | None = None,
+    ):
+        """Thread-side rebuild body: dirty-scoped per-area compute + diff
+        against the published RIB (self.rib is only rebound by the
+        serialized rebuild coroutine, so reading it here is race-free).
+
+        `dirt` maps area → None (topology dirt) | set of touched
+        prefixes, as accumulated by _drain_pending; None for the whole
+        argument (legacy callers, e.g. profile_churn_rebuild) means
+        every area is topology-dirty — the from-scratch behavior.
+
+        Per-area dispatch:
+          * topology dirt, no/invalid cache → full solve (engine SPF),
+            cache refreshed with the new RouteDatabase + SolveArtifact;
+          * no dirt (revision-verified) → cached RIB reused, ZERO work;
+          * prefix-only dirt → scoped reassembly of just the touched
+            prefixes against the cached artifact, zero SPF solves.
+        When no area needed a solve, the final diff is scoped to the
+        union of touched prefixes (and no MPLS walk at all) instead of
+        the full O(routes) sweep. Fallback-to-full triggers: installed
+        RibPolicy, force_full_rebuild, first build (empty cache),
+        revision mismatch (out-of-band LSDB mutation), artifact absent
+        (node not in topology at solve time).
+        """
         ts = time.perf_counter()
-        new_rib = self.compute_rib(states)
+        if dirt is None:
+            dirt = {a: None for a in states}
+        scope: set | None = None
+        cached_areas = 0
+        if self.rib_policy is not None or self.force_full_rebuild:
+            # RibPolicy.apply mutates the MERGED rdb in place — which
+            # aliases the single-area rdb — so per-area caching is
+            # unsound while a policy is installed: recompute from
+            # scratch until it is removed/expired (empty cache then
+            # forces the next round full, picking up the policy drop)
+            self._area_cache.clear()
+            new_rib = self.compute_rib(states)
+            path = "full"
+        else:
+            per_area: dict[str, RouteDatabase] = {}
+            solved_any = False
+            prefix_scope: set = set()
+            bumps = ps_bumps or {}
+            for a, (ls, ps) in states.items():
+                d = dirt.get(a, _NO_DIRT)
+                cache = self._area_cache.get(a)
+                # revision guard: the topology rev must be unchanged and
+                # the prefix rev must equal cached rev + the EXACT bump
+                # count the tracked drains produced — so an out-of-band
+                # prefix mutation is caught even on a round that also
+                # carries legitimate prefix dirt
+                if cache is not None and (
+                    cache["ls_rev"] != ls.rev
+                    or ps.rev != cache["ps_rev"] + bumps.get(a, 0)
+                ):
+                    cache = None  # out-of-band mutation: doubt → full
+                # the artifact is only needed for prefix-dirt
+                # reassembly: a no-dirt area reuses its cached rdb even
+                # when the artifact is None (node outside the topology
+                # at solve time — the cached rdb is correctly empty)
+                if d is None or cache is None or (d and cache["art"] is None):
+                    rdb, art = self._compute_area(ls, ps, want_artifact=True)
+                    self._area_cache[a] = {
+                        "rdb": rdb, "art": art,
+                        "ls_rev": ls.rev, "ps_rev": ps.rev,
+                    }
+                    solved_any = True
+                elif not d:
+                    rdb = cache["rdb"]
+                    cached_areas += 1
+                else:
+                    rdb = self._reassemble_area(cache, ps, d)
+                    cache["rdb"] = rdb
+                    cache["ps_rev"] = ps.rev
+                    prefix_scope |= d
+                per_area[a] = rdb
+            path = "full" if solved_any else "prefix_only"
+            if solved_any:
+                new_rib = merge_area_ribs(per_area, self.node_name)
+            else:
+                scope = prefix_scope
+                if len(per_area) == 1:
+                    new_rib = next(iter(per_area.values()))
+                else:
+                    new_rib = merge_area_ribs_scoped(
+                        per_area, self.node_name, self.rib, scope
+                    )
         tr = time.perf_counter()
-        update = diff_route_dbs(self.rib, new_rib)
+        update = diff_route_dbs(
+            self.rib, new_rib,
+            prefix_scope=scope,
+            label_scope=() if scope is not None else None,
+        )
+        self._rebuild_path = path
+        self._rebuild_cached_areas = cached_areas
         self._compute_split_ms = {
             "compute_rib": (tr - ts) * 1e3,
             "diff": (time.perf_counter() - tr) * 1e3,
@@ -708,6 +952,7 @@ class Decision(OpenrModule):
 
     async def _rebuild_routes(self) -> None:
         t0 = time.perf_counter()
+        traces: list = []
         try:
             # serde decode of the coalesced flap backlog runs in the
             # worker thread (pure; keyed by value identity so a key
@@ -734,9 +979,15 @@ class Decision(OpenrModule):
                     perf.DECISION_DEBOUNCED, node=self.node_name
                 )
             states = self._snapshot_states()
+            # consume the dirt AFTER the snapshot: everything the
+            # snapshot folded in has its dirt recorded by now, and
+            # anything arriving later stays pending for the rebuild
+            # that will actually contain it
+            dirt, self._dirty = self._dirty, {}
+            ps_bumps, self._dirty_ps_bumps = self._dirty_ps_bumps, {}
             t2 = time.perf_counter()
             new_rib, update = await asyncio.to_thread(
-                self._compute_and_diff, states
+                self._compute_and_diff, states, dirt, ps_bumps
             )
             t3 = time.perf_counter()
             # published breakdown (round-2 verdict item 3): where a
@@ -752,13 +1003,42 @@ class Decision(OpenrModule):
             }
         except Exception:  # noqa: BLE001 — keep serving the old RIB
             log.exception("%s: route rebuild failed", self.name)
+            # the dirt describing this batch was consumed but its routes
+            # never landed: drop the per-area caches so the next rebuild
+            # is a from-scratch one instead of trusting a stale artifact
+            self._area_cache.clear()
+            # re-queue the already-dequeued traces so the retrying
+            # rebuild (which WILL contain these publications' route
+            # changes) completes them — otherwise the slowest, failure-
+            # retried convergence events would vanish from the very
+            # metric this tracing exists to surface
+            self._pending_perf = (traces + self._pending_perf)[
+                :_PERF_PENDING_CAP
+            ]
             return
         self._last_spf_ms = (time.perf_counter() - t0) * 1e3
         self._spf_runs += 1
+        prefix_only = self._rebuild_path == "prefix_only"
         for pe in traces:
+            pe.add_perf_event(
+                perf.REBUILD_PREFIX_ONLY if prefix_only else perf.REBUILD_FULL,
+                node=self.node_name,
+            )
             pe.add_perf_event(perf.SPF_SOLVE_DONE, node=self.node_name)
         if self.counters:
             self.counters.increment("decision.spf_runs")
+            if prefix_only:
+                self.counters.increment("decision.rebuild.prefix_only")
+            else:
+                self.counters.increment("decision.rebuild.full")
+            if self._rebuild_cached_areas:
+                self.counters.increment(
+                    "decision.rebuild.cached_areas",
+                    self._rebuild_cached_areas,
+                )
+            self.counters.set(
+                "decision.rebuild.area_solves", self._area_solves
+            )
             self.counters.set("decision.spf_ms", self._last_spf_ms)
             # windowed latency stats (exported as .p50/.p99 per window):
             # the solve+assembly+diff core, and the full rebuild
@@ -777,6 +1057,9 @@ class Decision(OpenrModule):
                     self.counters.set(f"decision.dev_cache.{k}", n)
                 for k, n in self._tpu.spf_kernel_stats.items():
                     self.counters.set(f"decision.spf.{k}", n)
+                self.counters.set(
+                    "decision.spf.solves", self._tpu.solve_count
+                )
         first = not self.rib_computed.is_set()
         self.rib = new_rib
         self._last_completed_snapshot_t0 = t0

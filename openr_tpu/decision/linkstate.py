@@ -219,6 +219,14 @@ class LinkState:
     def __init__(self, area: str = DEFAULT_AREA):
         self.area = area
         self._adj_dbs: dict[str, AdjacencyDatabase] = {}
+        # monotonic topology revision: bumped on every APPLIED mutation
+        # (update/delete that returned True) and carried by snapshots.
+        # Decision's dirty-scoped rebuild keys its per-area solve cache
+        # on this: a cached SolveArtifact is only reused while the
+        # revision still matches, so any out-of-band mutation (one that
+        # bypassed the publication path's dirt tracking) falls back to
+        # a full rebuild instead of silently reusing a stale solve.
+        self.rev = 0
         # CSR cache cell [base, patched, patched_upto], SHARED with
         # snapshots: a snapshot that builds the base CSR — or advances
         # the patched view — off-thread publishes it back through the
@@ -255,6 +263,7 @@ class LinkState:
         if old == db:
             return False
         self._adj_dbs[db.this_node_name] = db
+        self.rev += 1
         base = self._csr_cell[0]
         if base is not None and old is not None:
             delta = _metric_only_delta(old, db)
@@ -274,6 +283,7 @@ class LinkState:
     def delete_adjacency_db(self, node: str) -> bool:
         if node in self._adj_dbs:
             del self._adj_dbs[node]
+            self.rev += 1
             self._csr_cell = [None, None, 0]
             self._pending = []
             return True
@@ -286,6 +296,7 @@ class LinkState:
         to the live object until the next topology change."""
         snap = LinkState(self.area)
         snap._adj_dbs = dict(self._adj_dbs)
+        snap.rev = self.rev
         snap._csr_cell = self._csr_cell
         # _pending is rebound on mutation, never mutated, so sharing
         # the current reference is race-free; the patched view travels
@@ -637,6 +648,13 @@ class PrefixState:
             if self.withdraw(node, prefix):
                 changed.add(prefix)
         return changed
+
+    @property
+    def rev(self) -> int:
+        """Monotonic mutation revision (mirrors LinkState.rev): the
+        dirty-scoped rebuild uses it to prove a no-dirt area really is
+        unchanged before reusing its cached per-area RIB."""
+        return self._rev
 
     @property
     def prefixes(self) -> dict[IpPrefix, dict[str, PrefixEntry]]:

@@ -52,6 +52,31 @@ class SpfResult:
     first_hops: dict[str, set[str]]
 
 
+@dataclass
+class SolveArtifact:
+    """Reusable per-area solve state for prefix-scoped reassembly.
+
+    Both engines expose one from ``compute_routes(...,
+    return_artifact=True)``; Decision's dirty-scoped rebuild caches it
+    and, on prefix-only churn, calls ``assemble_prefix_routes`` against
+    it — re-running route assembly ONLY for the touched prefixes with
+    ZERO new SPF solves. Valid only while the area's topology (LinkState
+    revision) is unchanged; any topology dirt discards it.
+    """
+
+    my_node: str
+    ls: LinkState  # the snapshot the solve ran against
+    # --- oracle engine state -----------------------------------------
+    adj: dict[str, dict[str, int]] | None = None
+    spf: SpfResult | None = None
+    lfa_spfs: dict[str, SpfResult] | None = None
+    overloaded_set: set[str] | None = None  # lazy (KSP prefixes only)
+    ksp_k: int = 2
+    # --- TPU engine state: the solve() tuple -------------------------
+    # (csr, dist, fh, nbr_ids, lfa); see TpuSpfSolver.solve
+    solved: tuple | None = None
+
+
 def build_adjacency(ls: LinkState) -> dict[str, dict[str, int]]:
     """Directed min-metric adjacency with the bidirectional check applied."""
     nodes = set(ls.nodes)
@@ -254,17 +279,97 @@ def _lfa_backups(
     )
 
 
+def _unicast_route(art: SolveArtifact, prefix, per_node) -> RibEntry | None:
+    """One prefix's best route against a completed solve, or None when
+    no route is programmed (unreachable, local, or below min_nexthop).
+
+    The single source of truth for the per-prefix selection semantics:
+    the full `compute_routes` loop and the prefix-scoped
+    `assemble_prefix_routes` fast path both call it, so the scoped
+    rebuild is byte-equal to a from-scratch build by construction.
+    """
+    ls, my_node, spf, adj = art.ls, art.my_node, art.spf, art.adj
+    reachable = {
+        n: e
+        for n, e in per_node.items()
+        if n == my_node or (n in spf.dist and spf.first_hops.get(n))
+    }
+    if not reachable:
+        return None
+    best_key = max(metric_key(e) for e in reachable.values())
+    best_nodes = sorted(
+        n for n, e in reachable.items() if metric_key(e) == best_key
+    )
+    if my_node in best_nodes:
+        return None  # local prefix: not programmed via SPF
+    if (
+        reachable[best_nodes[0]].forwarding_algorithm
+        == ForwardingAlgorithm.KSP2_ED_ECMP
+    ):
+        if art.overloaded_set is None:  # built lazily, once
+            art.overloaded_set = {
+                n for n in ls.nodes if ls.is_node_overloaded(n)
+            }
+        return ksp2_route(
+            ls, my_node, prefix, reachable, best_nodes, adj,
+            art.overloaded_set, k=art.ksp_k,
+        )
+    min_igp = min(spf.dist[n] for n in best_nodes)
+    chosen = [n for n in best_nodes if spf.dist[n] == min_igp]
+    weights = ucmp_weights({n: reachable[n] for n in chosen})
+    nexthops = _nexthops_to_nodes(ls, my_node, spf, chosen, weights)
+    if not nexthops:
+        return None
+    best_entry = reachable[chosen[0]]
+    if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
+        return None  # reference: drop route below min_nexthop †
+    backups: tuple[NextHop, ...] = ()
+    if art.lfa_spfs is not None:
+        backups = _lfa_backups(ls, my_node, spf, art.lfa_spfs, chosen)
+    return RibEntry(
+        prefix=prefix,
+        nexthops=nexthops,
+        best_node=chosen[0],
+        best_nodes=tuple(best_nodes),
+        best_entry=best_entry,
+        igp_cost=min_igp,
+        backup_nexthops=backups,
+    )
+
+
+def assemble_prefix_routes(
+    art: SolveArtifact, ps: PrefixState, prefixes
+) -> dict:
+    """Prefix-scoped reassembly against a cached artifact: routes for
+    `prefixes` only, with zero SPF work. A prefix absent from the result
+    has no route (withdrawn/unreachable/local) — the caller deletes it."""
+    out: dict = {}
+    for prefix in sorted(prefixes):
+        per_node = ps.prefixes.get(prefix)
+        if not per_node:
+            continue  # fully withdrawn
+        entry = _unicast_route(art, prefix, per_node)
+        if entry is not None:
+            out[prefix] = entry
+    return out
+
+
 def compute_routes(
     ls: LinkState,
     ps: PrefixState,
     my_node: str,
     enable_lfa: bool = False,
     ksp_k: int = 2,
-) -> RouteDatabase:
-    """Full RIB for `my_node` (reference: SpfSolver::buildRouteDb †)."""
+    return_artifact: bool = False,
+):
+    """Full RIB for `my_node` (reference: SpfSolver::buildRouteDb †).
+
+    With `return_artifact=True`, returns (rdb, SolveArtifact | None) —
+    the artifact feeds `assemble_prefix_routes` for dirty-scoped
+    rebuilds (None when my_node is not in the topology)."""
     rdb = RouteDatabase(this_node_name=my_node)
     if my_node not in set(ls.nodes):
-        return rdb
+        return (rdb, None) if return_artifact else rdb
     adj = build_adjacency(ls)
     spf = run_spf(ls, my_node, adj)
     lfa_spfs: dict[str, SpfResult] | None = None
@@ -274,59 +379,16 @@ def compute_routes(
         lfa_spfs = {
             n: run_spf(ls, n, adj) for n in sorted(adj.get(my_node, {}))
         }
+    art = SolveArtifact(
+        my_node=my_node, ls=ls, adj=adj, spf=spf, lfa_spfs=lfa_spfs,
+        ksp_k=ksp_k,
+    )
 
     # ---- unicast ----------------------------------------------------------
-    overloaded_set = None  # built lazily, once, for KSP2 prefixes
     for prefix, per_node in sorted(ps.prefixes.items()):
-        reachable = {
-            n: e
-            for n, e in per_node.items()
-            if n == my_node or (n in spf.dist and spf.first_hops.get(n))
-        }
-        if not reachable:
-            continue
-        best_key = max(metric_key(e) for e in reachable.values())
-        best_nodes = sorted(
-            n for n, e in reachable.items() if metric_key(e) == best_key
-        )
-        if my_node in best_nodes:
-            continue  # local prefix: not programmed via SPF
-        if (
-            reachable[best_nodes[0]].forwarding_algorithm
-            == ForwardingAlgorithm.KSP2_ED_ECMP
-        ):
-            if overloaded_set is None:
-                overloaded_set = {
-                    n for n in ls.nodes if ls.is_node_overloaded(n)
-                }
-            ksp_entry = ksp2_route(
-                ls, my_node, prefix, reachable, best_nodes, adj,
-                overloaded_set, k=ksp_k,
-            )
-            if ksp_entry is not None:
-                rdb.unicast_routes[prefix] = ksp_entry
-            continue
-        min_igp = min(spf.dist[n] for n in best_nodes)
-        chosen = [n for n in best_nodes if spf.dist[n] == min_igp]
-        weights = ucmp_weights({n: reachable[n] for n in chosen})
-        nexthops = _nexthops_to_nodes(ls, my_node, spf, chosen, weights)
-        if not nexthops:
-            continue
-        best_entry = reachable[chosen[0]]
-        if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
-            continue  # reference: drop route below min_nexthop †
-        backups: tuple[NextHop, ...] = ()
-        if lfa_spfs is not None:
-            backups = _lfa_backups(ls, my_node, spf, lfa_spfs, chosen)
-        rdb.unicast_routes[prefix] = RibEntry(
-            prefix=prefix,
-            nexthops=nexthops,
-            best_node=chosen[0],
-            best_nodes=tuple(best_nodes),
-            best_entry=best_entry,
-            igp_cost=min_igp,
-            backup_nexthops=backups,
-        )
+        entry = _unicast_route(art, prefix, per_node)
+        if entry is not None:
+            rdb.unicast_routes[prefix] = entry
 
     # ---- MPLS node-segment routes ----------------------------------------
     # reference: SpfSolver::createMplsRoutes † — for every remote node with a
@@ -383,4 +445,4 @@ def compute_routes(
                     ),
                 ),
             )
-    return rdb
+    return (rdb, art) if return_artifact else rdb

@@ -29,7 +29,7 @@ from openr_tpu.decision.ksp import (
     ucmp_weights,
 )
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
-from openr_tpu.decision.oracle import metric_key
+from openr_tpu.decision.oracle import SolveArtifact, metric_key
 from openr_tpu.monitor import profiling
 from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
@@ -246,6 +246,10 @@ class TpuSpfSolver:
         self.spf_kernel_stats = {
             "gs_active": 0, "gs_disabled": 0, "uniform_metric": 0,
         }
+        # SPF engine invocations (kernel launch OR native solve): the
+        # dirty-scoped rebuild's acceptance signal — prefix-only churn
+        # must leave this flat while routes still update (tested)
+        self.solve_count = 0
         # cross-rebuild MPLS RibMplsEntry cache: {slot_fingerprint:
         # {(label, node, class_token, igp): RibMplsEntry}} — see the
         # MPLS section of _assemble_routes. LRU over fingerprints; the
@@ -623,6 +627,7 @@ class TpuSpfSolver:
         my_id = csr.name_to_id.get(my_node)
         if my_id is None:
             return None
+        self.solve_count += 1
         nbr_key = (csr.base_version, my_id)
         nbr_ids = self._nbr_cache.get(nbr_key)
         if nbr_ids is None:
@@ -722,14 +727,62 @@ class TpuSpfSolver:
     # ------------------------------------------------------------------ RIB
 
     def compute_routes(
-        self, ls: LinkState, ps: PrefixState, my_node: str
-    ) -> RouteDatabase:
+        self,
+        ls: LinkState,
+        ps: PrefixState,
+        my_node: str,
+        return_artifact: bool = False,
+    ):
+        """Full RIB. With `return_artifact=True`, returns
+        (rdb, SolveArtifact | None) — same contract as the oracle's
+        `compute_routes`: the artifact wraps the solve() tuple so
+        `assemble_prefix_routes` can re-assemble touched prefixes under
+        prefix-only churn with zero new kernel launches."""
         rdb = RouteDatabase(this_node_name=my_node)
         solved = self.solve(ls, my_node)
         if solved is None:
-            return rdb
+            return (rdb, None) if return_artifact else rdb
         with profiling.annotate("spf:rib_assembly"):
-            return self._assemble_routes(rdb, ls, ps, my_node, solved)
+            rdb = self._assemble_routes(rdb, ls, ps, my_node, solved)
+        if return_artifact:
+            return rdb, SolveArtifact(
+                my_node=my_node, ls=ls, ksp_k=self.ksp_k, solved=solved
+            )
+        return rdb
+
+    def assemble_prefix_routes(
+        self, art: SolveArtifact, ps: PrefixState, prefixes
+    ) -> dict:
+        """Prefix-scoped reassembly against a cached artifact (the
+        dirty-scoped rebuild's prefix-only fast path): routes for
+        `prefixes` only, re-using the cached solve — no SPF kernel
+        launch. Runs every scoped prefix down the general per-prefix
+        path (byte-equal to the vectorized plain path — same selection
+        semantics, tested); KSP prefixes still batch into one device
+        call, which is per-prefix path work, not an SPF solve. A prefix
+        absent from the result has no route — the caller deletes it."""
+        csr, dist, fh, nbr_ids, lfa = art.solved
+        ls, my_node = art.ls, art.my_node
+        my_id = csr.name_to_id[my_node]
+        d_root = dist[:, 0]
+        fh_any = fh.any(axis=0)
+        slot_cache = self._nbr_slot_cache(csr, my_id, nbr_ids)
+        mk_nexthops_cached = self._mk_nexthops_cached_factory(
+            fh, slot_cache, ls.area
+        )
+        items = []
+        for p in sorted(prefixes):
+            per_node = ps.prefixes.get(p)
+            if per_node:
+                items.append((p, dict(per_node)))
+        out: dict = {}
+        ksp_jobs = self._unicast_general(
+            csr, ls, my_node, my_id, d_root, fh, fh_any, nbr_ids, lfa,
+            dist, slot_cache, mk_nexthops_cached, items, out,
+        )
+        if ksp_jobs:
+            self._ksp_batch(csr, ls, my_node, my_id, d_root, ksp_jobs, out)
+        return out
 
     def _assemble_routes(self, rdb, ls, ps, my_node, solved):
         csr, dist, fh, nbr_ids, lfa = solved
@@ -740,29 +793,9 @@ class TpuSpfSolver:
         # prefix made RIB assembly O(P·B·V) and dominated churn rebuilds
         fh_any = fh.any(axis=0)  # [Vp]
         slot_cache = self._nbr_slot_cache(csr, my_id, nbr_ids)
-        # unweighted nexthop sets repeat across prefixes anycast to the
-        # same originator set and again in the MPLS node-segment loop —
-        # memoize by the UNION FIRST-HOP COLUMN, not the target ids: in a
-        # fat-tree every far destination shares the same up-link set, so
-        # thousands of distinct dest sets collapse into a handful of
-        # (first-hop set, igp) classes and NextHop construction runs once
-        # per class instead of once per prefix
-        mk_memo: dict[tuple, tuple[NextHop, ...]] = {}
-
-        def fh_union_col(targets: np.ndarray) -> np.ndarray:
-            if len(targets) == 1:
-                return fh[:, int(targets[0])]
-            return fh[:, targets].any(axis=1)
-
-        def mk_nexthops_cached(targets: np.ndarray, igp: int):
-            col = fh_union_col(targets)
-            key = (col.tobytes(), igp)
-            got = mk_memo.get(key)
-            if got is None:
-                got = mk_memo[key] = self._mk_nexthops_union(
-                    slot_cache, col, igp, ls.area
-                )
-            return got
+        mk_nexthops_cached = self._mk_nexthops_cached_factory(
+            fh, slot_cache, ls.area
+        )
 
         # per-destination-node (first-hop column, igp) equivalence
         # classes, computed ONCE and shared by the plain-prefix and MPLS
@@ -878,76 +911,16 @@ class TpuSpfSolver:
             )
 
         # ---- unicast: general path ---------------------------------------
-        ksp_jobs: list[tuple] = []  # (prefix, reachable, best_nodes)
-        for prefix, per_node in complex_items:
-            reachable = {}
-            for n, e in per_node.items():
-                nid = csr.name_to_id.get(n)
-                if n == my_node:
-                    reachable[n] = e
-                elif (
-                    nid is not None
-                    and d_root[nid] < INF_DIST
-                    and fh_any[nid]
-                ):
-                    reachable[n] = e
-            if not reachable:
-                continue
-            best_key = max(metric_key(e) for e in reachable.values())
-            best_nodes = sorted(
-                n for n, e in reachable.items() if metric_key(e) == best_key
-            )
-            if my_node in best_nodes:
-                continue  # local prefix
-            if (
-                reachable[best_nodes[0]].forwarding_algorithm
-                == ForwardingAlgorithm.KSP2_ED_ECMP
-            ):
-                # batched on device after the loop: ONE vectorized
-                # k-disjoint-paths solve for every KSP prefix at once
-                # (the reference re-runs Dijkstra per prefix per path †)
-                ksp_jobs.append((prefix, reachable, best_nodes))
-                continue
-            ids = np.array(
-                [csr.name_to_id[n] for n in best_nodes], dtype=np.int64
-            )
-            igps = d_root[ids]
-            min_igp = int(igps.min())
-            chosen = ids[igps == min_igp]
-            chosen_names = sorted(csr.node_names[i] for i in chosen)
-            weights = ucmp_weights({n: reachable[n] for n in chosen_names})
-            if weights is None:
-                nexthops = mk_nexthops_cached(chosen, min_igp)
-            else:
-                nexthops = self._mk_nexthops(
-                    csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area,
-                    weights=weights,
-                    target_names=csr.node_names,
-                    slot_cache=slot_cache,
-                )
-            if not nexthops:
-                continue
-            best_entry = reachable[chosen_names[0]]
-            if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
-                continue
-            backups: tuple[NextHop, ...] = ()
-            if lfa is not None:
-                backups = self._mk_backup_nexthops(
-                    csr, my_id, nbr_ids, fh, lfa, dist, chosen, ls.area,
-                    slot_cache,
-                )
-            rdb.unicast_routes[prefix] = RibEntry(
-                prefix=prefix,
-                nexthops=nexthops,
-                best_node=chosen_names[0],
-                best_nodes=tuple(best_nodes),
-                best_entry=best_entry,
-                igp_cost=min_igp,
-                backup_nexthops=backups,
-            )
-
+        ksp_jobs = self._unicast_general(
+            csr, ls, my_node, my_id, d_root, fh, fh_any, nbr_ids, lfa,
+            dist, slot_cache, mk_nexthops_cached, complex_items,
+            rdb.unicast_routes,
+        )
         if ksp_jobs:
-            self._ksp_batch(csr, ls, my_node, my_id, d_root, ksp_jobs, rdb)
+            self._ksp_batch(
+                csr, ls, my_node, my_id, d_root, ksp_jobs,
+                rdb.unicast_routes,
+            )
 
         # ---- MPLS node segments ------------------------------------------
         # cross-rebuild cache: under churn most nodes keep the same
@@ -1078,6 +1051,132 @@ class TpuSpfSolver:
                 )
         return rdb
 
+    def _mk_nexthops_cached_factory(
+        self,
+        fh: np.ndarray,
+        slot_cache: list[list[tuple[str, str]]],
+        area: str,
+    ):
+        """Memoized unweighted NextHop construction, shared by the
+        unicast general path, the MPLS node-segment loop, and the
+        prefix-scoped reassembly fast path.
+
+        Unweighted nexthop sets repeat across prefixes anycast to the
+        same originator set and again in the MPLS node-segment loop —
+        memoize by the UNION FIRST-HOP COLUMN, not the target ids: in a
+        fat-tree every far destination shares the same up-link set, so
+        thousands of distinct dest sets collapse into a handful of
+        (first-hop set, igp) classes and NextHop construction runs once
+        per class instead of once per prefix."""
+        mk_memo: dict[tuple, tuple[NextHop, ...]] = {}
+
+        def fh_union_col(targets: np.ndarray) -> np.ndarray:
+            if len(targets) == 1:
+                return fh[:, int(targets[0])]
+            return fh[:, targets].any(axis=1)
+
+        def mk_nexthops_cached(targets: np.ndarray, igp: int):
+            col = fh_union_col(targets)
+            key = (col.tobytes(), igp)
+            got = mk_memo.get(key)
+            if got is None:
+                got = mk_memo[key] = self._mk_nexthops_union(
+                    slot_cache, col, igp, area
+                )
+            return got
+
+        return mk_nexthops_cached
+
+    def _unicast_general(
+        self,
+        csr: CsrGraph,
+        ls: LinkState,
+        my_node: str,
+        my_id: int,
+        d_root: np.ndarray,
+        fh: np.ndarray,
+        fh_any: np.ndarray,
+        nbr_ids: list[int],
+        lfa,
+        dist,
+        slot_cache: list[list[tuple[str, str]]],
+        mk_nexthops_cached,
+        items,
+        out: dict,
+    ) -> list[tuple]:
+        """The general per-prefix unicast path (anycast, UCMP, KSP,
+        min_nexthop, LFA — and, on the scoped-reassembly path, plain
+        prefixes too). Writes routes into `out`; returns the KSP jobs
+        for the caller's single batched `_ksp_batch` device call."""
+        ksp_jobs: list[tuple] = []  # (prefix, reachable, best_nodes)
+        for prefix, per_node in items:
+            reachable = {}
+            for n, e in per_node.items():
+                nid = csr.name_to_id.get(n)
+                if n == my_node:
+                    reachable[n] = e
+                elif (
+                    nid is not None
+                    and d_root[nid] < INF_DIST
+                    and fh_any[nid]
+                ):
+                    reachable[n] = e
+            if not reachable:
+                continue
+            best_key = max(metric_key(e) for e in reachable.values())
+            best_nodes = sorted(
+                n for n, e in reachable.items() if metric_key(e) == best_key
+            )
+            if my_node in best_nodes:
+                continue  # local prefix
+            if (
+                reachable[best_nodes[0]].forwarding_algorithm
+                == ForwardingAlgorithm.KSP2_ED_ECMP
+            ):
+                # batched on device after the loop: ONE vectorized
+                # k-disjoint-paths solve for every KSP prefix at once
+                # (the reference re-runs Dijkstra per prefix per path †)
+                ksp_jobs.append((prefix, reachable, best_nodes))
+                continue
+            ids = np.array(
+                [csr.name_to_id[n] for n in best_nodes], dtype=np.int64
+            )
+            igps = d_root[ids]
+            min_igp = int(igps.min())
+            chosen = ids[igps == min_igp]
+            chosen_names = sorted(csr.node_names[i] for i in chosen)
+            weights = ucmp_weights({n: reachable[n] for n in chosen_names})
+            if weights is None:
+                nexthops = mk_nexthops_cached(chosen, min_igp)
+            else:
+                nexthops = self._mk_nexthops(
+                    csr, my_id, nbr_ids, fh, chosen, min_igp, ls.area,
+                    weights=weights,
+                    target_names=csr.node_names,
+                    slot_cache=slot_cache,
+                )
+            if not nexthops:
+                continue
+            best_entry = reachable[chosen_names[0]]
+            if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
+                continue
+            backups: tuple[NextHop, ...] = ()
+            if lfa is not None:
+                backups = self._mk_backup_nexthops(
+                    csr, my_id, nbr_ids, fh, lfa, dist, chosen, ls.area,
+                    slot_cache,
+                )
+            out[prefix] = RibEntry(
+                prefix=prefix,
+                nexthops=nexthops,
+                best_node=chosen_names[0],
+                best_nodes=tuple(best_nodes),
+                best_entry=best_entry,
+                igp_cost=min_igp,
+                backup_nexthops=backups,
+            )
+        return ksp_jobs
+
     def _ksp_batch(
         self,
         csr: CsrGraph,
@@ -1086,7 +1185,7 @@ class TpuSpfSolver:
         my_id: int,
         d_root: np.ndarray,
         jobs: list[tuple],
-        rdb: RouteDatabase,
+        out: dict,
     ) -> None:
         """All KSP prefixes in ONE vectorized device call (BASELINE
         config 4): k edge-disjoint paths per job via k successive masked
@@ -1211,7 +1310,7 @@ class TpuSpfSolver:
                     ls, my_node, prefix, reachable, best_nodes, host_paths
                 )
                 if entry is not None:
-                    rdb.unicast_routes[prefix] = entry
+                    out[prefix] = entry
 
     @staticmethod
     def _mk_backup_nexthops(

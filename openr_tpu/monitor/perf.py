@@ -17,6 +17,9 @@ docs/Monitor.md — ci.sh lints this):
   KVSTORE_FLOODED     KvStore accepted + published the adj/prefix update
   DECISION_RECEIVED   Decision buffered the publication
   DECISION_DEBOUNCED  the debounce window fired; rebuild started
+  REBUILD_FULL        the rebuild took the from-scratch path (SPF solves)
+  REBUILD_PREFIX_ONLY the rebuild took the dirty-scoped prefix-only path
+                      (zero SPF solves — cached artifacts re-assembled)
   SPF_SOLVE_DONE      SPF solve + RIB assembly + diff finished
   ROUTE_UPDATE_SENT   the route delta was pushed toward Fib
   FIB_PROGRAMMED      Fib programmed the delta into the dataplane
@@ -40,17 +43,24 @@ ADJ_DB_UPDATED = "ADJ_DB_UPDATED"
 KVSTORE_FLOODED = "KVSTORE_FLOODED"
 DECISION_RECEIVED = "DECISION_RECEIVED"
 DECISION_DEBOUNCED = "DECISION_DEBOUNCED"
+REBUILD_FULL = "REBUILD_FULL"
+REBUILD_PREFIX_ONLY = "REBUILD_PREFIX_ONLY"
 SPF_SOLVE_DONE = "SPF_SOLVE_DONE"
 ROUTE_UPDATE_SENT = "ROUTE_UPDATE_SENT"
 FIB_PROGRAMMED = "FIB_PROGRAMMED"
 
-# canonical spark→fib stage order; doubles as the doc-lint source of truth
+# canonical spark→fib stage order; doubles as the doc-lint source of
+# truth. REBUILD_FULL / REBUILD_PREFIX_ONLY are alternatives at the same
+# stage position — exactly one of them is stamped per rebuild, recording
+# which pipeline the debounced batch took.
 ALL_MARKERS = (
     NEIGHBOR_EVENT,
     ADJ_DB_UPDATED,
     KVSTORE_FLOODED,
     DECISION_RECEIVED,
     DECISION_DEBOUNCED,
+    REBUILD_FULL,
+    REBUILD_PREFIX_ONLY,
     SPF_SOLVE_DONE,
     ROUTE_UPDATE_SENT,
     FIB_PROGRAMMED,

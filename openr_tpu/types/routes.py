@@ -94,29 +94,64 @@ class RouteUpdate:
         )
 
 
-def diff_route_dbs(old: RouteDatabase, new: RouteDatabase) -> RouteUpdate:
+def diff_route_dbs(
+    old: RouteDatabase,
+    new: RouteDatabase,
+    prefix_scope=None,
+    label_scope=None,
+) -> RouteUpdate:
     """Compute the delta update turning `old` into `new`.
 
     reference: openr/decision/Decision.cpp † (Decision computes deltas on
     rebuildRoutes; Fib re-diffs against programmed state).
+
+    `prefix_scope` / `label_scope` (iterables of candidate keys) restrict
+    the walk: only scoped keys are compared, everything else is asserted
+    unchanged BY THE CALLER. Decision's prefix-only rebuilds satisfy that
+    by construction — the new RIB reuses the previous RIB's entry objects
+    verbatim outside the touched-prefix set — so the diff is O(|scope|)
+    instead of a full O(routes) sweep. None (the default) walks
+    everything.
     """
     upd = RouteUpdate()
-    for prefix, entry in new.unicast_routes.items():
-        # identity first: the solver's cross-rebuild entry caches hand
-        # back the same frozen object for unchanged routes, making the
-        # steady-state diff a pointer compare instead of a
-        # field-by-field dataclass equality over the nexthop tuples
-        prev = old.unicast_routes.get(prefix)
-        if prev is not entry and prev != entry:
-            upd.unicast_to_update[prefix] = entry
-    for prefix in old.unicast_routes:
-        if prefix not in new.unicast_routes:
-            upd.unicast_to_delete.append(prefix)
-    for label, mentry in new.mpls_routes.items():
-        prev_m = old.mpls_routes.get(label)
-        if prev_m is not mentry and prev_m != mentry:
-            upd.mpls_to_update[label] = mentry
-    for label in old.mpls_routes:
-        if label not in new.mpls_routes:
-            upd.mpls_to_delete.append(label)
+    if prefix_scope is None:
+        for prefix, entry in new.unicast_routes.items():
+            # identity first: the solver's cross-rebuild entry caches
+            # hand back the same frozen object for unchanged routes,
+            # making the steady-state diff a pointer compare instead of
+            # a field-by-field dataclass equality over the nexthop tuples
+            prev = old.unicast_routes.get(prefix)
+            if prev is not entry and prev != entry:
+                upd.unicast_to_update[prefix] = entry
+        for prefix in old.unicast_routes:
+            if prefix not in new.unicast_routes:
+                upd.unicast_to_delete.append(prefix)
+    else:
+        for prefix in sorted(prefix_scope):  # sorted: deterministic delta
+            entry = new.unicast_routes.get(prefix)
+            if entry is None:
+                if prefix in old.unicast_routes:
+                    upd.unicast_to_delete.append(prefix)
+                continue
+            prev = old.unicast_routes.get(prefix)
+            if prev is not entry and prev != entry:
+                upd.unicast_to_update[prefix] = entry
+    if label_scope is None:
+        for label, mentry in new.mpls_routes.items():
+            prev_m = old.mpls_routes.get(label)
+            if prev_m is not mentry and prev_m != mentry:
+                upd.mpls_to_update[label] = mentry
+        for label in old.mpls_routes:
+            if label not in new.mpls_routes:
+                upd.mpls_to_delete.append(label)
+    else:
+        for label in sorted(label_scope):
+            mentry = new.mpls_routes.get(label)
+            if mentry is None:
+                if label in old.mpls_routes:
+                    upd.mpls_to_delete.append(label)
+                continue
+            prev_m = old.mpls_routes.get(label)
+            if prev_m is not mentry and prev_m != mentry:
+                upd.mpls_to_update[label] = mentry
     return upd

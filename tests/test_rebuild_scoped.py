@@ -1,0 +1,456 @@
+"""Dirty-scoped incremental rebuild tests (docs/Decision.md).
+
+The contract under test: prefix-only churn skips SPF entirely (counter
+`decision.rebuild.prefix_only` increments while the engine's solve
+counter stays flat), areas with no dirt reuse their cached RIB, and
+every fast path stays BYTE-EQUAL with a from-scratch `compute_rib` —
+proven here by a randomized mixed churn sequence on both engines.
+"""
+
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+
+from openr_tpu.common.constants import DEFAULT_AREA, adj_key, prefix_key
+from openr_tpu.config import Config, NodeConfig
+from openr_tpu.decision.decision import Decision, merge_area_ribs
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.kvstore import Publication, Value
+from openr_tpu.types.network import (
+    IpPrefix,
+    MplsAction,
+    MplsActionType,
+    NextHop,
+)
+from openr_tpu.types.routes import (
+    RibEntry,
+    RibMplsEntry,
+    RouteDatabase,
+    diff_route_dbs,
+)
+from openr_tpu.types.serde import to_wire
+from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+from openr_tpu.utils import topogen
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def mk_decision(backend="cpu", name="node-0"):
+    cfg = Config(NodeConfig(node_name=name))
+    pubs = ReplicateQueue(name="pubs")
+    routes = ReplicateQueue(name="routes")
+    d = Decision(
+        cfg, pubs.get_reader(), routes, solver=backend, counters=Counters()
+    )
+    return d
+
+
+def adj_pub(adj_dbs, area=DEFAULT_AREA, version=1):
+    return Publication(
+        area=area,
+        key_vals={
+            adj_key(db.this_node_name): Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(db),
+            ).with_hash()
+            for db in adj_dbs
+        },
+    )
+
+
+def prefix_pub(prefix_dbs, area=DEFAULT_AREA, version=1):
+    kv = {}
+    for db in prefix_dbs:
+        for e in db.prefix_entries:
+            key = prefix_key(db.this_node_name, area, str(e.prefix.prefix))
+            kv[key] = Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(
+                    PrefixDatabase(
+                        this_node_name=db.this_node_name,
+                        prefix_entries=(e,),
+                        area=area,
+                    )
+                ),
+            ).with_hash()
+    return Publication(area=area, key_vals=kv)
+
+
+def one_prefix_pub(node, pstr, area=DEFAULT_AREA, version=1):
+    return prefix_pub(
+        [
+            PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=(PrefixEntry(prefix=IpPrefix(prefix=pstr)),),
+                area=area,
+            )
+        ],
+        area=area,
+        version=version,
+    )
+
+
+def assert_parity(d, step=None):
+    """The incremental pipeline's published RIB must be byte-equal to a
+    from-scratch compute over the same LSDB."""
+    ref = d.compute_rib()
+    assert d.rib.unicast_routes == ref.unicast_routes, step
+    assert d.rib.mpls_routes == ref.mpls_routes, step
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_prefix_only_round_zero_solves(backend):
+    """A prefix advertise / withdraw round must not run ANY SPF solve:
+    `decision.rebuild.prefix_only` increments while the area-solve and
+    engine solve counters stay flat — and the RIB still updates and
+    stays byte-equal to from-scratch."""
+
+    async def body():
+        d = mk_decision(backend)
+        adj_dbs, prefix_dbs = topogen.grid(3, 3)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 1
+        assert_parity(d)
+
+        solves0 = d._area_solves
+        engine0 = d._tpu.solve_count if d._tpu is not None else None
+        new = IpPrefix(prefix="10.66.0.0/24")
+        d.process_publication(one_prefix_pub("node-3", "10.66.0.0/24"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.prefix_only") == 1
+        assert d._area_solves == solves0  # zero SPF solves
+        if engine0 is not None:
+            assert d._tpu.solve_count == engine0  # zero kernel launches
+        assert new in d.rib.unicast_routes
+        assert_parity(d)
+
+        # withdrawal is prefix-only too; the route disappears
+        solves1 = d._area_solves  # assert_parity ran full computes
+        d.process_publication(
+            Publication(
+                expired_keys=[
+                    prefix_key("node-3", DEFAULT_AREA, "10.66.0.0/24")
+                ]
+            )
+        )
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.prefix_only") == 2
+        assert d._area_solves == solves1
+        assert new not in d.rib.unicast_routes
+        assert_parity(d)
+
+        # adjacency churn is topology dirt: back to the full path
+        db0 = adj_dbs[0]
+        adjs = tuple(
+            dataclasses.replace(a, metric=17) for a in db0.adjacencies
+        )
+        d.process_publication(
+            adj_pub([dataclasses.replace(db0, adjacencies=adjs)], version=2)
+        )
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 2
+        assert_parity(d)
+
+    run(body())
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_randomized_churn_parity(backend):
+    """Parity contract: after EVERY rebuild of a randomized mixed churn
+    sequence (metric flaps, prefix advertise/withdraw, node expiry and
+    re-advertisement, overload toggles) the incremental RIB equals a
+    from-scratch compute_rib — on both engines."""
+
+    async def body():
+        d = mk_decision(backend)
+        adj_dbs, prefix_dbs = topogen.fat_tree(4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        assert_parity(d, "initial")
+
+        rng = np.random.default_rng(42)
+        names = [db.this_node_name for db in adj_dbs]
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        expired: set[str] = set()
+        for step in range(18):
+            op = int(rng.integers(0, 10))
+            name = names[int(rng.integers(1, len(names)))]  # never self
+            if op < 4:
+                # prefix advertise or withdraw — the scoped fast path
+                i = int(rng.integers(0, len(names)))
+                pstr = f"10.44.{i}.0/24"
+                key = prefix_key(names[i], DEFAULT_AREA, pstr)
+                if rng.integers(0, 2):
+                    pub = one_prefix_pub(
+                        names[i], pstr, version=step + 2
+                    )
+                else:
+                    pub = Publication(expired_keys=[key])
+            elif op < 7:
+                # metric flap (topology dirt via the CSR patch journal)
+                db = adj_cur[name]
+                adjs = list(db.adjacencies)
+                k = int(rng.integers(0, len(adjs)))
+                adjs[k] = dataclasses.replace(
+                    adjs[k], metric=int(rng.integers(1, 32))
+                )
+                db = dataclasses.replace(db, adjacencies=tuple(adjs))
+                adj_cur[name] = db
+                pub = adj_pub([db], version=step + 2)
+            elif op < 8:
+                # node overload toggle (structural topology dirt)
+                db = dataclasses.replace(
+                    adj_cur[name], is_overloaded=not adj_cur[name].is_overloaded
+                )
+                adj_cur[name] = db
+                pub = adj_pub([db], version=step + 2)
+            elif op < 9 and name not in expired:
+                # node withdrawal via adj-key expiry
+                expired.add(name)
+                pub = Publication(expired_keys=[adj_key(name)])
+            else:
+                # (re-)advertise the node's adjacency db
+                expired.discard(name)
+                pub = adj_pub([adj_cur[name]], version=step + 2)
+            d.process_publication(pub)
+            await d._rebuild_routes()
+            assert_parity(d, f"step {step}")
+        # the sequence must actually have exercised the fast path
+        assert d.counters.get("decision.rebuild.prefix_only") > 0
+
+    run(body())
+
+
+def test_multi_area_cached_reuse():
+    """Prefix dirt in one area must not touch the other: the clean
+    area's RIB is reused (decision.rebuild.cached_areas) with zero
+    solves, and the cross-area merge stays byte-equal."""
+
+    async def body():
+        d = mk_decision("cpu")
+        ring_a, pfx_a = topogen.ring(4)
+        ring_b, _ = topogen.ring(3, metric=7)
+        d.process_publication(adj_pub(ring_a, area="a"))
+        d.process_publication(prefix_pub(pfx_a, area="a"))
+        d.process_publication(adj_pub(ring_b, area="b"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 1
+        assert_parity(d, "initial")
+
+        solves0 = d._area_solves
+        d.process_publication(
+            one_prefix_pub("node-1", "10.88.0.0/24", area="b")
+        )
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.prefix_only") == 1
+        # area "a" AND the (empty) configured default area both reused
+        assert d.counters.get("decision.rebuild.cached_areas") == 2
+        assert d._area_solves == solves0
+        assert IpPrefix(prefix="10.88.0.0/24") in d.rib.unicast_routes
+        assert_parity(d, "after scoped")
+
+    run(body())
+
+
+def test_policy_forces_full_rebuild():
+    """An installed RibPolicy is a classification-doubt condition: every
+    rebuild goes from-scratch while it is present (the policy mutates
+    the merged RIB, so per-area caches are unsound)."""
+
+    class NoopPolicy:
+        def apply(self, rdb):
+            pass
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.ring(4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        d.rib_policy = NoopPolicy()
+        d.process_publication(one_prefix_pub("node-1", "10.66.1.0/24"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.prefix_only") == 0
+        assert d.counters.get("decision.rebuild.full") == 2
+        # policy removed: the cleared cache forces one more full round,
+        # then the scoped path resumes
+        d.rib_policy = None
+        d.process_publication(one_prefix_pub("node-1", "10.66.2.0/24"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 3
+        d.process_publication(one_prefix_pub("node-1", "10.66.3.0/24"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.prefix_only") == 1
+        assert_parity(d)
+
+    run(body())
+
+
+def test_out_of_band_mutation_falls_back_to_full():
+    """An LSDB mutation that bypassed the publication path (no dirt
+    recorded) must be caught by the revision check and produce a full
+    rebuild — never a stale cached reuse."""
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.ring(4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        # out-of-band: mutate the live LinkState directly
+        db0 = adj_dbs[0]
+        adjs = tuple(
+            dataclasses.replace(a, metric=23) for a in db0.adjacencies
+        )
+        d._link_states[DEFAULT_AREA].update_adjacency_db(
+            dataclasses.replace(db0, adjacencies=adjs)
+        )
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 2
+        assert d.counters.get("decision.rebuild.prefix_only") == 0
+        assert_parity(d)
+
+        # out-of-band PREFIX mutation racing tracked prefix dirt: the
+        # exact-bump revision guard must force full (a lone ps_rev
+        # equality check would miss this — the tracked pub also moves
+        # the revision)
+        d._prefix_states[DEFAULT_AREA].update_prefix_db(
+            PrefixDatabase(
+                this_node_name="node-2",
+                prefix_entries=(
+                    PrefixEntry(prefix=IpPrefix(prefix="10.70.0.0/24")),
+                ),
+            )
+        )
+        d.process_publication(one_prefix_pub("node-1", "10.71.0.0/24"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 3
+        assert d.counters.get("decision.rebuild.prefix_only") == 0
+        assert IpPrefix(prefix="10.70.0.0/24") in d.rib.unicast_routes
+        assert_parity(d)
+
+    run(body())
+
+
+def test_merge_area_ribs_mpls_equal_cost_union():
+    """Satellite: equal-IGP-cost multi-area MPLS routes union their
+    nexthops (previously the first sorted area's nexthops silently won
+    the tie); the lower-cost area still wins outright."""
+
+    def nh(nbr, ifn, area, metric=10):
+        return NextHop(
+            address=nbr,
+            if_name=ifn,
+            metric=metric,
+            neighbor_node=nbr,
+            area=area,
+            mpls_action=MplsAction(
+                action=MplsActionType.SWAP, swap_label=100
+            ),
+        )
+
+    def rdb_with(label, *nhs):
+        return RouteDatabase(
+            this_node_name="me",
+            mpls_routes={label: RibMplsEntry(label=label, nexthops=nhs)},
+        )
+
+    a = rdb_with(100, nh("n1", "i1", "a"))
+    b = rdb_with(100, nh("n2", "i2", "b"))
+    out = merge_area_ribs({"a": a, "b": b}, "me")
+    got = out.mpls_routes[100].nexthops
+    assert {x.neighbor_node for x in got} == {"n1", "n2"}  # tie: union
+    assert got == tuple(sorted(got))  # canonical order preserved
+
+    # unequal IGP cost: the cheaper area's nexthops win outright
+    c = rdb_with(100, nh("n3", "i3", "c", metric=5))
+    out2 = merge_area_ribs({"a": a, "c": c}, "me")
+    assert {x.neighbor_node for x in out2.mpls_routes[100].nexthops} == {
+        "n3"
+    }
+
+    # identical nexthop sets at a tie keep the original entry object
+    # (no spurious churn for the downstream identity diff)
+    a2 = rdb_with(100, nh("n1", "i1", "a"))
+    out3 = merge_area_ribs({"a": a, "x": a2}, "me")
+    assert out3.mpls_routes[100] is a.mpls_routes[100]
+
+
+def test_diff_route_dbs_prefix_scope():
+    """Satellite: the scoped diff equals the full diff restricted to the
+    scope, and reports nothing outside it."""
+    p1 = IpPrefix(prefix="10.0.1.0/24")
+    p2 = IpPrefix(prefix="10.0.2.0/24")
+    p3 = IpPrefix(prefix="10.0.3.0/24")
+
+    def e(p, igp):
+        return RibEntry(prefix=p, nexthops=(), igp_cost=igp)
+
+    m = RibMplsEntry(label=100, nexthops=())
+    old = RouteDatabase(
+        unicast_routes={p1: e(p1, 1), p2: e(p2, 1)},
+        mpls_routes={100: m, 101: RibMplsEntry(label=101, nexthops=())},
+    )
+    new = RouteDatabase(
+        unicast_routes={p1: e(p1, 2), p3: e(p3, 1)},
+        mpls_routes={100: m},
+    )
+    full = diff_route_dbs(old, new)
+    scoped = diff_route_dbs(
+        old, new, prefix_scope={p1, p2, p3}, label_scope=(100, 101)
+    )
+    assert scoped.unicast_to_update == full.unicast_to_update
+    assert sorted(scoped.unicast_to_delete) == sorted(full.unicast_to_delete)
+    assert scoped.mpls_to_update == full.mpls_to_update
+    assert sorted(scoped.mpls_to_delete) == sorted(full.mpls_to_delete)
+
+    # scope excludes p2's deletion and 101's deletion
+    narrow = diff_route_dbs(old, new, prefix_scope={p1}, label_scope=())
+    assert set(narrow.unicast_to_update) == {p1}
+    assert not narrow.unicast_to_delete
+    assert not narrow.mpls_to_update and not narrow.mpls_to_delete
+
+
+def test_rebuild_marker_stamped():
+    """The taken-path PerfEvents marker rides the convergence traces:
+    prefix-only rounds stamp REBUILD_PREFIX_ONLY, full rounds stamp
+    REBUILD_FULL."""
+    from openr_tpu.monitor import perf
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.ring(4)
+        pub = adj_pub(adj_dbs)
+        pub.perf_events = perf.PerfEvents.start(
+            perf.KVSTORE_FLOODED, node="t"
+        )
+        d.process_publication(pub)
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        reader = d.route_updates.get_reader("t")  # attach late: peek rib
+        full_trace = pub.perf_events
+        names = [e.event for e in full_trace.events]
+        assert perf.REBUILD_FULL in names
+        assert perf.REBUILD_PREFIX_ONLY not in names
+
+        pub2 = one_prefix_pub("node-1", "10.66.9.0/24")
+        pub2.perf_events = perf.PerfEvents.start(
+            perf.KVSTORE_FLOODED, node="t"
+        )
+        d.process_publication(pub2)
+        await d._rebuild_routes()
+        names2 = [e.event for e in pub2.perf_events.events]
+        assert perf.REBUILD_PREFIX_ONLY in names2
+        assert reader is not None
+
+    run(body())
