@@ -72,11 +72,72 @@ if missing:
 print(f"ok: {len(names)} decision.rebuild counters documented")
 PYEOF
 
+echo "== kvstore.flood_* / fib.program_* counter docs lint =="
+# every flood/programming failure-path counter emitted in code must be
+# documented in docs/Monitor.md (same contract as decision.rebuild.*)
+python - <<'PYEOF'
+import pathlib
+import re
+import sys
+
+doc = pathlib.Path("docs/Monitor.md").read_text()
+names: set[str] = set()
+for p in pathlib.Path("openr_tpu").rglob("*.py"):
+    names.update(
+        re.findall(
+            r"[\"'](kvstore\.flood[a-z_]*|fib\.program[a-z_]*)[\"']",
+            p.read_text(),
+        )
+    )
+if not names:
+    sys.exit("no kvstore.flood_*/fib.program_* counters found (lint broken?)")
+missing = sorted(n for n in names if n not in doc)
+if missing:
+    sys.exit(f"flood/program counters missing from docs/Monitor.md: {missing}")
+print(f"ok: {len(names)} flood/program counters documented")
+PYEOF
+
+echo "== chaos smoke (fixed seed, deterministic schedule) =="
+# small cluster, short seeded storm, full invariant check — the fast
+# always-on slice of the tests/test_chaos.py soak matrix
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import asyncio
+
+from openr_tpu.emulator import Cluster
+from openr_tpu.emulator.chaos import ChaosPlan, KvFaults, LinkFaults, run_schedule
+from openr_tpu.emulator.invariants import wait_quiescent
+
+
+async def main():
+    plan = ChaosPlan(
+        7,
+        link_faults=LinkFaults(drop=0.05, reorder=0.05, jitter_ms=20.0),
+        kv_faults=KvFaults(fail_flood=0.05),
+    )
+    c = Cluster.from_edges(
+        [("a", "b"), ("b", "c"), ("c", "d"), ("d", "a")], chaos=plan
+    )
+    await c.start()
+    await c.wait_converged(timeout=30.0)
+    c.make_storm(plan, duration_s=1.0, n_flaps=2, heal_after_s=0.4)
+    await run_schedule(c, plan)
+    await wait_quiescent(c, timeout_s=30.0, context=plan.replay_hint())
+    await c.stop()
+    print(
+        f"chaos smoke ok: {plan.replay_hint()}; "
+        f"stats={dict(sorted(plan.stats.items()))}"
+    )
+
+
+asyncio.run(main())
+PYEOF
+
 echo "== pytest tier-1 (not slow) =="
 # the fast lane the PR driver gates on — includes the observability
-# suite (tests/test_perf.py), the CLI/ctrl export tests, and the
+# suite (tests/test_perf.py), the CLI/ctrl export tests, the
 # dirty-scoped rebuild parity suite (tests/test_rebuild_scoped.py:
-# randomized churn byte-equality on both engines)
+# randomized churn byte-equality on both engines), and the chaos soak
+# matrix (tests/test_chaos.py: three fixed-seed storms x both solvers)
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors
 
 echo "== pytest slow lane =="

@@ -202,12 +202,23 @@ class Decision(OpenrModule):
         route_updates_queue: ReplicateQueue,
         solver: str | None = None,  # "tpu" | "cpu" | None (config default)
         counters=None,
+        initial_sync_event: "asyncio.Event | None" = None,
     ):
         super().__init__(f"{config.node_name}.decision", counters=counters)
         self.config = config
         self.node_name = config.node_name
         self.pub_reader = kvstore_pub_reader
         self.route_updates = route_updates_queue
+        # KVSTORE_SYNCED gate (reference: the initialization process
+        # orders KVSTORE_SYNCED before RIB_COMPUTED †): when provided
+        # (node.py passes KvStore.initial_sync_done), the FIRST rebuild
+        # is deferred until the store finished its initial full sync.
+        # Without this a restarted node computes its first RIB from a
+        # partial LSDB (typically just its own adj advertisement) and
+        # emits a shrunken FULL_SYNC that a warm-booted Fib faithfully
+        # programs — wiping every surviving route (chaos-soak finding).
+        self._initial_sync_event = initial_sync_event
+        self._sync_waiter: "asyncio.Task | None" = None
         self._link_states: dict[str, LinkState] = {
             a: LinkState(a) for a in config.area_ids()
         }
@@ -951,6 +962,20 @@ class Decision(OpenrModule):
         return new_rib, update
 
     async def _rebuild_routes(self) -> None:
+        if (
+            self._initial_sync_event is not None
+            and not self._initial_sync_event.is_set()
+            and not self.rib_computed.is_set()
+        ):
+            # hold the first RIB until KVSTORE_SYNCED; a waiter re-pokes
+            # the debounce the moment the gate opens so the deferred
+            # batch still rebuilds promptly
+            if self._sync_waiter is None or self._sync_waiter.done():
+                self._sync_waiter = self.spawn(
+                    self._poke_after_initial_sync(),
+                    name=f"{self.name}.syncgate",
+                )
+            return
         t0 = time.perf_counter()
         traces: list = []
         try:
@@ -1077,6 +1102,10 @@ class Decision(OpenrModule):
             self.route_updates.push(update)
         elif not update.empty():
             self.route_updates.push(update)
+
+    async def _poke_after_initial_sync(self) -> None:
+        await self._initial_sync_event.wait()
+        self.debounce.poke()
 
     # ------------------------------------------------------------ accessors
 

@@ -7,5 +7,22 @@ multi-node-without-a-cluster testing pattern, also used by the
 `python -m openr_tpu.emulator` CLI for interactive convergence demos.
 """
 
+from openr_tpu.emulator.chaos import (  # noqa: F401
+    ChaosEvent,
+    ChaosFibHandler,
+    ChaosIoHub,
+    ChaosKvTransport,
+    ChaosPlan,
+    FibFaults,
+    KvFaults,
+    LinkFaults,
+    run_schedule,
+)
 from openr_tpu.emulator.cluster import Cluster, ClusterNodeSpec, LinkSpec  # noqa: F401
 from openr_tpu.emulator.convergence import measure_convergence  # noqa: F401
+from openr_tpu.emulator.invariants import (  # noqa: F401
+    Violation,
+    assert_invariants,
+    check_cluster,
+    wait_quiescent,
+)

@@ -94,6 +94,16 @@ class Cluster:
     transport: InProcKvTransport = field(default_factory=InProcKvTransport)
     links: list[LinkSpec] = field(default_factory=list)
     solver: str = "cpu"  # integration tests default to the oracle backend
+    enable_ctrl: bool = False
+    # chaos wiring (emulator/chaos.py): when set, the hub is a
+    # ChaosIoHub, each node's kv transport is a per-node ChaosKvTransport
+    # and its fib handler a plan-gated ChaosFibHandler
+    chaos: object | None = None
+    # crashed-but-restartable nodes: name -> (Config, fib_handler) — the
+    # handler IS the emulated dataplane, surviving the control-plane
+    # crash so restart_node exercises Fib warm boot
+    crashed: dict[str, tuple] = field(default_factory=dict)
+    _partitioned: list[LinkSpec] = field(default_factory=list)
 
     @staticmethod
     def build(
@@ -102,8 +112,13 @@ class Cluster:
         solver: str = "cpu",
         debounce_ms: tuple[int, int] | None = None,
         enable_ctrl: bool = False,
+        chaos=None,
     ) -> "Cluster":
-        c = Cluster(solver=solver)
+        c = Cluster(solver=solver, enable_ctrl=enable_ctrl, chaos=chaos)
+        if chaos is not None:
+            from openr_tpu.emulator.chaos import ChaosIoHub
+
+            c.hub = ChaosIoHub(chaos)
         spark_cfg = scaled_spark(len(node_specs))
         if debounce_ms is None:
             # Decision debounce scales with CPU oversubscription for
@@ -158,7 +173,8 @@ class Cluster:
             node = OpenrNode(
                 cfg,
                 c.hub.io_for(spec.name),
-                c.transport,
+                c._transport_for(spec.name),
+                fib_handler=c._fib_handler_for(spec.name),
                 solver=solver,
                 enable_ctrl=enable_ctrl,
             )
@@ -173,6 +189,7 @@ class Cluster:
         edges: list[tuple[str, str]] | list[LinkSpec],
         solver: str = "cpu",
         enable_ctrl: bool = False,
+        chaos=None,
     ) -> "Cluster":
         links = [
             e if isinstance(e, LinkSpec) else LinkSpec(a=e[0], b=e[1])
@@ -183,7 +200,28 @@ class Cluster:
             ClusterNodeSpec(name=n, loopback=loopback_of(i))
             for i, n in enumerate(names)
         ]
-        return Cluster.build(specs, links, solver=solver, enable_ctrl=enable_ctrl)
+        return Cluster.build(
+            specs, links, solver=solver, enable_ctrl=enable_ctrl, chaos=chaos
+        )
+
+    def _transport_for(self, name: str):
+        """Per-node kv transport view: the chaos wrapper needs to know
+        which node OWNS the outgoing sessions (partition blocking is a
+        pair property); without chaos the shared registry is used as-is."""
+        if self.chaos is None:
+            return self.transport
+        from openr_tpu.emulator.chaos import ChaosKvTransport
+
+        return ChaosKvTransport(self.transport, self.chaos, name)
+
+    def _fib_handler_for(self, name: str):
+        """Plan-gated fault-injecting FibService per node, or None to
+        let OpenrNode build its default MockFibHandler."""
+        if self.chaos is None or self.chaos.fib_faults.fail_rate <= 0:
+            return None
+        from openr_tpu.emulator.chaos import ChaosFibHandler
+
+        return ChaosFibHandler(self.chaos, name)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -231,16 +269,166 @@ class Cluster:
 
     # -------------------------------------------------------------- control
 
+    def _links_between(self, a: str, b: str) -> list[LinkSpec]:
+        found = [ls for ls in self.links if {ls.a, ls.b} == {a, b}]
+        if not found:
+            raise ValueError(f"no link between {a!r} and {b!r}")
+        return found
+
     def fail_link(self, a: str, b: str) -> None:
-        for ls in self.links:
-            if {ls.a, ls.b} == {a, b}:
-                self.hub.set_link(ls.a, ls.a_if, up=False)
-                self.hub.set_link(ls.b, ls.b_if, up=False)
+        """Silent packet loss on the (a, b) link: the hub stops
+        delivering, and the adjacency dies by Spark hold-timer expiry —
+        neither endpoint is told. Raises ValueError when no such link
+        exists (a typo'd pair must not be a silent no-op)."""
+        for ls in self._links_between(a, b):
+            self.hub.set_link(ls.a, ls.a_if, up=False)
+            self.hub.set_link(ls.b, ls.b_if, up=False)
 
     def heal_link(self, a: str, b: str) -> None:
+        """Undo fail_link. Asymmetric with it by design: fail models
+        silent loss (hold-timer detection, no interface event), while
+        heal re-ups the hub AND re-injects interface-up events on both
+        endpoints so Spark restarts fast-init discovery immediately.
+        Raises ValueError when no such link exists."""
+        for ls in self._links_between(a, b):
+            self.hub.set_link(ls.a, ls.a_if, up=True)
+            self.hub.set_link(ls.b, ls.b_if, up=True)
+            if ls.a in self.nodes:
+                self.nodes[ls.a].set_interface(ls.a_if, up=True)
+            if ls.b in self.nodes:
+                self.nodes[ls.b].set_interface(ls.b_if, up=True)
+
+    # ------------------------------------------------------- chaos: crash/GR
+
+    async def crash_node(self, name: str, graceful: bool = False) -> None:
+        """Control-plane crash: stop every module, drop the node's
+        Spark inbox, and unregister its KvStore from the in-proc
+        transport so peers' floods/full_syncs to it now FAIL (exercising
+        their flood-failure → full-sync repair path). The MockFibHandler
+        — the emulated dataplane — survives in `self.crashed`, so a
+        later restart_node exercises Fib warm boot. With graceful=True
+        the node first announces a Spark graceful restart, so neighbors
+        hold the adjacency for gr_time instead of withdrawing at
+        hold-timer expiry."""
+        node = self.nodes.pop(name)  # KeyError: unknown or already crashed
+        if graceful:
+            # hub delivery is synchronous, so the GR hellos sit in peer
+            # inboxes when this returns; stop() follows with NO
+            # intervening yield — a hello tick sneaking in between
+            # would send restarting=False and cancel the GR hold on
+            # the receivers
+            await node.spark.announce_restart()
+        await node.stop()
+        self.transport.unregister(name)
+        self.hub.drop_node(name)
+        self.crashed[name] = (node.config, node.fib_handler)
+
+    async def restart_node(self, name: str) -> None:
+        """Rebuild a crashed node from its retained Config and start it:
+        KvStore re-syncs the LSDB from peers, Decision recomputes, and
+        Fib warm-boots off the surviving MockFibHandler — the first
+        program pass is an incremental delta against the adopted kernel
+        state, so surviving prefixes see zero route-withdrawal gap."""
+        cfg, handler = self.crashed.pop(name)
+        node = OpenrNode(
+            cfg,
+            self.hub.io_for(name),
+            self._transport_for(name),
+            fib_handler=handler,
+            solver=self.solver,
+            enable_ctrl=self.enable_ctrl,
+        )
+        self.transport.register(name, node.kvstore)
+        self.nodes[name] = node
+        await node.start()
         for ls in self.links:
-            if {ls.a, ls.b} == {a, b}:
-                self.hub.set_link(ls.a, ls.a_if, up=True)
-                self.hub.set_link(ls.b, ls.b_if, up=True)
-                self.nodes[a].set_interface(ls.a_if, up=True)
-                self.nodes[b].set_interface(ls.b_if, up=True)
+            if name not in (ls.a, ls.b):
+                continue
+            my_if = ls.a_if if ls.a == name else ls.b_if
+            if ls.metric != 1:
+                # mirror Cluster.start: a restarted node must rejoin
+                # with its configured link weights, not the default
+                node.linkmonitor.set_link_metric(my_if, ls.metric)
+            node.set_interface(my_if, up=True)
+
+    # ------------------------------------------------------ chaos: partition
+
+    def partition(self, groups) -> None:
+        """Split the cluster: every link whose endpoints belong to
+        DIFFERENT groups — including one grouped endpoint vs one
+        ungrouped — goes down at the packet layer; a link between two
+        ungrouped nodes is untouched. When the cluster is
+        chaos-wrapped, the KvStore transport additionally refuses the
+        same cross-group pairs immediately, so established kv sessions
+        break like real sockets would instead of lingering until Spark
+        hold expiry. Unknown names raise ValueError (same contract as
+        fail_link). Repeated partitions compose; `heal_partition`
+        heals them all."""
+        all_names = set(self.nodes) | set(self.crashed)
+        membership: dict[str, int] = {}
+        for gi, group in enumerate(groups):
+            for n in group:
+                if n not in all_names:
+                    # same contract as fail_link: a typo'd name must not
+                    # silently reshape the split
+                    raise ValueError(f"partition group names unknown node {n!r}")
+                membership[n] = gi
+        for ls in self.links:
+            ga, gb = membership.get(ls.a), membership.get(ls.b)
+            if ga == gb and ga is not None:
+                continue
+            if ga is None and gb is None:
+                continue  # both outside every group: untouched
+            self.hub.set_link(ls.a, ls.a_if, up=False)
+            self.hub.set_link(ls.b, ls.b_if, up=False)
+            self._partitioned.append(ls)
+        if self.chaos is not None:
+            names = sorted(all_names)
+            for i, a in enumerate(names):
+                for b in names[i + 1 :]:
+                    ga, gb = membership.get(a), membership.get(b)
+                    if ga == gb and ga is not None:
+                        continue
+                    if ga is None and gb is None:
+                        continue
+                    self.chaos.block_kv(a, b)
+
+    def heal_partition(self) -> None:
+        """Re-up every partition-downed link (and re-inject interface-up
+        on live endpoints, mirroring heal_link), and lift all KvStore
+        pair blocks."""
+        healed, self._partitioned = self._partitioned, []
+        for ls in healed:
+            self.hub.set_link(ls.a, ls.a_if, up=True)
+            self.hub.set_link(ls.b, ls.b_if, up=True)
+            if ls.a in self.nodes:
+                self.nodes[ls.a].set_interface(ls.a_if, up=True)
+            if ls.b in self.nodes:
+                self.nodes[ls.b].set_interface(ls.b_if, up=True)
+        if self.chaos is not None:
+            self.chaos.unblock_kv_all()
+
+    # ----------------------------------------------------- chaos: flap storm
+
+    def make_storm(
+        self,
+        plan,
+        *,
+        duration_s: float = 2.0,
+        n_flaps: int = 0,
+        n_crashes: int = 0,
+        n_partitions: int = 0,
+        heal_after_s: float = 0.6,
+    ):
+        """Flap-storm generator: build this cluster's deterministic
+        fault schedule on `plan` (a ChaosPlan) from its own link/node
+        sets. Run it with chaos.run_schedule(cluster, plan)."""
+        return plan.build_storm(
+            [(ls.a, ls.b) for ls in self.links],
+            sorted(set(self.nodes) | set(self.crashed)),
+            duration_s=duration_s,
+            n_flaps=n_flaps,
+            n_crashes=n_crashes,
+            n_partitions=n_partitions,
+            heal_after_s=heal_after_s,
+        )

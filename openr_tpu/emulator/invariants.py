@@ -1,0 +1,348 @@
+"""Post-quiescence cluster invariant checker for the emulator.
+
+Four invariant classes over a quiesced Cluster (storm over, rate faults
+off, structural faults healed):
+
+  1. **KvStore consistency** — every node's KvStoreDb in an area is
+     key/version/originator/hash-identical (the flood + full-sync repair
+     machinery converged to one winner everywhere).
+  2. **FIB/oracle parity** — every node's programmed FIB equals a fresh
+     from-scratch CPU-oracle solve over that node's *own* LinkState —
+     the check that catches stale dirty-scoped cache reuse (PR-2's
+     per-area RIB/SolveArtifact caches) after fault-driven invalidation.
+  3. **No stuck state** — no pending publication backlogs, flood queues
+     or desired-vs-programmed FIB deltas; no lingering (let alone
+     saturated) retry backoffs; all peers synced with live sessions.
+  4. **Counter sanity** — cross-counter identities hold (rebuild-path
+     counters sum to the rebuild count, peer add/remove deltas match the
+     live peer set, no residual failure streaks).
+
+`wait_quiescent` polls until all four hold (twice consecutively, so a
+mid-flight sample can't pass by luck) or raises with the chaos replay
+hint — a failing soak always prints the seed needed to reproduce it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from openr_tpu.decision.decision import merge_area_ribs
+from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
+
+_DETAIL_CAP = 3  # sample size for mismatch listings
+
+
+@dataclass(frozen=True)
+class Violation:
+    kind: str  # e.g. "kvstore.divergence", "fib.oracle_mismatch"
+    node: str | None
+    detail: str
+
+    def __str__(self) -> str:
+        where = f"[{self.node}] " if self.node else ""
+        return f"{self.kind}: {where}{self.detail}"
+
+
+# ------------------------------------------------------- 1. kvstore identical
+
+
+def check_kvstore_consistency(cluster) -> list[Violation]:
+    """All live nodes in an area hold the identical key/version/hash set
+    (TTL countdowns are per-store clocks and excluded by design)."""
+    out: list[Violation] = []
+    areas: set[str] = set()
+    for node in cluster.nodes.values():
+        areas.update(node.kvstore.dbs)
+    for area in sorted(areas):
+        digests: dict[str, dict] = {}
+        for name, node in cluster.nodes.items():
+            db = node.kvstore.dbs.get(area)
+            if db is None:
+                continue
+            digests[name] = {
+                k: (v.version, v.originator_id, v.with_hash().hash)
+                for k, v in db.kv.items()
+            }
+        if not digests:
+            continue
+        ref_name = min(digests)
+        ref = digests[ref_name]
+        for name, d in digests.items():
+            if d == ref:
+                continue
+            diff_keys = sorted(
+                k
+                for k in set(d) | set(ref)
+                if d.get(k) != ref.get(k)
+            )
+            out.append(
+                Violation(
+                    "kvstore.divergence",
+                    name,
+                    f"area {area}: {len(diff_keys)} keys differ from "
+                    f"{ref_name}'s store, e.g. {diff_keys[:_DETAIL_CAP]}",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------ 2. fib == oracle rib
+
+
+def check_fib_oracle_parity(cluster) -> list[Violation]:
+    """Each node's programmed FIB must be byte-equal to a from-scratch
+    CPU-oracle rebuild over that node's own LSDB — independent of the
+    node's own solver backend (tpu or cpu) and of every incremental /
+    dirty-scoped cache the live pipeline used. Nodes with an installed
+    RibPolicy are skipped (the policy mutates routes after the solve)."""
+    out: list[Violation] = []
+    for name, node in cluster.nodes.items():
+        dec = node.decision
+        if dec.rib_policy is not None:
+            continue
+        dcfg = node.config.node.decision
+        link_states = dec.link_states  # property: drains pending pubs
+        prefix_states = dec.prefix_states
+        per_area = {
+            a: oracle_compute_routes(
+                link_states[a].snapshot(),
+                prefix_states[a].snapshot(),
+                name,
+                enable_lfa=dcfg.enable_lfa,
+                ksp_k=dcfg.ksp_paths,
+            )
+            for a in link_states
+        }
+        want = merge_area_ribs(per_area, name)
+        want_u = {
+            p: e.to_unicast_route() for p, e in want.unicast_routes.items()
+        }
+        want_m = {
+            l: e.to_mpls_route() for l, e in want.mpls_routes.items()
+        }
+        got_u = node.fib.programmed_unicast
+        got_m = node.fib.programmed_mpls
+        if got_u != want_u:
+            diff = sorted(
+                str(p)
+                for p in set(got_u) | set(want_u)
+                if got_u.get(p) != want_u.get(p)
+            )
+            out.append(
+                Violation(
+                    "fib.oracle_mismatch",
+                    name,
+                    f"{len(diff)} unicast routes differ from the "
+                    f"CPU-oracle rebuild, e.g. {diff[:_DETAIL_CAP]}",
+                )
+            )
+        if got_m != want_m:
+            diff_l = sorted(
+                l
+                for l in set(got_m) | set(want_m)
+                if got_m.get(l) != want_m.get(l)
+            )
+            out.append(
+                Violation(
+                    "fib.oracle_mismatch_mpls",
+                    name,
+                    f"{len(diff_l)} mpls routes differ from the "
+                    f"CPU-oracle rebuild, e.g. {diff_l[:_DETAIL_CAP]}",
+                )
+            )
+    return out
+
+
+# ----------------------------------------------------------- 3. nothing stuck
+
+
+def check_no_stuck_state(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    for name, node in cluster.nodes.items():
+        if not node.initialized:
+            out.append(
+                Violation("node.uninitialized", name, "init gates not passed")
+            )
+        dec = node.decision
+        if dec._pending_kvs or dec.debounce.pending:
+            out.append(
+                Violation(
+                    "decision.pending",
+                    name,
+                    f"{len(dec._pending_kvs)} buffered kvs, "
+                    f"debounce pending={dec.debounce.pending}",
+                )
+            )
+        pc = node.fib.pending_changes()
+        if not pc["converged"]:
+            out.append(
+                Violation(
+                    "fib.unconverged",
+                    name,
+                    f"{pc['pending']} desired-vs-programmed deltas, "
+                    f"e.g. {pc['stale'][:_DETAIL_CAP]}",
+                )
+            )
+        fib_cfg = node.config.node.fib
+        if node.fib.backoff.current_ms >= fib_cfg.max_retry_ms:
+            out.append(
+                Violation(
+                    "fib.backoff_saturated",
+                    name,
+                    f"program backoff pinned at {fib_cfg.max_retry_ms} ms",
+                )
+            )
+        elif node.fib.backoff.has_error:
+            out.append(
+                Violation(
+                    "fib.backoff_pending",
+                    name,
+                    f"retry backoff at {node.fib.backoff.current_ms} ms",
+                )
+            )
+        for (area, pname), peer in node.kvstore.peers.items():
+            if not peer.synced:
+                out.append(
+                    Violation(
+                        "kvstore.peer_unsynced",
+                        name,
+                        f"peer {pname} (area {area}) not synced",
+                    )
+                )
+            if peer.session is None:
+                out.append(
+                    Violation(
+                        "kvstore.peer_sessionless",
+                        name,
+                        f"peer {pname} (area {area}) has no session",
+                    )
+                )
+            if peer.pending_keys or peer.pending_expired:
+                out.append(
+                    Violation(
+                        "kvstore.peer_flood_backlog",
+                        name,
+                        f"peer {pname}: {len(peer.pending_keys)} keys / "
+                        f"{len(peer.pending_expired)} expiries queued",
+                    )
+                )
+            if peer.backoff.has_error:
+                out.append(
+                    Violation(
+                        "kvstore.peer_backoff",
+                        name,
+                        f"peer {pname} sync backoff at "
+                        f"{peer.backoff.current_ms} ms",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------- 4. counter sanity
+
+
+def check_counter_sanity(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    for name, node in cluster.nodes.items():
+        c = node.counters
+        full = c.get("decision.rebuild.full")
+        pfx = c.get("decision.rebuild.prefix_only")
+        runs = c.get("decision.spf_runs")
+        if full + pfx != runs:
+            out.append(
+                Violation(
+                    "counters.rebuild_sum",
+                    name,
+                    f"rebuild.full({full}) + rebuild.prefix_only({pfx}) "
+                    f"!= spf_runs({runs})",
+                )
+            )
+        live_peers = len(node.kvstore.peers)
+        added = c.get("kvstore.peers_added")
+        removed = c.get("kvstore.peers_removed")
+        if added - removed != live_peers:
+            out.append(
+                Violation(
+                    "counters.peer_ledger",
+                    name,
+                    f"peers_added({added}) - peers_removed({removed}) "
+                    f"!= live peers({live_peers})",
+                )
+            )
+        streak = c.get("fib.program_fail_streak")
+        if streak:
+            out.append(
+                Violation(
+                    "counters.fib_fail_streak",
+                    name,
+                    f"fib.program_fail_streak={streak} after quiescence",
+                )
+            )
+    return out
+
+
+# -------------------------------------------------------------- entry points
+
+
+def check_cluster(cluster) -> list[Violation]:
+    """All four invariant classes; cheap checks first so the poll loop
+    fails fast while the cluster is still settling."""
+    out = check_no_stuck_state(cluster)
+    out += check_kvstore_consistency(cluster)
+    out += check_counter_sanity(cluster)
+    out += check_fib_oracle_parity(cluster)
+    return out
+
+
+def assert_invariants(cluster, context: str = "") -> None:
+    """Single-shot assertion; `context` (e.g. the ChaosPlan replay hint)
+    is embedded in the failure message so any failing run is replayable
+    from its seed."""
+    violations = check_cluster(cluster)
+    if violations:
+        hint = f" (replay: {context})" if context else ""
+        lines = "\n  ".join(str(v) for v in violations)
+        raise AssertionError(
+            f"{len(violations)} cluster invariant violation(s){hint}:\n"
+            f"  {lines}"
+        )
+
+
+async def wait_quiescent(
+    cluster,
+    timeout_s: float = 30.0,
+    poll_s: float = 0.25,
+    context: str = "",
+) -> None:
+    """Poll until the cluster converges AND all invariants hold on two
+    consecutive checks; on timeout raise with the last violations and
+    the replay context. This is the post-storm gate every chaos soak
+    ends with."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    clean = 0
+    last: list[Violation] = []
+    while True:
+        if not cluster.converged():
+            last = [
+                Violation(
+                    "cluster.unconverged",
+                    None,
+                    "cluster.converged() is False",
+                )
+            ]
+            clean = 0
+        else:
+            last = check_cluster(cluster)
+            clean = 0 if last else clean + 1
+            if clean >= 2:
+                return
+        if loop.time() >= deadline:
+            hint = f" (replay: {context})" if context else ""
+            lines = "\n  ".join(str(v) for v in last[:8])
+            raise AssertionError(
+                f"cluster failed to quiesce within {timeout_s:.0f}s"
+                f"{hint}; last violations:\n  {lines}"
+            )
+        await asyncio.sleep(poll_s)

@@ -80,14 +80,20 @@ class MockFibHandler:
 
     reference: MockNetlinkFibHandler in openr/tests/mocks/ † — records
     programmed routes, lets tests fail the next N operations to exercise
-    Fib's retry/backoff/sync path, and exposes wait helpers.
-    """
+    Fib's retry/backoff/sync path, and exposes wait helpers. Beyond the
+    count-based `fail_next_n`, `fail_rate` fails each operation with a
+    given probability from an injectable RNG — the emulator's chaos
+    layer (emulator/chaos.py) drives it from a seeded ChaosPlan so a
+    failing soak is replayable."""
 
-    def __init__(self):
+    def __init__(self, fail_rate: float = 0.0, rng=None):
         self.unicast: dict[int, dict[IpPrefix, UnicastRoute]] = {}
         self.mpls: dict[int, dict[int, MplsRoute]] = {}
         self.fail_next_n = 0
+        self.fail_rate = fail_rate
+        self.rng = rng
         self.op_count = 0
+        self.fail_count = 0
         self.sync_count = 0
         self._changed = asyncio.Event()
 
@@ -95,7 +101,12 @@ class MockFibHandler:
         self.op_count += 1
         if self.fail_next_n > 0:
             self.fail_next_n -= 1
+            self.fail_count += 1
             raise FibProgramError("injected failure")
+        if self.fail_rate > 0 and self.rng is not None:
+            if self.rng.random() < self.fail_rate:
+                self.fail_count += 1
+                raise FibProgramError("injected failure (rate)")
 
     def _notify(self):
         self._changed.set()
@@ -205,6 +216,8 @@ class Fib(OpenrModule):
         self.backoff = ExponentialBackoff(
             config.node.fib.initial_retry_ms, config.node.fib.max_retry_ms
         )
+        self._fail_streak = 0  # consecutive failed program passes
+        self._warned_backoff_saturated = False
 
     async def main(self) -> None:
         if self.config.node.fib.enable_warm_boot and not self.dry_run:
@@ -299,6 +312,11 @@ class Fib(OpenrModule):
                 n_covered = len(self._pending_perf)
                 await self._program_once()
                 self.backoff.report_success()
+                if self._fail_streak:
+                    self._fail_streak = 0
+                    self._warned_backoff_saturated = False
+                    if self.counters:
+                        self.counters.set("fib.program_fail_streak", 0)
                 if self._have_rib and not self.synced.is_set():
                     self.synced.set()
                 if self.counters:
@@ -316,8 +334,26 @@ class Fib(OpenrModule):
                 self._dirty.set()
                 self.backoff.report_error()
                 delay = self.backoff.current_ms / 1e3
+                self._fail_streak += 1
                 if self.counters:
                     self.counters.increment("fib.program_fail")
+                    self.counters.set(
+                        "fib.program_fail_streak", self._fail_streak
+                    )
+                if (
+                    self.backoff.current_ms >= self.config.node.fib.max_retry_ms
+                    and not self._warned_backoff_saturated
+                ):
+                    # once per saturation episode: a pinned backoff means
+                    # the FibService is persistently failing, not just
+                    # riding out transient retry noise
+                    self._warned_backoff_saturated = True
+                    log.warning(
+                        "%s: programming backoff saturated at %.0f ms "
+                        "after %d consecutive failures — FibService looks "
+                        "permanently down",
+                        self.name, self.backoff.current_ms, self._fail_streak,
+                    )
                 log.warning(
                     "%s: programming failed (%s); retry in %.3fs",
                     self.name, exc, delay,

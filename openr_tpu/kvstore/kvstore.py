@@ -256,7 +256,10 @@ class KvStore(OpenrModule):
             except Exception as e:  # noqa: BLE001
                 log.debug("%s: sync with %s failed: %s", self.name, peer.spec.node_name, e)
                 peer.backoff.report_error()
-                peer.session = None
+                if peer.session is not None:
+                    peer.session = None
+                    if self.counters is not None:
+                        self.counters.increment("kvstore.peer_disconnects")
                 if self.counters is not None:
                     self.counters.increment("kvstore.full_sync_failures")
 
@@ -439,7 +442,18 @@ class KvStore(OpenrModule):
             except Exception:  # noqa: BLE001
                 peer.flood_failures += 1
                 peer.synced = False
-                peer.session = None
+                if self.counters is not None:
+                    # per-peer flood_failures was previously invisible in
+                    # the counter export — chaos soaks watch this pair
+                    self.counters.increment("kvstore.flood_failures")
+                # drop the session only if it is still the one that
+                # failed: a concurrent sync may have already torn it
+                # down (counted there) or re-established a fresh one
+                # that must not be clobbered
+                if peer.session is session:
+                    peer.session = None
+                    if self.counters is not None:
+                        self.counters.increment("kvstore.peer_disconnects")
                 ft = self.flood_topos.get(peer.spec.area)
                 if ft is not None:
                     ft.peer_down(peer.spec.node_name)

@@ -97,6 +97,10 @@ class OpenrNode:
             self.route_updates,
             solver=solver,
             counters=self.counters,
+            # initialization ordering (reference: KVSTORE_SYNCED before
+            # RIB_COMPUTED †): the first rebuild must see a fully synced
+            # store, or a warm-booted Fib programs a partial RIB
+            initial_sync_event=self.kvstore.initial_sync_done,
         )
         self.fib_handler = fib_handler if fib_handler is not None else MockFibHandler()
         self.fib = Fib(
@@ -246,11 +250,17 @@ class OpenrNode:
 
     async def wait_initialized(self, timeout: float = 30.0) -> None:
         """Block until the three init gates pass (reference: initialization
-        events KVSTORE_SYNCED → RIB_COMPUTED → FIB_SYNCED †)."""
-        async with asyncio.timeout(timeout):
-            await self.kvstore.initial_sync_done.wait()
-            await self.decision.rib_computed.wait()
-            await self.fib.synced.wait()
+        events KVSTORE_SYNCED → RIB_COMPUTED → FIB_SYNCED †).
+        asyncio.wait_for per gate with one shared deadline: asyncio.timeout
+        needs Python ≥3.11 and this repo still runs on 3.10."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        for gate in (
+            self.kvstore.initial_sync_done,
+            self.decision.rib_computed,
+            self.fib.synced,
+        ):
+            remaining = deadline - asyncio.get_event_loop().time()
+            await asyncio.wait_for(gate.wait(), max(remaining, 0.001))
 
     @property
     def initialized(self) -> bool:

@@ -69,6 +69,13 @@ class MockIoHub:
             if (a_node, a_if) in (lk.a, lk.b):
                 lk.up = up
 
+    def drop_node(self, node: str) -> None:
+        """Forget a node's inbox (emulated crash): in-flight and future
+        packets to it are discarded until `io_for` recreates the inbox,
+        so a restarted node never replays its dead incarnation's
+        backlog."""
+        self._inboxes.pop(node, None)
+
     def _deliver(self, src_node: str, src_if: str, payload: bytes) -> None:
         for lk in self._links:
             if not lk.up:
@@ -82,12 +89,25 @@ class MockIoHub:
             inbox = self._inboxes.get(dst_node)
             if inbox is None:
                 continue
-            if lk.latency_ms > 0:
-                asyncio.get_event_loop().call_later(
-                    lk.latency_ms / 1e3, inbox.put_nowait, (dst_if, payload)
-                )
-            else:
-                inbox.put_nowait((dst_if, payload))
+            self._enqueue(lk, dst_node, dst_if, payload, inbox)
+
+    def _enqueue(
+        self,
+        lk: _MockLink,
+        dst_node: str,
+        dst_if: str,
+        payload: bytes,
+        inbox: asyncio.Queue,
+    ) -> None:
+        """Final delivery of one packet onto the destination inbox — the
+        per-delivery seam ChaosIoHub overrides to drop/delay/duplicate
+        (emulator/chaos.py)."""
+        if lk.latency_ms > 0:
+            asyncio.get_event_loop().call_later(
+                lk.latency_ms / 1e3, inbox.put_nowait, (dst_if, payload)
+            )
+        else:
+            inbox.put_nowait((dst_if, payload))
 
 
 class MockIo:

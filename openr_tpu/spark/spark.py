@@ -230,6 +230,34 @@ class Spark(OpenrModule):
             if self.counters is not None:
                 self.counters.increment("spark.hello_sent")
 
+    async def announce_restart(self) -> None:
+        """Tell every neighbor we are about to gracefully restart
+        (reference: Spark GR † — the departing instance floods a hello
+        with restarting=true so peers hold the adjacency for gr_time
+        instead of withdrawing on hold-timer expiry). Called by the
+        emulator's Cluster.crash_node(graceful=True) before stop.
+
+        These are the instance's last words: the interface set is
+        cleared afterwards so a hello tick racing the (yielding) module
+        teardown can't send a restarting=False hello that would cancel
+        the GR hold on the receivers."""
+        self.seq += 1
+        now_us = int(time.monotonic() * 1e6)
+        interfaces, self.interfaces = list(self.interfaces), set()
+        for if_name in interfaces:
+            pkt = SparkPacket(
+                hello=HelloMsg(
+                    node_name=self.node_name,
+                    if_name=if_name,
+                    seq=self.seq,
+                    sent_ts_us=now_us,
+                    restarting=True,
+                )
+            )
+            await self.io.send(if_name, to_wire(pkt))
+            if self.counters is not None:
+                self.counters.increment("spark.restart_announced")
+
     async def _heartbeat_tick(self) -> None:
         cfg = self.config.node.spark
         sent_ifs = set()
