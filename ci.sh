@@ -97,6 +97,51 @@ if missing:
 print(f"ok: {len(names)} flood/program counters documented")
 PYEOF
 
+echo "== queue.* / ctrl.sub_* / watchdog.* counter docs lint =="
+# the overload-control counter surface must be documented in
+# docs/Monitor.md (same contract as the flood/program counters):
+# queue gauge FIELDS come from the messaging layer's emit sites, the
+# rest are literal counter names
+python - <<'PYEOF'
+import pathlib
+import re
+import sys
+
+doc = pathlib.Path("docs/Monitor.md").read_text()
+msg_src = pathlib.Path("openr_tpu/messaging/__init__.py").read_text()
+fields = set(re.findall(r"queue\.\{self\.ckey\}\.([a-z_]+)", msg_src))
+# policy counters route through _count(what, ...): collect the whats
+fields |= set(re.findall(r"self\._count\(\s*\"([a-z_]+)\"", msg_src))
+if not fields:
+    sys.exit("no queue.* gauge fields found in messaging (lint broken?)")
+missing = sorted(f for f in fields if f"queue.<name>.{f}" not in doc)
+if missing:
+    sys.exit(f"queue gauge fields missing from docs/Monitor.md: {missing}")
+names: set[str] = set()
+for p in pathlib.Path("openr_tpu").rglob("*.py"):
+    # counters only (validate() check names share the watchdog.* shape)
+    names.update(
+        re.findall(
+            r"increment\(\s*[\"'](ctrl\.sub_[a-z_]+|watchdog\.[a-z_]+|"
+            r"spark\.inbox_[a-z_]+)[\"']",
+            p.read_text(),
+        )
+    )
+if not names:
+    sys.exit("no ctrl.sub_*/watchdog.*/spark.inbox_* counters found")
+missing = sorted(n for n in names if n not in doc)
+if missing:
+    sys.exit(f"overload counters missing from docs/Monitor.md: {missing}")
+print(f"ok: {len(fields)} queue fields + {len(names)} counters documented")
+PYEOF
+
+echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
+# the tier-1-safe slice of the long-horizon soak: storms + background
+# prefix churn + all five invariant classes + memory watermark, with
+# the seed+round replay hint on any failure (docs/Emulator.md)
+JAX_PLATFORMS=cpu python -m openr_tpu.emulator --soak \
+    --topo grid --nodes 9 --seed 7 --rounds 2
+
 echo "== chaos smoke (fixed seed, deterministic schedule) =="
 # small cluster, short seeded storm, full invariant check — the fast
 # always-on slice of the tests/test_chaos.py soak matrix

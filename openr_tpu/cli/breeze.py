@@ -919,6 +919,29 @@ def monitor_counters(ctx, prefix):
         click.echo(f"{k}: {v:g}")
 
 
+@monitor.command("queues")
+@click.pass_context
+def monitor_queues(ctx):
+    """Per-seam queue gauges: live depth, high watermark, and overflow
+    policy activity (coalesced / shed / overflow / blocked) for every
+    inter-module queue — the overload-control dashboard."""
+    res = _run(ctx, "get_counters", {"prefix": "queue."})
+    queues: dict[str, dict[str, float]] = {}
+    for k, v in res.items():
+        # queue.<name>.<field>
+        _, name, fld = k.split(".", 2)
+        queues.setdefault(name, {})[fld] = v
+    fields = ["depth", "highwater", "coalesced", "shed", "overflow", "blocked"]
+    rows = [
+        [name, *(f"{int(vals.get(f, 0))}" for f in fields)]
+        for name, vals in sorted(queues.items())
+    ]
+    if not rows:
+        click.echo("no queue gauges yet")
+        return
+    click.echo(_table(rows, ["queue", *fields]))
+
+
 @monitor.command("prometheus")
 @click.pass_context
 def monitor_prometheus(ctx):

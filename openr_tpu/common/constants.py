@@ -33,6 +33,12 @@ KVSTORE_SYNC_INTERVAL_S = 60  # anti-entropy full-sync cadence
 KVSTORE_FLOOD_RATE_MSGS_PER_SEC = 600
 KVSTORE_FLOOD_RATE_BURST = 300
 KVSTORE_FLOOD_PENDING_MAX_KEYS = 8192
+# per-reader depth cap on the policied inter-module queues (messaging
+# overload control; 0 = unbounded)
+QUEUE_MAXSIZE = 1024
+# Spark per-node inbox cap in the mock/UDP IO providers (a partitioned
+# or stalled receiver sheds oldest packets instead of growing RAM)
+SPARK_INBOX_MAXSIZE = 2048
 TTL_REFRESH_FRACTION = 0.25  # originator refreshes at ttl * fraction left
 
 # ---- Decision debounce (reference: DecisionConfig † debounce_min/max_ms) ---

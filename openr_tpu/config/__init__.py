@@ -14,6 +14,7 @@ from openr_tpu.config.config import (  # noqa: F401
     FibConfig,
     KvstoreConfig,
     LinkMonitorConfig,
+    MessagingConfig,
     NodeConfig,
     OriginatedPrefix,
     PrefixAllocationConfig,

@@ -59,6 +59,25 @@ class KvstoreConfig:
 
 
 @dataclass
+class MessagingConfig:
+    """Bounds + overflow policies for the inter-module queues
+    (openr_tpu/messaging). The reference's ReplicateQueues are unbounded;
+    under sustained churn that is an OOM waiting to happen, so every
+    policied seam here gets a depth cap (DeltaPath, PAPERS.md: churn
+    throughput is governed by batching/coalescing at the seams)."""
+
+    # per-reader depth cap for the policied queues (kvstore_pubs,
+    # route_updates, fib_updates coalesce; log_samples, perf_events
+    # shed-oldest). 0 = unbounded.
+    queue_maxsize: int = C.QUEUE_MAXSIZE
+    # False keeps the caps configured (the soak's bounded-depth invariant
+    # still reads queue_maxsize) but builds the queues UNBOUNDED — the
+    # deliberately-broken control case that proves the watermark check
+    # catches unbounded growth.
+    enforce_bounds: bool = True
+
+
+@dataclass
 class LinkMonitorConfig:
     """reference: OpenrConfig.thrift † LinkMonitorConfig."""
 
@@ -252,6 +271,7 @@ class NodeConfig:
     areas: tuple[AreaConfig, ...] = (AreaConfig(),)
     spark: SparkConfig = field(default_factory=SparkConfig)
     kvstore: KvstoreConfig = field(default_factory=KvstoreConfig)
+    messaging: MessagingConfig = field(default_factory=MessagingConfig)
     link_monitor: LinkMonitorConfig = field(default_factory=LinkMonitorConfig)
     decision: DecisionConfig = field(default_factory=DecisionConfig)
     fib: FibConfig = field(default_factory=FibConfig)
@@ -364,6 +384,8 @@ class Config:
         k = n.kvstore
         if k.key_ttl_ms <= 0:
             raise ConfigError("kvstore: key_ttl_ms must be positive")
+        if n.messaging.queue_maxsize < 0:
+            raise ConfigError("messaging: queue_maxsize must be >= 0")
         f = n.fib
         if not (0 < f.initial_retry_ms <= f.max_retry_ms):
             raise ConfigError("fib: retry bounds invalid")

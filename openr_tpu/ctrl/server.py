@@ -72,7 +72,14 @@ class CtrlServer(OpenrModule):
                 item = await reader.get()
             except QueueClosedError:
                 for q in subs:
-                    q.put_nowait(None)
+                    try:
+                        q.put_nowait(None)
+                    except asyncio.QueueFull:
+                        # a retained-but-stalled subscriber may sit at
+                        # exactly maxsize: shed one item so the
+                        # end-of-stream sentinel always lands
+                        q.get_nowait()
+                        q.put_nowait(None)
                 return
             if not subs:  # nobody listening — skip the encode work
                 continue
@@ -83,15 +90,19 @@ class CtrlServer(OpenrModule):
                 try:
                     q.put_nowait(payload)
                 except asyncio.QueueFull:
-                    # slow/stalled subscriber: evict rather than grow
-                    # without bound (reference: OpenrCtrlHandler drops
-                    # publishers whose stream backs up †)
-                    subs.discard(q)
-                    while not q.empty():
+                    # slow/stalled subscriber: evict its OLDEST buffered
+                    # item so the fan-out never blocks and the buffer
+                    # never grows past SUB_QUEUE_MAX — the subscriber
+                    # keeps its stream, just loses the stalest update
+                    # (reference: OpenrCtrlHandler sheds on backed-up
+                    # publisher streams †)
+                    try:
                         q.get_nowait()
-                    q.put_nowait(None)  # ends that subscriber's stream
+                        q.put_nowait(payload)
+                    except (asyncio.QueueEmpty, asyncio.QueueFull):
+                        pass  # racing disconnect drain: drop this item
                     if self.counters:
-                        self.counters.increment(f"{self.name}.subs_evicted")
+                        self.counters.increment("ctrl.sub_evictions")
 
     @staticmethod
     def _encode_pub(pub) -> dict | None:

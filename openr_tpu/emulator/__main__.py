@@ -63,7 +63,41 @@ async def main() -> None:
         "--hold", type=float, default=0.0,
         help="keep the cluster running this many seconds after convergence",
     )
+    ap.add_argument(
+        "--soak", action="store_true",
+        help="run the seeded multi-round soak (storms + background "
+        "prefix churn + per-round invariant and memory-watermark gates) "
+        "instead of the one-shot convergence run",
+    )
+    ap.add_argument("--seed", type=int, default=7, help="soak chaos seed")
+    ap.add_argument("--rounds", type=int, default=3, help="soak rounds")
+    ap.add_argument("--flaps", type=int, default=3)
+    ap.add_argument("--crashes", type=int, default=1)
+    ap.add_argument("--partitions", type=int, default=0)
+    ap.add_argument(
+        "--unbounded-control", action="store_true",
+        help="soak control case: disable the messaging queue bounds "
+        "(caps stay configured) to demonstrate the watermark check fails",
+    )
     args = ap.parse_args()
+
+    if args.soak:
+        from openr_tpu.emulator.soak import SoakConfig, run_soak
+
+        report = await run_soak(
+            SoakConfig(
+                seed=args.seed,
+                rounds=args.rounds,
+                edges=topo_edges(args.topo, args.nodes),
+                solver=args.solver,
+                n_flaps=args.flaps,
+                n_crashes=args.crashes,
+                n_partitions=args.partitions,
+                enforce_queue_bounds=not args.unbounded_control,
+            )
+        )
+        print(report.summary())
+        return
 
     from openr_tpu.emulator import Cluster
 

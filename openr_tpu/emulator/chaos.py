@@ -282,11 +282,10 @@ class ChaosIoHub(MockIoHub):
         super()._enqueue(lk, dst_node, dst_if, payload, inbox)
 
     def _late_deliver(self, dst_node: str, dst_if: str, payload: bytes) -> None:
-        # re-resolve the inbox at fire time: the destination may have
-        # crashed (inbox dropped) while the packet was held back
-        inbox = self._inboxes.get(dst_node)
-        if inbox is not None:
-            inbox.put_nowait((dst_if, payload))
+        # _inbox_put re-resolves the inbox at fire time (the destination
+        # may have crashed while the packet was held back) and enforces
+        # the inbox bound
+        self._inbox_put(dst_node, dst_if, payload)
 
 
 # ---------------------------------------------------------- KvStore sessions

@@ -113,6 +113,7 @@ class Cluster:
         debounce_ms: tuple[int, int] | None = None,
         enable_ctrl: bool = False,
         chaos=None,
+        node_config_transform=None,
     ) -> "Cluster":
         c = Cluster(solver=solver, enable_ctrl=enable_ctrl, chaos=chaos)
         if chaos is not None:
@@ -169,6 +170,11 @@ class Cluster:
                     debounce_max_ms=debounce_ms[1],
                 ),
             )
+            if node_config_transform is not None:
+                # last word on every node's config (e.g. the soak's
+                # unbounded-control case flips messaging.enforce_bounds)
+                # — keeps callers out of the per-node wiring below
+                ncfg = node_config_transform(ncfg)
             cfg = Config(ncfg)
             node = OpenrNode(
                 cfg,
@@ -190,6 +196,7 @@ class Cluster:
         solver: str = "cpu",
         enable_ctrl: bool = False,
         chaos=None,
+        node_config_transform=None,
     ) -> "Cluster":
         links = [
             e if isinstance(e, LinkSpec) else LinkSpec(a=e[0], b=e[1])
@@ -201,7 +208,8 @@ class Cluster:
             for i, n in enumerate(names)
         ]
         return Cluster.build(
-            specs, links, solver=solver, enable_ctrl=enable_ctrl, chaos=chaos
+            specs, links, solver=solver, enable_ctrl=enable_ctrl, chaos=chaos,
+            node_config_transform=node_config_transform,
         )
 
     def _transport_for(self, name: str):

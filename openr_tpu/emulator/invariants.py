@@ -1,6 +1,6 @@
 """Post-quiescence cluster invariant checker for the emulator.
 
-Four invariant classes over a quiesced Cluster (storm over, rate faults
+Five invariant classes over a quiesced Cluster (storm over, rate faults
 off, structural faults healed):
 
   1. **KvStore consistency** — every node's KvStoreDb in an area is
@@ -16,6 +16,11 @@ off, structural faults healed):
   4. **Counter sanity** — cross-counter identities hold (rebuild-path
      counters sum to the rebuild count, peer add/remove deltas match the
      live peer set, no residual failure streaks).
+  5. **Bounded seam depth** — no policied messaging queue's depth
+     watermark ever exceeded its configured cap (the overload policies
+     absorbed every burst at the bound); the long-horizon memory
+     watermark lives in the soak runner (emulator/soak.py), which needs
+     cross-round state this single-shot checker doesn't have.
 
 `wait_quiescent` polls until all four hold (twice consecutively, so a
 mid-flight sample can't pass by luck) or raises with the chaos replay
@@ -282,13 +287,50 @@ def check_counter_sanity(cluster) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------------ 5. bounded seam depth
+
+
+def check_queue_bounds(cluster) -> list[Violation]:
+    """Overload-control invariant: no policied inter-module queue's depth
+    WATERMARK may have exceeded the node's configured cap — the overflow
+    policies (coalesce / shed-oldest / block, openr_tpu/messaging) must
+    have absorbed every burst at the bound. A node built with
+    `messaging.enforce_bounds=False` keeps its cap configured but its
+    queues unbounded, so this check failing on it is the *control case*
+    proving the watermark detector works (tests/test_soak.py)."""
+    out: list[Violation] = []
+    for name, node in cluster.nodes.items():
+        cap = node.config.node.messaging.queue_maxsize
+        if cap <= 0:
+            continue
+        for key, q in getattr(node, "queues", {}).items():
+            if q.policy is None:
+                continue  # control-event seams are unbounded by design
+            for r in q.readers:
+                # COALESCE deliberately admits unmergeable items past
+                # the bound, one per counted overflow — those admissions
+                # are designed behavior, not a breach
+                if r.highwater > cap + r.overflow:
+                    out.append(
+                        Violation(
+                            "queue.depth_breach",
+                            name,
+                            f"{key} reader {r.name}: watermark "
+                            f"{r.highwater} > cap {cap} "
+                            f"(+{r.overflow} counted overflow)",
+                        )
+                    )
+    return out
+
+
 # -------------------------------------------------------------- entry points
 
 
 def check_cluster(cluster) -> list[Violation]:
-    """All four invariant classes; cheap checks first so the poll loop
+    """All five invariant classes; cheap checks first so the poll loop
     fails fast while the cluster is still settling."""
     out = check_no_stuck_state(cluster)
+    out += check_queue_bounds(cluster)
     out += check_kvstore_consistency(cluster)
     out += check_counter_sanity(cluster)
     out += check_fib_oracle_parity(cluster)

@@ -1,0 +1,310 @@
+"""Seeded long-horizon soak runner: back-to-back chaos storms over
+sustained background prefix churn, with per-round invariant gates.
+
+The short storms in tests/test_chaos.py prove the recovery machinery
+converges once; production outages look different — *minutes* of
+overlapping flaps while the control plane keeps originating and
+withdrawing prefixes, which is exactly the regime where unbounded queues
+grow and slow leaks hide. This runner composes PR 3's ``ChaosPlan``
+storms for N rounds over a continuous churn generator and, after every
+round's quiescence, enforces:
+
+  * all five cluster invariant classes (``emulator/invariants.py``),
+    including the bounded-queue-depth watermark check, and
+  * a **monotone-memory watermark**: RSS and live-object count after
+    round r must stay within tolerance of the post-round-1 baseline
+    (round 1 absorbs warmup: JAX compilation caches, interned wire
+    bytes) — the leak class a single short storm can never surface.
+
+Every failure message embeds ``seed=<s> round=<r>`` plus the plan's
+schedule hash, so a failing soak replays from its printout:
+``python -m openr_tpu.emulator --soak --seed <s> --rounds <r+1>``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import logging
+from dataclasses import dataclass, field
+
+from openr_tpu.emulator.chaos import (
+    ChaosPlan,
+    FibFaults,
+    KvFaults,
+    LinkFaults,
+    run_schedule,
+)
+from openr_tpu.emulator.cluster import Cluster
+from openr_tpu.emulator.invariants import wait_quiescent
+from openr_tpu.watchdog.watchdog import _current_rss_mb
+
+log = logging.getLogger(__name__)
+
+
+class SoakError(AssertionError):
+    """An invariant or watermark breach; the message carries the seed and
+    round needed to replay the failing run."""
+
+
+@dataclass
+class SoakConfig:
+    seed: int = 7
+    rounds: int = 3
+    edges: list = field(default_factory=list)  # [(a, b)] — required
+    solver: str = "cpu"
+    # per-round storm shape (fed to Cluster.make_storm)
+    storm_duration_s: float = 1.6
+    n_flaps: int = 3
+    n_crashes: int = 1
+    n_partitions: int = 0
+    heal_after_s: float = 0.6
+    # rate faults active during each storm
+    link_faults: LinkFaults = field(
+        default_factory=lambda: LinkFaults(drop=0.05, reorder=0.05, jitter_ms=20.0)
+    )
+    kv_faults: KvFaults = field(
+        default_factory=lambda: KvFaults(fail_flood=0.05)
+    )
+    fib_faults: FibFaults = field(default_factory=FibFaults)
+    # background churn: advertise/withdraw cadence per churn step
+    churn_interval_s: float = 0.03
+    churn_prefixes: int = 12  # fixed pool size (fixed pool ⇒ bounded keys)
+    # must cover a saturated peer-sync backoff (30 s envelope): a peer
+    # whose connects failed throughout a crash window may legitimately
+    # sleep most of that before the reconnect that drains its backlog
+    quiesce_timeout_s: float = 90.0
+    # memory watermark tolerances vs the post-round-1 baseline
+    mem_rss_slack_mb: float = 96.0
+    mem_obj_rel_tol: float = 0.10
+    mem_obj_abs_tol: int = 50_000
+    # control knob: build the cluster with messaging bounds DISABLED
+    # (caps stay configured, queues unbounded) to prove the watermark
+    # checks catch unbounded growth
+    enforce_queue_bounds: bool = True
+
+
+@dataclass
+class RoundSample:
+    round: int
+    rss_mb: float | None
+    objects: int
+    churn_events: int
+    schedule_hash: str
+
+
+@dataclass
+class SoakReport:
+    seed: int
+    rounds: list[RoundSample] = field(default_factory=list)
+
+    def summary(self) -> str:
+        lines = [f"soak seed={self.seed}: {len(self.rounds)} round(s) clean"]
+        for s in self.rounds:
+            rss = f"{s.rss_mb:.0f}MB" if s.rss_mb is not None else "n/a"
+            lines.append(
+                f"  round {s.round}: rss={rss} objects={s.objects} "
+                f"churn={s.churn_events} schedule={s.schedule_hash[:12]}"
+            )
+        return "\n".join(lines)
+
+
+class PrefixChurner:
+    """Sustained background prefix churn through the PrefixManager API
+    seam: each step advertises or withdraws one prefix from a fixed
+    per-node pool on a seeded-random live node. The pool is fixed so the
+    steady-state key count is bounded — what must NOT grow round over
+    round is memory, and a drifting advertisement set would mask that.
+    """
+
+    def __init__(self, cluster: Cluster, rng, interval_s: float, pool: int):
+        self.cluster = cluster
+        self.rng = rng
+        self.interval_s = interval_s
+        self.pool = pool
+        self.events = 0
+        self._advertised: set[tuple[str, int]] = set()  # (node, idx)
+        self._task: asyncio.Task | None = None
+        # stable node ids for prefix derivation: crash/restart must not
+        # shift another node's churn prefixes onto it
+        self._ids = {
+            name: i
+            for i, name in enumerate(
+                sorted(set(cluster.nodes) | set(cluster.crashed))
+            )
+        }
+
+    def _push(self, node_name: str, idx: int, add: bool) -> None:
+        from openr_tpu.prefixmgr.prefix_manager import (
+            PrefixEvent,
+            PrefixEventType,
+            PrefixSource,
+        )
+        from openr_tpu.types.network import IpPrefix
+        from openr_tpu.types.topology import PrefixEntry
+
+        node = self.cluster.nodes.get(node_name)
+        if node is None:
+            return  # crashed mid-storm: skip this step
+        nid = self._ids[node_name] & 0xFF
+        entry = PrefixEntry(
+            prefix=IpPrefix.make(f"10.200.{nid}.{idx}/32")
+        )
+        node.prefix_events.push(
+            PrefixEvent(
+                type=(
+                    PrefixEventType.ADD_PREFIXES
+                    if add
+                    else PrefixEventType.WITHDRAW_PREFIXES
+                ),
+                source=PrefixSource.API,
+                entries=(entry,),
+            )
+        )
+        self.events += 1
+        key = (node_name, idx)
+        (self._advertised.add if add else self._advertised.discard)(key)
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            names = sorted(self.cluster.nodes)
+            if not names:
+                continue
+            node_name = names[self.rng.randrange(len(names))]
+            idx = self.rng.randrange(self.pool)
+            add = (node_name, idx) not in self._advertised
+            self._push(node_name, idx, add)
+
+    def start(self) -> None:
+        assert self._task is None
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def stop(self, withdraw: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if withdraw:
+            # return to the base advertisement set so every round
+            # quiesces into the same steady state
+            for node_name, idx in sorted(self._advertised):
+                self._push(node_name, idx, add=False)
+            self._advertised.clear()
+
+
+def _memory_sample() -> tuple[float | None, int]:
+    gc.collect()
+    return _current_rss_mb(), len(gc.get_objects())
+
+
+async def run_soak(cfg: SoakConfig) -> SoakReport:
+    """Run the multi-round soak; raises :class:`SoakError` (with the
+    seed+round replay hint embedded) on any invariant or watermark
+    breach."""
+    assert cfg.edges, "SoakConfig.edges is required"
+    plan = ChaosPlan(
+        cfg.seed,
+        link_faults=cfg.link_faults,
+        kv_faults=cfg.kv_faults,
+        fib_faults=cfg.fib_faults,
+    )
+    transform = None
+    if not cfg.enforce_queue_bounds:
+        # control case: every node built with bounds OFF while the caps
+        # stay configured, so check_queue_bounds still knows the limits
+        from dataclasses import replace
+
+        def transform(ncfg):  # noqa: F811
+            return replace(
+                ncfg,
+                messaging=replace(ncfg.messaging, enforce_bounds=False),
+            )
+
+    cluster = Cluster.from_edges(
+        cfg.edges, solver=cfg.solver, chaos=plan,
+        node_config_transform=transform,
+    )
+    # rate faults gate on the per-round storms — initial bring-up is
+    # clean so round boundaries always start from a converged baseline
+    plan.active = False
+    await cluster.start()
+    try:
+        await cluster.wait_converged(timeout=cfg.quiesce_timeout_s)
+        report = SoakReport(seed=cfg.seed)
+        churn_rng = plan.rng("soak/churn")
+        baseline: tuple[float | None, int] | None = None
+        for rnd in range(cfg.rounds):
+            plan.active = True
+            cluster.make_storm(
+                plan,
+                duration_s=cfg.storm_duration_s,
+                n_flaps=cfg.n_flaps,
+                n_crashes=cfg.n_crashes,
+                n_partitions=cfg.n_partitions,
+                heal_after_s=cfg.heal_after_s,
+            )
+            context = (
+                f"soak seed={cfg.seed} round={rnd} "
+                f"(--soak --seed {cfg.seed} --rounds {rnd + 1}; "
+                f"{plan.replay_hint()})"
+            )
+            churner = PrefixChurner(
+                cluster, churn_rng, cfg.churn_interval_s, cfg.churn_prefixes
+            )
+            churner.start()
+            try:
+                await run_schedule(cluster, plan)
+            finally:
+                await churner.stop(withdraw=True)
+            try:
+                await wait_quiescent(
+                    cluster,
+                    timeout_s=cfg.quiesce_timeout_s,
+                    context=context,
+                )
+            except AssertionError as e:
+                raise SoakError(str(e)) from e
+            rss_mb, objects = _memory_sample()
+            report.rounds.append(
+                RoundSample(
+                    round=rnd,
+                    rss_mb=rss_mb,
+                    objects=objects,
+                    churn_events=churner.events,
+                    schedule_hash=plan.schedule_hash(),
+                )
+            )
+            log.info(
+                "soak round %d clean: rss=%s objects=%d churn=%d",
+                rnd, rss_mb, objects, churner.events,
+            )
+            if rnd == 0:
+                # round 1 is the warmup baseline (JIT caches, interned
+                # bytes); monotone growth is judged from here on
+                baseline = (rss_mb, objects)
+                continue
+            base_rss, base_obj = baseline
+            if (
+                rss_mb is not None
+                and base_rss is not None
+                and rss_mb > base_rss + cfg.mem_rss_slack_mb
+            ):
+                raise SoakError(
+                    f"memory watermark breach ({context}): RSS "
+                    f"{rss_mb:.0f}MB > baseline {base_rss:.0f}MB + "
+                    f"{cfg.mem_rss_slack_mb:.0f}MB slack"
+                )
+            obj_cap = base_obj * (1 + cfg.mem_obj_rel_tol) + cfg.mem_obj_abs_tol
+            if objects > obj_cap:
+                raise SoakError(
+                    f"object watermark breach ({context}): "
+                    f"{objects} live objects > cap {obj_cap:.0f} "
+                    f"(baseline {base_obj})"
+                )
+        return report
+    finally:
+        await cluster.stop()

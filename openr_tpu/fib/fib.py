@@ -16,7 +16,7 @@ import time
 from typing import Iterable, Protocol
 
 from openr_tpu.common import constants as C
-from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.backoff import ExponentialBackoff, stable_rng
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
@@ -214,7 +214,15 @@ class Fib(OpenrModule):
         self._warm_booted = False  # programmed_* adopted from the kernel
         self._dirty = asyncio.Event()
         self.backoff = ExponentialBackoff(
-            config.node.fib.initial_retry_ms, config.node.fib.max_retry_ms
+            config.node.fib.initial_retry_ms,
+            config.node.fib.max_retry_ms,
+            # a dataplane outage fails every node's programming at once;
+            # jitter spreads the retry wave (the envelope, current_ms,
+            # stays deterministic for the saturation warning below);
+            # name-seeded RNG: decorrelated across nodes, reproducible
+            # across runs (seeded-soak replay)
+            jitter=True,
+            rng=stable_rng(config.node_name, "fib-program"),
         )
         self._fail_streak = 0  # consecutive failed program passes
         self._warned_backoff_saturated = False
@@ -333,7 +341,7 @@ class Fib(OpenrModule):
                 self._need_full_sync = True
                 self._dirty.set()
                 self.backoff.report_error()
-                delay = self.backoff.current_ms / 1e3
+                delay = self.backoff.delay_ms / 1e3
                 self._fail_streak += 1
                 if self.counters:
                     self.counters.increment("fib.program_fail")

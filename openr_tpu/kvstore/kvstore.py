@@ -14,7 +14,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any
 
-from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.backoff import ExponentialBackoff, stable_rng
 from openr_tpu.common.constants import DEFAULT_AREA
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
@@ -46,11 +46,19 @@ class PeerEvent:
 
 
 class _Peer:
-    def __init__(self, spec: PeerSpec):
+    def __init__(self, spec: PeerSpec, owner: str = ""):
         self.spec = spec
         self.session = None
         self.synced = False
-        self.backoff = ExponentialBackoff(100, 30_000)
+        # jittered: after a partition heals, every peer on the losing
+        # side has an identical failure history — without jitter they
+        # all re-sync at the same instant (thundering herd). RNG seeded
+        # from (owner, peer): decorrelated across pairs, reproducible
+        # across runs (seeded-soak replay)
+        self.backoff = ExponentialBackoff(
+            100, 30_000, jitter=True,
+            rng=stable_rng(owner, spec.node_name, "kv-sync"),
+        )
         self.flood_failures = 0
         self.sync_task: "asyncio.Task | None" = None
         # pending flood state (coalesced by key: versions only grow, so
@@ -162,7 +170,7 @@ class KvStore(OpenrModule):
             if self.counters is not None:
                 self.counters.increment("kvstore.peers_rejected_bad_area")
             return
-        peer = _Peer(spec)
+        peer = _Peer(spec, owner=self.node_name)
         self.peers[key] = peer
         if self.counters is not None:
             self.counters.increment("kvstore.peers_added")
@@ -244,6 +252,9 @@ class KvStore(OpenrModule):
                         )
                 peer.synced = True
                 peer.backoff.report_success()
+                # un-gate the flood pump: publications buffered while the
+                # peer was sessionless flush now, as one coalesced batch
+                peer.flood_wake.set()
                 if self.counters is not None:
                     self.counters.increment("kvstore.full_syncs")
                 ft = self.flood_topos.get(area)
@@ -339,18 +350,58 @@ class KvStore(OpenrModule):
         for (parea, pname), peer in self.peers.items():
             if parea != area or pname == exclude:
                 continue
-            if pname in pub.node_ids or peer.session is None:
+            if pname in pub.node_ids:
                 continue
             if spt is not None and pname not in spt:
                 continue
+            # sessionless (backed-off / reconnecting) peers still get the
+            # update QUEUED: it coalesces into the per-peer pending
+            # buffer and flushes when the sync task re-establishes the
+            # session — one merged message instead of a thundering
+            # replay (flood throttling; the buffer stays bounded by
+            # flood_pending_max_keys below)
             self._enqueue_flood(peer, pub)
 
     def _enqueue_flood(self, peer: _Peer, pub: Publication) -> None:
+        """Merge one publication into the peer's pending-flood buffer.
+
+        Version-dominant per key (the same total order as
+        store.merge_key_values): a queued value is only replaced by one
+        that would win the merge, so out-of-order local enqueues can
+        never regress what the peer eventually receives."""
         coalesced = 0
         for k, v in pub.key_vals.items():
-            if k in peer.pending_keys:
+            cur = peer.pending_keys.get(k)
+            if cur is not None:
                 coalesced += 1
+                v.with_hash()
+                cur.with_hash()
+                if (
+                    v.value is None
+                    and (v.version, v.originator_id, v.hash)
+                    == (cur.version, cur.originator_id, cur.hash)
+                ):
+                    # ttl refresh of the buffered payload: fold the newer
+                    # ttl into the queued FULL value — replacing it with
+                    # the hash-only refresh would strand the peer on a
+                    # payload it now can only get via anti-entropy
+                    if v.ttl_version > cur.ttl_version:
+                        peer.pending_keys[k] = Value(
+                            version=cur.version,
+                            originator_id=cur.originator_id,
+                            value=cur.value,
+                            ttl=v.ttl,
+                            ttl_version=v.ttl_version,
+                            hash=cur.hash,
+                        )
+                    peer.pending_expired.discard(k)
+                    continue
+                if (v.version, v.originator_id, v.hash, v.ttl_version) < (
+                    cur.version, cur.originator_id, cur.hash, cur.ttl_version
+                ):
+                    continue  # queued value already dominates
             peer.pending_keys[k] = v
+            peer.pending_expired.discard(k)  # re-advertised: alive again
         peer.pending_expired.update(pub.expired_keys)
         if pub.perf_events is not None:
             # traces of coalesced publications merge, same as the keys.
@@ -399,6 +450,18 @@ class KvStore(OpenrModule):
                 peer.flood_wake.clear()
                 await peer.flood_wake.wait()
                 continue
+            if peer.session is None:
+                # backed-off peer: hold the coalesced backlog — further
+                # publications keep merging into it — until the sync
+                # task re-establishes the session (it sets flood_wake);
+                # the post-heal flush is ONE rate-limited message, not a
+                # replay of every buffered publication
+                if self.counters is not None:
+                    self.counters.increment("kvstore.floods_held")
+                peer.flood_wake.clear()
+                if peer.session is None:  # re-check: no await raced us
+                    await peer.flood_wake.wait()
+                continue
             if rate > 0:
                 now = asyncio.get_running_loop().time()
                 tokens = min(burst, tokens + (now - last) * rate)
@@ -425,8 +488,19 @@ class KvStore(OpenrModule):
             )
             session = peer.session
             if session is None:
-                # session died while queued: the pending sync's FULL_SYNC
-                # supersedes this backlog
+                # session died during the rate-limit wait: fold the batch
+                # back under whatever newer values landed meanwhile and
+                # hold until the sync task restores the session. An
+                # expiry only comes back for keys NOT re-advertised in
+                # the interim — pending_keys is the newer word
+                for k, v in kv.items():
+                    peer.pending_keys.setdefault(k, v)
+                peer.pending_expired |= exp - peer.pending_keys.keys()
+                if pe is not None:
+                    peer.pending_perf = (
+                        pe if peer.pending_perf is None
+                        else pe.merge(peer.pending_perf)
+                    )
                 continue
             try:
                 t0 = asyncio.get_running_loop().time()

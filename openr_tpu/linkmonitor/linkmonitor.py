@@ -304,6 +304,11 @@ class LinkMonitor(OpenrModule):
                 area,
                 adj_key(self.node_name),
                 to_wire(db),
+                # finite TTL (was TTL_INFINITY): a hard-crashed node
+                # that never says goodbye must fade out of every LSDB
+                # by TTL, or routes through it persist forever — the
+                # client refreshes live keys, so only the dead decay
+                ttl_ms=self.config.node.kvstore.key_ttl_ms,
                 # per-area copy: each area's publication is stamped by
                 # its own downstream pipeline
                 perf_events=pe.copy() if pe is not None else None,

@@ -54,17 +54,60 @@ class OpenrNode:
         self.counters = Counters()
 
         # ---- queues (reference: Main.cpp queue construction †) ----------
-        self.neighbor_events = ReplicateQueue(name=f"{self.name}.nbr")
-        self.interface_events = ReplicateQueue(name=f"{self.name}.if")
-        self.peer_events = ReplicateQueue(name=f"{self.name}.peers")
-        self.kvstore_pubs = ReplicateQueue(name=f"{self.name}.pubs")
-        self.prefix_events = ReplicateQueue(name=f"{self.name}.prefix")
-        self.route_updates = ReplicateQueue(name=f"{self.name}.routes")
-        self.fib_updates = ReplicateQueue(name=f"{self.name}.fib")
-        self.log_samples = ReplicateQueue(name=f"{self.name}.logs")
+        # Every seam is depth-gauged; the policied ones are bounded with
+        # an overflow discipline matched to their payload (messaging
+        # overload control — docs/Architecture.md): mergeable deltas
+        # coalesce at the bound, telemetry sheds oldest, control events
+        # stay unbounded (losing one breaks protocol state machines).
+        from openr_tpu.messaging import COALESCE, SHED_OLDEST
+        from openr_tpu.messaging.policies import (
+            coalesce_publications,
+            coalesce_route_updates,
+        )
+
+        mcfg = config.node.messaging
+        bound = mcfg.queue_maxsize if mcfg.enforce_bounds else 0
+
+        def _q(short: str, policy=None, coalesce_fn=None) -> ReplicateQueue:
+            return ReplicateQueue(
+                name=f"{self.name}.{short}",
+                maxsize=bound if policy is not None else 0,
+                policy=policy,
+                coalesce_fn=coalesce_fn,
+                counters=self.counters,
+                counter_key=short,
+            )
+
+        self.neighbor_events = _q("neighbor_events")
+        self.interface_events = _q("interface_events")
+        self.peer_events = _q("peer_events")
+        self.kvstore_pubs = _q(
+            "kvstore_pubs", COALESCE, coalesce_publications
+        )
+        self.prefix_events = _q("prefix_events")
+        self.route_updates = _q(
+            "route_updates", COALESCE, coalesce_route_updates
+        )
+        self.fib_updates = _q(
+            "fib_updates", COALESCE, coalesce_route_updates
+        )
+        self.log_samples = _q("log_samples", SHED_OLDEST)
         # completed convergence traces: Fib → Monitor (reference: the
         # perf-event ring the fib drains into the monitor †)
-        self.perf_events = ReplicateQueue(name=f"{self.name}.perf")
+        self.perf_events = _q("perf_events", SHED_OLDEST)
+        # registry for introspection: breeze `monitor queues` renders the
+        # gauges; the soak's bounded-depth invariant walks the readers
+        self.queues: dict[str, ReplicateQueue] = {
+            "neighbor_events": self.neighbor_events,
+            "interface_events": self.interface_events,
+            "peer_events": self.peer_events,
+            "kvstore_pubs": self.kvstore_pubs,
+            "prefix_events": self.prefix_events,
+            "route_updates": self.route_updates,
+            "fib_updates": self.fib_updates,
+            "log_samples": self.log_samples,
+            "perf_events": self.perf_events,
+        }
 
         # ---- modules, dependency order ----------------------------------
         self.store = None
@@ -235,17 +278,7 @@ class OpenrNode:
         self._started = False
         for m in reversed(self._modules):
             await m.stop()
-        for q in (
-            self.neighbor_events,
-            self.interface_events,
-            self.peer_events,
-            self.kvstore_pubs,
-            self.prefix_events,
-            self.route_updates,
-            self.fib_updates,
-            self.log_samples,
-            self.perf_events,
-        ):
+        for q in self.queues.values():
             q.close()
 
     async def wait_initialized(self, timeout: float = 30.0) -> None:

@@ -14,7 +14,7 @@ import struct
 from dataclasses import dataclass
 from typing import Protocol
 
-from openr_tpu.common.constants import SPARK_MCAST_PORT
+from openr_tpu.common.constants import SPARK_INBOX_MAXSIZE, SPARK_MCAST_PORT
 
 
 class IoProvider(Protocol):
@@ -43,13 +43,27 @@ class MockIoHub:
     here the pump is the event loop itself.
     """
 
-    def __init__(self):
+    # per-node inbox bound: a partitioned or stalled receiver sheds its
+    # OLDEST packets (hellos are periodic and self-superseding, so the
+    # newest state always survives) instead of growing RAM without limit
+    INBOX_MAX = SPARK_INBOX_MAXSIZE
+
+    def __init__(self, inbox_max: int | None = None):
         self._links: list[_MockLink] = []
         self._inboxes: dict[str, asyncio.Queue] = {}
+        self.inbox_max = self.INBOX_MAX if inbox_max is None else inbox_max
+        self.inbox_drops: dict[str, int] = {}  # dst node -> dropped packets
+        self._counters: dict[str, object] = {}  # dst node -> Counters
 
     def io_for(self, node: str) -> "MockIo":
         self._inboxes.setdefault(node, asyncio.Queue())
         return MockIo(self, node)
+
+    def set_counters(self, node: str, counters) -> None:
+        """Attach a node's Counters registry so inbox drops surface as
+        that node's `spark.inbox_dropped` counter (the hub exists before
+        the nodes do, so registration is a second step)."""
+        self._counters[node] = counters
 
     def link(
         self,
@@ -104,16 +118,37 @@ class MockIoHub:
         (emulator/chaos.py)."""
         if lk.latency_ms > 0:
             asyncio.get_event_loop().call_later(
-                lk.latency_ms / 1e3, inbox.put_nowait, (dst_if, payload)
+                lk.latency_ms / 1e3, self._inbox_put, dst_node, dst_if, payload
             )
         else:
-            inbox.put_nowait((dst_if, payload))
+            self._inbox_put(dst_node, dst_if, payload)
+
+    def _inbox_put(self, dst_node: str, dst_if: str, payload: bytes) -> None:
+        """Bounded inbox append (re-resolving the inbox, so a packet
+        delayed past a crash is discarded with the dead incarnation).
+        At the bound the oldest packet is shed and counted."""
+        inbox = self._inboxes.get(dst_node)
+        if inbox is None:
+            return
+        if self.inbox_max > 0 and inbox.qsize() >= self.inbox_max:
+            inbox.get_nowait()
+            self.inbox_drops[dst_node] = self.inbox_drops.get(dst_node, 0) + 1
+            c = self._counters.get(dst_node)
+            if c is not None:
+                c.increment("spark.inbox_dropped")
+        inbox.put_nowait((dst_if, payload))
 
 
 class MockIo:
     def __init__(self, hub: MockIoHub, node: str):
         self._hub = hub
         self.node = node
+
+    def attach_counters(self, counters) -> None:
+        """Spark hands its node's Counters down at construction so hub
+        inbox drops surface as `spark.inbox_dropped` (same seam on every
+        IoProvider)."""
+        self._hub.set_counters(self.node, counters)
 
     async def recv(self) -> tuple[str, bytes]:
         return await self._hub._inboxes[self.node].get()
@@ -134,10 +169,17 @@ class UdpIoProvider:
     (local_port, peer_addr) pairs.
     """
 
-    def __init__(self):
+    def __init__(self, inbox_max: int = SPARK_INBOX_MAXSIZE):
         self._transports: dict[str, asyncio.DatagramTransport] = {}
         self._peers: dict[str, tuple[str, int]] = {}
         self._rx: asyncio.Queue = asyncio.Queue()
+        self.inbox_max = inbox_max
+        self.rx_dropped = 0  # oldest-shed count at the rx bound
+        self._counters = None
+
+    def attach_counters(self, counters) -> None:
+        """Export rx sheds as `spark.inbox_dropped` (wired by Spark)."""
+        self._counters = counters
 
     async def add_interface(
         self, if_name: str, local_port: int = 0,
@@ -146,8 +188,20 @@ class UdpIoProvider:
         loop = asyncio.get_event_loop()
         rx = self._rx
 
+        provider = self
+
         class Proto(asyncio.DatagramProtocol):
             def datagram_received(self, data, addr):
+                # bounded rx: shed oldest under overload (periodic Spark
+                # traffic is self-superseding) instead of growing RAM
+                if (
+                    provider.inbox_max > 0
+                    and rx.qsize() >= provider.inbox_max
+                ):
+                    rx.get_nowait()
+                    provider.rx_dropped += 1
+                    if provider._counters is not None:
+                        provider._counters.increment("spark.inbox_dropped")
                 rx.put_nowait((if_name, data))
 
         transport, _ = await loop.create_datagram_endpoint(

@@ -143,6 +143,11 @@ class Spark(OpenrModule):
         self.ctrl_port = ctrl_port
         self.endpoint_host = endpoint_host
         self.interfaces: set[str] = set()
+        # inbox-shed visibility: every IoProvider that bounds its rx
+        # queue exports drops through this node's counters
+        attach = getattr(io, "attach_counters", None)
+        if attach is not None and counters is not None:
+            attach(counters)
         # (if_name, neighbor_name) -> state
         self.neighbors: dict[tuple[str, str], _Neighbor] = {}
         self.seq = 0

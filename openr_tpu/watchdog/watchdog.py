@@ -90,6 +90,10 @@ class Watchdog(OpenrModule):
                 continue
             age = now - m.last_heartbeat
             if age > self.timeout_s:
+                if self.counters:
+                    # stall-specific ledger (aborts also counts memory
+                    # breaches; a soak watches this one for stuck loops)
+                    self.counters.increment("watchdog.stalls")
                 self._fire(
                     f"module {m.name} stuck: no heartbeat for {age:.1f}s "
                     f"(limit {self.timeout_s}s)"

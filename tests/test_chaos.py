@@ -352,6 +352,96 @@ def test_chaos_soak(scenario, solver):
     run(body())
 
 
+# ------------------------------------------------------- dead-node TTL death
+
+
+def test_dead_node_keys_expire_and_routes_reroute():
+    """Satellite (ISSUE 4): a node that crashes PERMANENTLY (no restart,
+    no graceful announcement) must fade out of the control plane by TTL
+    alone — `_ttl_tick` on every surviving store expires its adj/prefix
+    keys, Decision drops the routes through and to it, and the cluster
+    settles into all invariants with traffic rerouted around the hole."""
+    from openr_tpu.common import constants as C
+    from openr_tpu.config import KvstoreConfig, NodeConfig, OriginatedPrefix
+    from openr_tpu.emulator.cluster import (
+        FAST_SPARK,
+        ClusterNodeSpec,
+        LinkSpec,
+        loopback_of,
+    )
+
+    TTL_MS = 1500
+
+    async def body():
+        names = ["a", "b", "c", "d"]
+        specs = [
+            ClusterNodeSpec(
+                name=n,
+                config=NodeConfig(
+                    node_name=n,
+                    spark=FAST_SPARK,
+                    kvstore=KvstoreConfig(key_ttl_ms=TTL_MS),
+                    originated_prefixes=(
+                        OriginatedPrefix(prefix=loopback_of(i)),
+                    ),
+                ),
+            )
+            for i, n in enumerate(names)
+        ]
+        links = [
+            LinkSpec(a="a", b="b"), LinkSpec(a="b", b="c"),
+            LinkSpec(a="c", b="d"), LinkSpec(a="d", b="a"),
+        ]
+        c = Cluster.build(specs, links)
+        await c.start()
+        await c.wait_converged(timeout=20.0)
+        dead_loopback = None
+        for r in c.nodes["a"].fib.get_programmed_unicast():
+            if str(r.dest) == loopback_of(1):
+                dead_loopback = r.dest
+        assert dead_loopback is not None
+
+        await c.crash_node("b", graceful=False)  # hard crash, never returns
+
+        def dead_keys_everywhere_gone() -> bool:
+            for node in c.nodes.values():
+                for key in node.kvstore.dbs["0"].kv:
+                    if key == C.adj_key("b") or key.startswith("prefix:b"):
+                        return False
+            return True
+
+        t0 = asyncio.get_event_loop().time()
+        while not dead_keys_everywhere_gone():
+            assert asyncio.get_event_loop().time() - t0 < 30.0, (
+                "dead node's keys never expired from surviving stores"
+            )
+            await asyncio.sleep(0.1)
+        for node in c.nodes.values():
+            assert node.counters.get("kvstore.expired_keys") >= 1
+
+        # full quiescence: all invariant classes on the 3-node remainder
+        await wait_quiescent(c, timeout_s=30.0, context="dead-node ttl")
+        # the ring healed around the hole: a still reaches c and d ...
+        for name, node in c.nodes.items():
+            others = {loopback_of(i) for i, n in enumerate(names) if n != name}
+            others.discard(loopback_of(1))  # ... but b's loopback is GONE
+            programmed = {
+                str(r.dest) for r in node.fib.get_programmed_unicast()
+            }
+            assert others <= programmed, (name, others - programmed)
+            assert loopback_of(1) not in programmed, (
+                f"{name} still routes to the dead node's loopback"
+            )
+        # a→c no longer transits b: the nexthop swings to the d side
+        route_ac = {
+            str(r.dest): r for r in c.nodes["a"].fib.get_programmed_unicast()
+        }[loopback_of(2)]
+        assert all("if-a-d" == nh.if_name for nh in route_ac.nexthops)
+        await c.stop()
+
+    run(body())
+
+
 # --------------------------------------------------- warm boot under restart
 
 
@@ -367,6 +457,10 @@ def test_crash_restart_warm_boot_continuity():
         )
         await c.start()
         await c.wait_converged(timeout=20.0)
+        # full quiescence, not just route COUNTS: the ring's equal-cost
+        # second nexthop can land after wait_converged under suite load,
+        # and the continuity assertions below compare exact route sets
+        await wait_quiescent(c, timeout_s=20.0)
         target = "b"
         handler = c.nodes[target].fib_handler
         from openr_tpu.fib.fib import CLIENT_ID_OPENR

@@ -160,6 +160,19 @@ def test_monitor_counters(live):
     assert "kvstore." in out
 
 
+def test_monitor_queues(live):
+    """Acceptance (ISSUE 4): live per-queue depth / highwater / policy
+    gauges on an emulated cluster, via ctrl and the Prometheus export."""
+    out = invoke(live, "a", "monitor", "queues")
+    for col in ("queue", "depth", "highwater", "coalesced", "shed"):
+        assert col in out, col
+    # every policied + gauged seam reports
+    for q in ("kvstore_pubs", "route_updates", "log_samples", "perf_events"):
+        assert q in out, q
+    prom = invoke(live, "a", "monitor", "prometheus")
+    assert 'key="queue.kvstore_pubs.highwater"' in prom
+
+
 def test_decision_path(live):
     out = invoke(live, "a", "decision", "path", "c")
     assert "total cost" in out and "b" in out  # a->b->c on the line

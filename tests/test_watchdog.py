@@ -1,0 +1,135 @@
+"""Watchdog liveness-supervisor tests (reference analogue: the
+Watchdog thread's eventbase scan in openr/watchdog/Watchdog.cpp †).
+
+The module previously had zero coverage. Exercised here: stall
+detection on a module whose heartbeat fiber is genuinely wedged, the
+injectable abort_fn firing with the stall reason, the
+`watchdog.stalls` / `watchdog.aborts` / `watchdog.scans` counter
+ledger, the memory-breach path, and quiet operation on a healthy set.
+"""
+
+import asyncio
+import time
+
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.config import Config, NodeConfig
+from openr_tpu.monitor import Counters
+from openr_tpu.watchdog import Watchdog
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class _WedgedModule(OpenrModule):
+    """A module whose heartbeat fiber hangs forever — the observable
+    signature of a blocked module loop (the heartbeat never re-stamps,
+    exactly as if the loop were stuck in a long synchronous call)."""
+
+    async def _heartbeat_loop(self) -> None:
+        await asyncio.Event().wait()  # never stamps again
+
+
+def _mk(abort_log, timeout_s=0.05, modules=(), **kw) -> Watchdog:
+    cfg = Config(NodeConfig(node_name="n"))
+    wd = Watchdog(
+        cfg,
+        list(modules),
+        abort_fn=abort_log.append,
+        counters=Counters(),
+        **kw,
+    )
+    wd.timeout_s = timeout_s  # config field is whole seconds; tests can't wait
+    return wd
+
+
+def test_stall_detection_fires_abort_fn():
+    async def body():
+        stuck = _WedgedModule("n.stuck")
+        aborts: list[str] = []
+        wd = _mk(aborts, modules=[stuck])
+        await stuck.start()
+        try:
+            await asyncio.sleep(0.12)  # > timeout_s since the last stamp
+            wd.check()
+            assert aborts and "n.stuck" in aborts[0] and "stuck" in aborts[0]
+            assert wd.fired == aborts[0]
+            assert wd.counters.get("watchdog.stalls") == 1
+            assert wd.counters.get("watchdog.aborts") == 1
+        finally:
+            await stuck.stop()
+
+    run(body())
+
+
+def test_healthy_modules_do_not_fire():
+    async def body():
+        mod = OpenrModule("n.ok")
+        await mod.start()  # heartbeat fiber stamps every second
+        aborts: list[str] = []
+        wd = _mk(aborts, timeout_s=5.0, modules=[mod])
+        try:
+            wd.check()
+            wd.check()
+            assert not aborts and wd.fired is None
+            assert wd.counters.get("watchdog.scans") == 2
+            assert wd.counters.get("watchdog.stalls") == 0
+        finally:
+            await mod.stop()
+
+    run(body())
+
+
+def test_stopped_module_is_exempt():
+    """A cleanly stopped module's stale heartbeat must not trip the
+    scan — shutdown is not a stall."""
+
+    async def body():
+        mod = OpenrModule("n.stopped")
+        await mod.start()
+        await mod.stop()
+        mod.last_heartbeat = time.monotonic() - 100
+        aborts: list[str] = []
+        wd = _mk(aborts, modules=[mod])
+        wd.check()
+        assert not aborts
+
+    run(body())
+
+
+def test_memory_breach_fires_without_stall_counter():
+    async def body():
+        aborts: list[str] = []
+        wd = _mk(aborts, max_memory_mb=1)  # any real process exceeds 1MB
+        wd.check()
+        assert aborts and "memory" in aborts[0]
+        assert wd.counters.get("watchdog.aborts") == 1
+        assert wd.counters.get("watchdog.stalls") == 0  # not a stall
+
+    run(body())
+
+
+def test_watchdog_scan_loop_detects_wedge_end_to_end():
+    """Integration: the watchdog's own periodic scan (no manual check()
+    call) catches a wedged module and fires."""
+
+    async def body():
+        stuck = _WedgedModule("n.wedged")
+        aborts: list[str] = []
+        wd = _mk(aborts, modules=[stuck])
+        wd.interval_s = 0.02
+        await stuck.start()
+        await wd.start()
+        try:
+            t0 = asyncio.get_event_loop().time()
+            while not aborts:
+                assert asyncio.get_event_loop().time() - t0 < 5.0, (
+                    "watchdog scan never caught the wedged module"
+                )
+                await asyncio.sleep(0.01)
+            assert wd.counters.get("watchdog.stalls") >= 1
+        finally:
+            await wd.stop()
+            await stuck.stop()
+
+    run(body())
