@@ -29,6 +29,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import numpy as np  # noqa: E402
 
+from openr_tpu.common.tasks import guard_task, reap  # noqa: E402
+
 
 def build_decision(
     adj_dbs, prefix_dbs, debounce_min=None, debounce_max=None,
@@ -147,7 +149,9 @@ async def churn(
             for pe in upd.perf_events:
                 trace_ms.append(pe.total_ms())
 
-    drainer = asyncio.ensure_future(drain())
+    drainer = guard_task(
+        asyncio.ensure_future(drain()), owner="bench_churn.drain"
+    )
     # Pre-generate the flap publications: in production the serialization
     # happens at each flapping link's OWN router (LinkMonitor persistKey);
     # this node only ever sees the serialized value arrive from KvStore.
@@ -215,7 +219,7 @@ async def churn(
     # let the tail drain
     await asyncio.sleep(1.0)
     spf_runs = dec._spf_runs - base_spf_runs
-    drainer.cancel()
+    await reap(drainer)
     await dec.stop()
     return (
         n_flaps, spf_runs, spf_ms, got_t, no_change_flaps[0], breakdown,

@@ -1,139 +1,53 @@
 #!/usr/bin/env bash
-# CI: native build + lint (when ruff is installed) + full test suite.
+# CI: native build + ruff + orlint + emulator smokes + full test suite.
 # Mirrors the reference's CI shape (build deps, compile, ctest) for this
-# repo: make -C native, ruff, pytest on the virtual 8-device CPU mesh.
+# repo: make -C native, lint, pytest on the virtual 8-device CPU mesh.
+#
+# The tree-scraping doc lints that used to live here as bash/python
+# heredocs (perf markers, decision.rebuild.*, flood/program counters,
+# queue/ctrl/watchdog/spark counters) are now orlint rule OR007 backed
+# by the central name registry (openr_tpu/monitor/names.py); the task
+# hygiene, determinism and queue-seam contracts are OR001..OR006. See
+# docs/Linting.md.
 set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "== native build =="
 make -C native
 
-if command -v ruff >/dev/null 2>&1; then
-    echo "== ruff =="
-    ruff check openr_tpu tests benchmarks
-else
-    echo "== ruff not installed; skipping lint =="
+echo "== ruff =="
+if ! command -v ruff >/dev/null 2>&1; then
+    echo "ERROR: ruff is not installed — the lint lane is mandatory."
+    echo "Install it (pip install ruff); the rule set is pinned in"
+    echo "pyproject.toml [tool.ruff]. CI must not silently skip lint."
+    exit 1
 fi
+ruff check openr_tpu tests benchmarks tools
 
-echo "== perf marker docs lint =="
-# every stage marker in the vocabulary (and every string literal stamped
-# at a call site) must be documented in docs/Monitor.md
-python - <<'PYEOF'
-import pathlib
-import re
-import sys
+echo "== orlint (project AST lint; registry<->docs parity via OR007) =="
+python -m tools.orlint openr_tpu tests benchmarks
 
-from openr_tpu.monitor import perf
-
-doc = pathlib.Path("docs/Monitor.md").read_text()
-missing = [m for m in perf.ALL_MARKERS if m not in doc]
-if missing:
-    sys.exit(f"markers missing from docs/Monitor.md: {missing}")
-
-# stamp call sites may only use the documented vocabulary: collect
-# string literals passed to add_perf_event()/PerfEvents.start() and the
-# perf.<MARKER> constant references across the package
-used: set[str] = set()
-for p in pathlib.Path("openr_tpu").rglob("*.py"):
-    src = p.read_text()
-    used.update(
-        re.findall(
-            r"(?:add_perf_event|PerfEvents\.start)\(\s*[\"']([A-Z_]+)[\"']",
-            src,
-        )
-    )
-    used.update(re.findall(r"perf\.([A-Z_][A-Z_0-9]*)\b", src))
-used -= {"MAX_EVENTS_PER_TRACE", "ALL_MARKERS"}
-unknown = sorted(used - set(perf.ALL_MARKERS))
-if unknown:
-    sys.exit(f"undocumented stage markers stamped in code: {unknown}")
-print(f"ok: {len(perf.ALL_MARKERS)} markers documented, {len(used)} in use")
-PYEOF
-
-echo "== decision.rebuild counter docs lint =="
-# every decision.rebuild.* counter name emitted in code must be
-# documented in docs/Monitor.md (same contract as the perf markers)
-python - <<'PYEOF'
-import pathlib
-import re
-import sys
-
-doc = pathlib.Path("docs/Monitor.md").read_text()
-names: set[str] = set()
-for p in pathlib.Path("openr_tpu").rglob("*.py"):
-    names.update(
-        re.findall(r"[\"'](decision\.rebuild\.[a-z_]+)[\"']", p.read_text())
-    )
-if not names:
-    sys.exit("no decision.rebuild.* counters found in code (lint broken?)")
-missing = sorted(n for n in names if n not in doc)
-if missing:
-    sys.exit(f"decision.rebuild counters missing from docs/Monitor.md: {missing}")
-print(f"ok: {len(names)} decision.rebuild counters documented")
-PYEOF
-
-echo "== kvstore.flood_* / fib.program_* counter docs lint =="
-# every flood/programming failure-path counter emitted in code must be
-# documented in docs/Monitor.md (same contract as decision.rebuild.*)
-python - <<'PYEOF'
-import pathlib
-import re
-import sys
-
-doc = pathlib.Path("docs/Monitor.md").read_text()
-names: set[str] = set()
-for p in pathlib.Path("openr_tpu").rglob("*.py"):
-    names.update(
-        re.findall(
-            r"[\"'](kvstore\.flood[a-z_]*|fib\.program[a-z_]*)[\"']",
-            p.read_text(),
-        )
-    )
-if not names:
-    sys.exit("no kvstore.flood_*/fib.program_* counters found (lint broken?)")
-missing = sorted(n for n in names if n not in doc)
-if missing:
-    sys.exit(f"flood/program counters missing from docs/Monitor.md: {missing}")
-print(f"ok: {len(names)} flood/program counters documented")
-PYEOF
-
-echo "== queue.* / ctrl.sub_* / watchdog.* counter docs lint =="
-# the overload-control counter surface must be documented in
-# docs/Monitor.md (same contract as the flood/program counters):
-# queue gauge FIELDS come from the messaging layer's emit sites, the
-# rest are literal counter names
-python - <<'PYEOF'
-import pathlib
-import re
-import sys
-
-doc = pathlib.Path("docs/Monitor.md").read_text()
-msg_src = pathlib.Path("openr_tpu/messaging/__init__.py").read_text()
-fields = set(re.findall(r"queue\.\{self\.ckey\}\.([a-z_]+)", msg_src))
-# policy counters route through _count(what, ...): collect the whats
-fields |= set(re.findall(r"self\._count\(\s*\"([a-z_]+)\"", msg_src))
-if not fields:
-    sys.exit("no queue.* gauge fields found in messaging (lint broken?)")
-missing = sorted(f for f in fields if f"queue.<name>.{f}" not in doc)
-if missing:
-    sys.exit(f"queue gauge fields missing from docs/Monitor.md: {missing}")
-names: set[str] = set()
-for p in pathlib.Path("openr_tpu").rglob("*.py"):
-    # counters only (validate() check names share the watchdog.* shape)
-    names.update(
-        re.findall(
-            r"increment\(\s*[\"'](ctrl\.sub_[a-z_]+|watchdog\.[a-z_]+|"
-            r"spark\.inbox_[a-z_]+)[\"']",
-            p.read_text(),
-        )
-    )
-if not names:
-    sys.exit("no ctrl.sub_*/watchdog.*/spark.inbox_* counters found")
-missing = sorted(n for n in names if n not in doc)
-if missing:
-    sys.exit(f"overload counters missing from docs/Monitor.md: {missing}")
-print(f"ok: {len(fields)} queue fields + {len(names)} counters documented")
-PYEOF
+echo "== orlint smoke (known-bad fixture must trip every rule) =="
+set +e
+smoke_out=$(python -m tools.orlint \
+    tests/fixtures/orlint/decision/known_bad.py --no-baseline 2>&1)
+smoke_rc=$?
+set -e
+if [ "$smoke_rc" -ne 1 ]; then
+    echo "expected the known-bad fixture to produce findings (rc=1)," \
+         "got rc=$smoke_rc"
+    echo "$smoke_out"
+    exit 1
+fi
+for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007; do
+    if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
+        echo "orlint smoke: rule $code produced no finding on the" \
+             "known-bad fixture (rule deleted or broken?)"
+        echo "$smoke_out"
+        exit 1
+    fi
+done
+echo "ok: known-bad fixture trips all 7 rules"
 
 echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
 # the tier-1-safe slice of the long-horizon soak: storms + background
@@ -142,47 +56,15 @@ echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
 JAX_PLATFORMS=cpu python -m openr_tpu.emulator --soak \
     --topo grid --nodes 9 --seed 7 --rounds 2
 
-echo "== chaos smoke (fixed seed, deterministic schedule) =="
-# small cluster, short seeded storm, full invariant check — the fast
-# always-on slice of the tests/test_chaos.py soak matrix
-JAX_PLATFORMS=cpu python - <<'PYEOF'
-import asyncio
-
-from openr_tpu.emulator import Cluster
-from openr_tpu.emulator.chaos import ChaosPlan, KvFaults, LinkFaults, run_schedule
-from openr_tpu.emulator.invariants import wait_quiescent
-
-
-async def main():
-    plan = ChaosPlan(
-        7,
-        link_faults=LinkFaults(drop=0.05, reorder=0.05, jitter_ms=20.0),
-        kv_faults=KvFaults(fail_flood=0.05),
-    )
-    c = Cluster.from_edges(
-        [("a", "b"), ("b", "c"), ("c", "d"), ("d", "a")], chaos=plan
-    )
-    await c.start()
-    await c.wait_converged(timeout=30.0)
-    c.make_storm(plan, duration_s=1.0, n_flaps=2, heal_after_s=0.4)
-    await run_schedule(c, plan)
-    await wait_quiescent(c, timeout_s=30.0, context=plan.replay_hint())
-    await c.stop()
-    print(
-        f"chaos smoke ok: {plan.replay_hint()}; "
-        f"stats={dict(sorted(plan.stats.items()))}"
-    )
-
-
-asyncio.run(main())
-PYEOF
-
 echo "== pytest tier-1 (not slow) =="
-# the fast lane the PR driver gates on — includes the observability
-# suite (tests/test_perf.py), the CLI/ctrl export tests, the
-# dirty-scoped rebuild parity suite (tests/test_rebuild_scoped.py:
-# randomized churn byte-equality on both engines), and the chaos soak
-# matrix (tests/test_chaos.py: three fixed-seed storms x both solvers)
+# the fast lane the PR driver gates on — observability (test_perf),
+# CLI/ctrl export, dirty-scoped rebuild parity (test_rebuild_scoped),
+# the chaos soak matrix (test_chaos: three fixed-seed storms x both
+# solvers — this subsumes the old inline chaos smoke), the orlint
+# self-tests (test_orlint: per-rule fixtures + shipped-baseline zero-
+# stale check) and the task-hygiene regressions (test_task_hygiene).
+# tests/conftest.py runs every loop in asyncio DEBUG mode and fails
+# any test that leaks pending tasks or never-retrieved exceptions.
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors
 
 echo "== pytest slow lane =="

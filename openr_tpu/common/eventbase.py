@@ -15,6 +15,8 @@ import logging
 import time
 from typing import Awaitable, Callable, Coroutine
 
+from openr_tpu.common.tasks import guard_task, reap
+
 log = logging.getLogger(__name__)
 
 
@@ -52,10 +54,13 @@ class OpenrModule:
         for t in reversed(live):
             t.cancel()
         for t in live:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+            # reap swallows only the fiber's own cancellation — one
+            # aimed at stop() itself re-raises, so module shutdown
+            # stays cancellable (OR005). Fiber crashes were already
+            # logged + counted by _guard. cancel=False: the loop above
+            # already cancelled every fiber; a second cancel would cut
+            # short a fiber's graceful CancelledError handler.
+            await reap(t, cancel=False)
         self._tasks.clear()
         await self.cleanup()
         log.debug("module %s stopped", self.name)
@@ -84,6 +89,16 @@ class OpenrModule:
             self._guard(coro), name=name or self.name
         )
         self._tasks[task] = None
+        # _guard re-raises only CancelledError, so guard_task's
+        # retrieve+log+count fires only if a subclass bypassed _guard —
+        # either way the exception can never park unretrieved on the
+        # Task (the asyncio sanitizer fails tests on that)
+        guard_task(
+            task,
+            owner=self.name,
+            counters=self.counters,
+            counter_key=f"{self.name}.task_exceptions",
+        )
 
         def _done(t, _coro=coro):
             self._tasks.pop(t, None)

@@ -1,0 +1,87 @@
+"""Task hygiene helpers: the OR002/OR005 contracts as library code.
+
+``guard_task`` is the required companion of every fire-and-forget
+``create_task``: without it, a crash inside the task parks the
+exception on the Task object and it surfaces only as a GC-time
+"exception was never retrieved" log line (the asyncio sanitizer in
+tests/conftest.py fails tests on exactly that). ``reap`` is the
+shutdown-side pattern: cancel + await a fiber while swallowing only
+the FIBER's cancellation — a cancellation aimed at the caller itself
+still propagates, so graceful shutdown can't be silently absorbed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+log = logging.getLogger(__name__)
+
+#: default counter bumped by guard_task on an uncaught task exception
+#: (registered in monitor/names.py).
+UNCAUGHT_KEY = "task.uncaught_exceptions"
+
+
+def guard_task(
+    task: asyncio.Task,
+    owner: str = "",
+    counters=None,
+    counter_key: str | None = None,
+) -> asyncio.Task:
+    """Attach a done-callback that logs + counts the task's uncaught
+    exception (if any) the moment the task finishes — never at GC time.
+    Returns the task for chaining."""
+
+    def _done(t: asyncio.Task) -> None:
+        if t.cancelled():
+            return
+        exc = t.exception()  # marks the exception retrieved
+        if exc is not None:
+            log.error(
+                "task %r (owner=%s) crashed",
+                t.get_name(),
+                owner or "-",
+                exc_info=exc,
+            )
+            if counters is not None:
+                counters.increment(counter_key or UNCAUGHT_KEY)
+
+    task.add_done_callback(_done)
+    return task
+
+
+async def reap(task: asyncio.Task | None, *, cancel: bool = True) -> None:
+    """Cancel ``task`` and await it. The reaped task's own
+    CancelledError is swallowed (that's the point of reaping); a
+    cancellation aimed at the CALLER re-raises, so stop() paths stay
+    cancellable. Non-cancellation exceptions are logged, not raised —
+    the fiber is being torn down, its failure must not abort the rest
+    of the shutdown sequence.
+
+    Pass ``cancel=False`` when the caller already cancelled the task
+    (e.g. a stop() that cancels every fiber up front, then reaps):
+    a second ``cancel()`` would interrupt the fiber's graceful
+    CancelledError handler mid-teardown."""
+    if task is None or task.done():
+        if task is not None and not task.cancelled():
+            # retrieve a parked exception so it can't fire at GC time
+            exc = task.exception()
+            if exc is not None:
+                log.debug(
+                    "reaped task %r had failed: %r", task.get_name(), exc
+                )
+        return
+    if cancel:
+        task.cancel()
+    try:
+        # shield: cancelling the REAPER must not look like the fiber's
+        # own cancellation (a bare `await task` forwards our cancel into
+        # `task`, making the two indistinguishable)
+        await asyncio.shield(task)
+    except asyncio.CancelledError:
+        if not task.cancelled():
+            raise  # the cancellation was aimed at US, not the fiber
+    except Exception:  # noqa: BLE001 — teardown must finish
+        log.exception(
+            "reaped task %r raised during cancellation", task.get_name()
+        )

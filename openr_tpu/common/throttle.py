@@ -12,12 +12,17 @@ import asyncio
 import time
 from typing import Awaitable, Callable
 
+from openr_tpu.common.tasks import guard_task
+
 
 class AsyncDebounce:
     """Coalesces bursts of operation() calls.
 
     poke() schedules fn after min_ms; repeated pokes push it out, bounded
-    by max_ms since the first un-flushed poke.
+    by max_ms since the first un-flushed poke. A crash inside fn is
+    logged + counted by the task guard the moment the timer task dies —
+    pre-guard, the exception parked unretrieved on the replaced Task and
+    surfaced only at GC time (OR002).
     """
 
     def __init__(
@@ -25,11 +30,15 @@ class AsyncDebounce:
         min_ms: float,
         max_ms: float,
         fn: Callable[[], Awaitable | None],
+        owner: str = "debounce",
+        counters=None,
     ):
         assert 0 < min_ms <= max_ms
         self.min_s = min_ms / 1e3
         self.max_s = max_ms / 1e3
         self.fn = fn
+        self.owner = owner
+        self.counters = counters
         self._task: asyncio.Task | None = None
         self._first_poke: float | None = None
         self._latest_poke: float = 0.0
@@ -43,7 +52,14 @@ class AsyncDebounce:
         if self._first_poke is None:
             self._first_poke = now
         if self._task is None or self._task.done():
-            self._task = asyncio.get_event_loop().create_task(self._wait())
+            self._task = guard_task(
+                asyncio.get_event_loop().create_task(
+                    self._wait(), name=f"{self.owner}.debounce"
+                ),
+                owner=self.owner,
+                counters=self.counters,
+                counter_key=f"{self.owner}.task_exceptions",
+            )
 
     async def _wait(self) -> None:
         while True:

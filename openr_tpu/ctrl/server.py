@@ -16,7 +16,7 @@ import logging
 
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.kvstore.kvstore import pub_to_json_value, value_from_json
-from openr_tpu.messaging import QueueClosedError
+from openr_tpu.messaging import QueueClosedError, RQueue
 from openr_tpu.rpc import RpcServer
 from openr_tpu.types.kvstore import KeyDumpParams, Publication
 from openr_tpu.types.network import IpPrefix
@@ -39,8 +39,8 @@ class CtrlServer(OpenrModule):
         # readers must exist before any module starts pushing
         self._kv_reader = node.kvstore_pubs.get_reader(f"{self.name}.kvsub")
         self._fib_reader = node.fib_updates.get_reader(f"{self.name}.fibsub")
-        self._kv_subs: set[asyncio.Queue] = set()
-        self._fib_subs: set[asyncio.Queue] = set()
+        self._kv_subs: set[RQueue] = set()
+        self._fib_subs: set[RQueue] = set()
         self._register_all()
 
     # ------------------------------------------------------------ lifecycle
@@ -63,23 +63,26 @@ class CtrlServer(OpenrModule):
 
     # ------------------------------------------------------------ fan-out
 
-    async def _fanout(self, reader, subs: set[asyncio.Queue], encode) -> None:
+    async def _fanout(self, reader, subs: set[RQueue], encode) -> None:
         """Drain one module queue, replicate to every live subscriber
         (reference: OpenrCtrlHandler's kvStorePublishers_ / fibPublishers_
-        lists fed from the subscriber fibers †)."""
+        lists fed from the subscriber fibers †). Subscriber queues are
+        messaging-seam RQueues; the bound is enforced here at put time
+        (SUB_QUEUE_MAX is a live instance knob) by evicting the OLDEST
+        buffered item, so the fan-out never blocks and the subscriber
+        keeps its stream minus the stalest update (reference:
+        OpenrCtrlHandler sheds on backed-up publisher streams †)."""
         while True:
             try:
                 item = await reader.get()
             except QueueClosedError:
                 for q in subs:
-                    try:
-                        q.put_nowait(None)
-                    except asyncio.QueueFull:
+                    if q.qsize() >= self.SUB_QUEUE_MAX:
                         # a retained-but-stalled subscriber may sit at
-                        # exactly maxsize: shed one item so the
+                        # exactly the bound: shed one item so the
                         # end-of-stream sentinel always lands
-                        q.get_nowait()
-                        q.put_nowait(None)
+                        q.try_get()
+                    q.put_nowait(None)
                 return
             if not subs:  # nobody listening — skip the encode work
                 continue
@@ -87,22 +90,11 @@ class CtrlServer(OpenrModule):
             if payload is None:
                 continue
             for q in list(subs):
-                try:
-                    q.put_nowait(payload)
-                except asyncio.QueueFull:
-                    # slow/stalled subscriber: evict its OLDEST buffered
-                    # item so the fan-out never blocks and the buffer
-                    # never grows past SUB_QUEUE_MAX — the subscriber
-                    # keeps its stream, just loses the stalest update
-                    # (reference: OpenrCtrlHandler sheds on backed-up
-                    # publisher streams †)
-                    try:
-                        q.get_nowait()
-                        q.put_nowait(payload)
-                    except (asyncio.QueueEmpty, asyncio.QueueFull):
-                        pass  # racing disconnect drain: drop this item
+                if q.qsize() >= self.SUB_QUEUE_MAX:
+                    q.try_get()
                     if self.counters:
                         self.counters.increment("ctrl.sub_evictions")
+                q.put_nowait(payload)
 
     @staticmethod
     def _encode_pub(pub) -> dict | None:
@@ -636,19 +628,21 @@ class CtrlServer(OpenrModule):
 
     SUB_QUEUE_MAX = 4096  # per-subscriber buffer before eviction
 
-    def _add_sub(self, subs: set[asyncio.Queue]) -> asyncio.Queue:
-        q: asyncio.Queue = asyncio.Queue(maxsize=self.SUB_QUEUE_MAX)
+    def _add_sub(self, subs: set[RQueue]) -> RQueue:
+        # unbounded messaging-seam queue; _fanout enforces SUB_QUEUE_MAX
+        # at put time (eviction, not blocking)
+        q: RQueue = RQueue(name=f"{self.name}.sub{len(subs)}")
         subs.add(q)
         if self.counters:
             self.counters.increment(f"{self.name}.subscribers")
         return q
 
-    def _remove_sub(self, subs: set[asyncio.Queue], q: asyncio.Queue) -> None:
+    def _remove_sub(self, subs: set[RQueue], q: RQueue) -> None:
         subs.discard(q)
         if self.counters:
             self.counters.increment(f"{self.name}.subscribers", -1)
 
-    async def _drain_sub(self, q: asyncio.Queue, stream, xform) -> None:
+    async def _drain_sub(self, q: RQueue, stream, xform) -> None:
         """Forward one subscriber's queue to its RPC stream until the
         stream disconnects or the fan-out ends/evicts it (None)."""
         while True:

@@ -286,7 +286,8 @@ class Decision(OpenrModule):
                 mesh=mesh,
             )
         self.debounce = AsyncDebounce(
-            dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes
+            dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes,
+            owner=self.name, counters=counters,
         )
         self.rib = RouteDatabase(this_node_name=self.node_name)
         self.rib_computed = asyncio.Event()  # RIB_COMPUTED init gate
@@ -1026,6 +1027,8 @@ class Decision(OpenrModule):
                 # see separately
                 **getattr(self, "_compute_split_ms", {}),
             }
+        except asyncio.CancelledError:
+            raise  # node shutdown mid-rebuild must propagate (OR005)
         except Exception:  # noqa: BLE001 — keep serving the old RIB
             log.exception("%s: route rebuild failed", self.name)
             # the dirt describing this batch was consumed but its routes
@@ -1036,10 +1039,13 @@ class Decision(OpenrModule):
             # rebuild (which WILL contain these publications' route
             # changes) completes them — otherwise the slowest, failure-
             # retried convergence events would vanish from the very
-            # metric this tracing exists to surface
-            self._pending_perf = (traces + self._pending_perf)[
-                :_PERF_PENDING_CAP
-            ]
+            # metric this tracing exists to surface. `traces` was POPPED
+            # from _pending_perf before the awaits and the RHS re-reads
+            # the CURRENT list, so this fold loses nothing — not a
+            # stale-read clobber:
+            self._pending_perf = (  # orlint: disable=OR003
+                traces + self._pending_perf
+            )[:_PERF_PENDING_CAP]
             return
         self._last_spf_ms = (time.perf_counter() - t0) * 1e3
         self._spf_runs += 1

@@ -28,6 +28,7 @@ import gc
 import logging
 from dataclasses import dataclass, field
 
+from openr_tpu.common.tasks import guard_task, reap
 from openr_tpu.emulator.chaos import (
     ChaosPlan,
     FibFaults,
@@ -178,15 +179,20 @@ class PrefixChurner:
 
     def start(self) -> None:
         assert self._task is None
-        self._task = asyncio.get_event_loop().create_task(self._run())
+        # guard: a crash mid-churn must surface (log + counter) the
+        # moment it happens, not sit parked on the Task until stop()
+        self._task = guard_task(
+            asyncio.get_event_loop().create_task(
+                self._run(), name="soak.churner"
+            ),
+            owner="soak.churner",
+        )
 
     async def stop(self, withdraw: bool = True) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+            # reap swallows only the churner's own cancellation; a
+            # cancellation aimed at stop() itself still propagates
+            await reap(self._task)
             self._task = None
         if withdraw:
             # return to the base advertisement set so every round

@@ -252,6 +252,8 @@ class Fib(OpenrModule):
             m = await self.handler.get_mpls_route_table_by_client(
                 CLIENT_ID_OPENR
             )
+        except asyncio.CancelledError:
+            raise  # shutdown during warm boot must propagate (OR005)
         except Exception as exc:  # noqa: BLE001 — cold boot on any failure
             log.info("%s: warm-boot dump unavailable (%s)", self.name, exc)
             return

@@ -139,6 +139,8 @@ class KvStore(OpenrModule):
             if peer.session is not None:
                 try:
                     await peer.session.close()
+                except asyncio.CancelledError:
+                    raise  # cleanup itself is being cancelled (OR005)
                 except Exception:  # noqa: BLE001
                     pass
         self.peers.clear()
@@ -197,6 +199,8 @@ class KvStore(OpenrModule):
         if peer.session is not None:
             try:
                 await peer.session.close()
+            except asyncio.CancelledError:
+                raise  # _del_peer's caller is being cancelled (OR005)
             except Exception:  # noqa: BLE001
                 pass
         if self.counters is not None:

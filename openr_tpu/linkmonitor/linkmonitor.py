@@ -77,6 +77,8 @@ class LinkMonitor(OpenrModule):
             max_ms=self.config.node.link_monitor.linkflap_initial_backoff_ms
             + 1000,
             fn=self.advertise_adjacencies,
+            owner=self.name,
+            counters=counters,
         )
 
     # ----------------------------------------------------------------- main

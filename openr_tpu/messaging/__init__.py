@@ -225,9 +225,26 @@ class RQueue(Generic[T]):
     def size(self) -> int:
         return len(self._items)
 
+    # stdlib-compatible aliases: call sites migrated off raw
+    # asyncio.Queue (OR004) keep their shape
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def get_nowait(self) -> T | None:
+        """Alias of try_get(): next item or None when empty/closed."""
+        return self.try_get()
+
     @property
     def closed(self) -> bool:
         return self._closed
+
+    def close(self) -> None:
+        """Close this reader endpoint directly (standalone RQueues, e.g.
+        the rpc stream buffers): wakes any blocked producer — whose next
+        ``put`` raises :class:`QueueClosedError` — and ``get`` raises it
+        after the drain sentinel. Readers minted by a ReplicateQueue are
+        closed via ``ReplicateQueue.close()`` instead."""
+        self._close()
 
     def _close(self) -> None:
         self._closing = True

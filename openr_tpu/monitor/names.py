@@ -1,0 +1,190 @@
+"""Central registry of counter / gauge / stat / marker names.
+
+Every name a module stamps into its :class:`Counters` registry — and
+every perf-trace stage marker — is declared here, so the observable
+surface of the system is one reviewable module instead of string
+literals scattered across the tree. ``tools/orlint`` rule **OR007**
+enforces it both ways:
+
+  * every literal (or f-string template) passed to
+    ``Counters.increment/set/add_value/touch`` or
+    ``PerfEvents.start/add_perf_event`` anywhere in ``openr_tpu`` must
+    resolve against this registry;
+  * every name in :data:`DOCUMENTED` (and every template's
+    :data:`TEMPLATES` doc-form, and every marker) must appear in
+    ``docs/Monitor.md`` — this subsumes the three bash-heredoc doc
+    lints ci.sh used to carry.
+
+Adding a counter: add the literal to :data:`COUNTERS` (or a template to
+:data:`TEMPLATES` when the name embeds a runtime key), and — for the
+operator-facing families — a row to docs/Monitor.md. docs/Linting.md
+covers the policy.
+"""
+
+from __future__ import annotations
+
+from openr_tpu.monitor import perf
+
+# --------------------------------------------------------------- markers
+
+#: perf-trace stage marker vocabulary (each must appear in
+#: docs/Monitor.md; stamp sites may only use these).
+MARKERS: tuple[str, ...] = perf.ALL_MARKERS
+
+#: non-marker public attributes of monitor.perf that `perf.<NAME>`
+#: references may legitimately touch (the OR007 attr check's allowlist).
+PERF_MODULE_EXPORTS: frozenset[str] = frozenset(
+    {"ALL_MARKERS", "MAX_EVENTS_PER_TRACE"}
+)
+
+# -------------------------------------------------------------- counters
+
+#: exact counter / gauge / stat names (literal emit sites).
+COUNTERS: frozenset[str] = frozenset(
+    {
+        # decision
+        "decision.lsdb_changes",
+        "decision.rebuild.full",
+        "decision.rebuild.prefix_only",
+        "decision.rebuild.cached_areas",
+        "decision.rebuild.area_solves",
+        "decision.rebuild_ms",
+        "decision.spf.solves",
+        "decision.spf_ms",
+        "decision.spf_runs",
+        "decision.spf_solve_ms",
+        # fib
+        "fib.perf_traces_completed",
+        "fib.program_ok",
+        "fib.program_fail",
+        "fib.program_fail_streak",
+        "fib.program_ms",
+        "fib.warm_boot_reprogrammed",
+        "fib.warm_boot_routes",
+        # kvstore
+        "kvstore.expired_keys",
+        "kvstore.flood_backpressure_drops",
+        "kvstore.flood_failures",
+        "kvstore.flood_fanout_ms",
+        "kvstore.flood_keys_coalesced",
+        "kvstore.flood_root_missing",
+        "kvstore.floods_held",
+        "kvstore.floods_rate_limited",
+        "kvstore.floods_received",
+        "kvstore.floods_sent",
+        "kvstore.full_sync_failures",
+        "kvstore.full_syncs",
+        "kvstore.full_syncs_served",
+        "kvstore.merged_updates",
+        "kvstore.peer_disconnects",
+        "kvstore.peers_added",
+        "kvstore.peers_rejected_bad_area",
+        "kvstore.peers_removed",
+        "kvclient.advertisements",
+        # spark / linkmonitor
+        "spark.bad_packets",
+        "spark.handshake_recv",
+        "spark.handshake_sent",
+        "spark.heartbeat_sent",
+        "spark.hello_recv",
+        "spark.hello_sent",
+        "spark.inbox_dropped",
+        "spark.neighbor_down",
+        "spark.neighbor_up",
+        "spark.restart_announced",
+        "linkmonitor.adj_advertised",
+        "linkmonitor.flap_damped",
+        "linkmonitor.neighbor_down",
+        "linkmonitor.neighbor_up",
+        # ctrl / watchdog / monitor
+        "ctrl.sub_evictions",
+        "watchdog.aborts",
+        "watchdog.scans",
+        "watchdog.stalls",
+        "monitor.convergence_ms",
+        "monitor.log_samples",
+        "monitor.perf_traces",
+        "monitor.perf_traces_multi_origin",
+        # everything else
+        "configstore.corrupt",
+        "configstore.stores",
+        "nlifaces.events",
+        "platform.errors",
+        "prefix_allocator.allocations",
+        "prefixmgr.advertised",
+        "prefixmgr.events",
+        "prefixmgr.policy_denied",
+        "prefixmgr.redistributed",
+        # common/tasks guard_task default
+        "task.uncaught_exceptions",
+    }
+)
+
+#: f-string templates (``*`` = runtime-interpolated segment), mapped to
+#: the doc-form docs/Monitor.md uses when the family is documented
+#: (None = internal family, registry membership only).
+TEMPLATES: dict[str, str | None] = {
+    # messaging queue gauge/counter fields — one row per field in
+    # docs/Monitor.md (the queue name is free)
+    "queue.*.depth": "queue.<name>.depth",
+    "queue.*.highwater": "queue.<name>.highwater",
+    "queue.*.blocked": "queue.<name>.blocked",
+    "queue.*.coalesced": "queue.<name>.coalesced",
+    "queue.*.shed": "queue.<name>.shed",
+    "queue.*.overflow": "queue.<name>.overflow",
+    # module-keyed lifecycle counters (OpenrModule)
+    "*.fiber_crashes": None,
+    "*.timer_errors": None,
+    "*.task_exceptions": None,
+    "*.subscribers": None,
+    # decision engine substructure
+    "decision.decode.*": None,
+    "decision.dev_cache.*": None,
+    "decision.spf.*": None,
+    # platform error taxonomy
+    "platform.*": None,
+}
+
+#: the queue counter FIELD vocabulary the messaging seams may emit —
+#: OR007 statically cross-checks messaging/__init__.py's emit sites
+#: against this set (the old ci.sh heredoc #4, now AST-based).
+QUEUE_FIELDS: frozenset[str] = frozenset(
+    {"depth", "highwater", "blocked", "coalesced", "shed", "overflow"}
+)
+
+#: names whose presence in docs/Monitor.md is REQUIRED (the
+#: operator-facing families the retired ci.sh heredocs covered; the
+#: rest of COUNTERS follows Monitor.md's generic `<module>.<what>`
+#: convention and only needs registry membership).
+DOCUMENTED: frozenset[str] = frozenset(
+    {n for n in COUNTERS if n.startswith("decision.rebuild.")}
+    | {n for n in COUNTERS if n.startswith("kvstore.flood")}
+    | {n for n in COUNTERS if n.startswith("fib.program")}
+    | {n for n in COUNTERS if n.startswith("ctrl.sub_")}
+    | {n for n in COUNTERS if n.startswith("watchdog.")}
+    | {n for n in COUNTERS if n.startswith("spark.inbox_")}
+)
+
+#: source files exempt from the per-callsite check: the registry's own
+#: mechanics (Counters expands `<stat>.sum` etc. dynamically) and the
+#: messaging seams (covered by the dedicated QUEUE_FIELDS cross-check).
+CALLSITE_EXEMPT: tuple[str, ...] = (
+    "openr_tpu/monitor/counters.py",
+    "openr_tpu/monitor/names.py",
+    "openr_tpu/messaging/__init__.py",
+)
+
+
+def is_registered(name_or_template: str) -> bool:
+    """True when a literal name or normalized f-string template resolves
+    against the registry (exact counter, exact template, or a literal
+    matching one template)."""
+    import fnmatch
+
+    if name_or_template in COUNTERS or name_or_template in TEMPLATES:
+        return True
+    if "*" in name_or_template:
+        return False
+    return any(
+        fnmatch.fnmatchcase(name_or_template, t) for t in TEMPLATES
+    )

@@ -7,9 +7,24 @@ import json
 import logging
 from typing import Any, AsyncIterator, Awaitable, Callable
 
+from openr_tpu.common.tasks import guard_task, reap
+from openr_tpu.messaging import QueueClosedError, RQueue
+
 log = logging.getLogger(__name__)
 
 MAX_LINE = 64 * 1024 * 1024  # LSDB dumps can be large
+
+# per-subscription client-side buffer: a slow stream consumer
+# backpressures the rx loop (and so, via TCP, the server's per-sub
+# eviction queue) instead of growing RAM without bound
+STREAM_BUF = 1024
+
+# how long the rx loop will sit blocked at one stream's bound before
+# declaring that consumer dead and breaking its stream — a subscriber
+# that never drains (or a generator that was never iterated, whose
+# cleanup can therefore never run) must not stall every other reply on
+# the client forever
+STREAM_STALL_S = 30.0
 
 
 class RpcError(Exception):
@@ -90,10 +105,10 @@ class RpcServer:
         for t in list(self._conn_tasks):
             t.cancel()
         for t in list(self._conn_tasks):
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+            # swallows only t's own cancellation; one aimed at stop()
+            # itself re-raises (OR005). cancel=False: all conn tasks
+            # were cancelled above.
+            await reap(t, cancel=False)
         self._conn_tasks.clear()
         if self._server is not None:
             self._server.close()
@@ -114,7 +129,12 @@ class RpcServer:
                     break
                 try:
                     msg = json.loads(line)
-                except json.JSONDecodeError:
+                except ValueError:
+                    # JSONDecodeError *or* UnicodeDecodeError: a garbage
+                    # frame that isn't valid UTF-8 raises the latter,
+                    # which json.JSONDecodeError does NOT cover — the
+                    # asyncio sanitizer caught the conn task dying on it
+                    # (test_fuzz_wire::test_rpc_server_survives_garbage)
                     log.warning("%s: bad json from peer", self.name)
                     continue
                 method = msg.get("method")
@@ -128,6 +148,8 @@ class RpcServer:
                             await fn(p, s)
                         except RpcError:
                             pass
+                        except asyncio.CancelledError:
+                            raise  # conn teardown cancels us (OR005)
                         except Exception:  # noqa: BLE001
                             log.exception("%s: stream handler failed", self.name)
                         finally:
@@ -138,6 +160,8 @@ class RpcServer:
                     try:
                         result = await self._methods[method](params)
                         reply = {"id": req_id, "result": result}
+                    except asyncio.CancelledError:
+                        raise  # server stop cancels conn tasks (OR005)
                     except Exception as e:  # noqa: BLE001
                         log.exception("%s: handler %s failed", self.name, method)
                         reply = {"id": req_id, "error": f"{type(e).__name__}: {e}"}
@@ -172,7 +196,7 @@ class RpcClient:
         self._writer: asyncio.StreamWriter | None = None
         self._next_id = 1
         self._pending: dict[int, asyncio.Future] = {}
-        self._streams: dict[int, asyncio.Queue] = {}
+        self._streams: dict[int, RQueue] = {}
         self._rx_task: asyncio.Task | None = None
 
     @property
@@ -186,15 +210,14 @@ class RpcClient:
             ),
             timeout,
         )
-        self._rx_task = asyncio.ensure_future(self._rx_loop())
+        self._rx_task = guard_task(
+            asyncio.ensure_future(self._rx_loop()), owner="rpc.client.rx"
+        )
 
     async def close(self) -> None:
         if self._rx_task:
-            self._rx_task.cancel()
-            try:
-                await self._rx_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+            # swallows only the rx fiber's cancellation, not close()'s
+            await reap(self._rx_task)
             self._rx_task = None
         if self._writer:
             self._writer.close()
@@ -207,7 +230,8 @@ class RpcClient:
                 fut.set_exception(err)
         self._pending.clear()
         for q in self._streams.values():
-            q.put_nowait(_STREAM_ERR)
+            # force: the sentinel must land even on a full queue
+            q.put_nowait(_STREAM_ERR, force=True)
         self._streams.clear()
 
     async def _rx_loop(self) -> None:
@@ -220,16 +244,40 @@ class RpcClient:
                 msg = json.loads(line)
                 req_id = msg.get("id")
                 if "item" in msg and req_id in self._streams:
-                    self._streams[req_id].put_nowait(msg["item"])
+                    try:
+                        # backpressured put: a slow consumer stalls line
+                        # reads (and via TCP, the sender) at STREAM_BUF
+                        await asyncio.wait_for(
+                            self._streams[req_id].put(msg["item"]),
+                            STREAM_STALL_S,
+                        )
+                    except QueueClosedError:
+                        # consumer abandoned the stream (gen() closed
+                        # its queue) — possibly while we were blocked
+                        # at the bound; drop the item and move on
+                        self._streams.pop(req_id, None)
+                    except asyncio.TimeoutError:
+                        # consumer sat at the bound for STREAM_STALL_S
+                        # without draining — or the generator was never
+                        # even iterated (its cleanup can't run). Break
+                        # THAT stream (its next get raises) rather than
+                        # stall every reply on this client forever.
+                        dead = self._streams.pop(req_id, None)
+                        if dead is not None:
+                            dead.close()
                 elif msg.get("end") and req_id in self._streams:
-                    self._streams.pop(req_id).put_nowait(_STREAM_END)
+                    self._streams.pop(req_id).put_nowait(
+                        _STREAM_END, force=True
+                    )
                 elif req_id in self._streams and (
                     "error" in msg or "result" in msg
                 ):
                     # server treated the subscription as a plain call (bad
                     # method / non-stream handler): fail the stream instead
                     # of hanging the subscriber forever
-                    self._streams.pop(req_id).put_nowait(_STREAM_ERR)
+                    self._streams.pop(req_id).put_nowait(
+                        _STREAM_ERR, force=True
+                    )
                 elif req_id in self._pending:
                     fut = self._pending.pop(req_id)
                     if not fut.done():
@@ -237,7 +285,10 @@ class RpcClient:
                             fut.set_exception(RpcError(msg["error"]))
                         else:
                             fut.set_result(msg.get("result"))
-        except (ConnectionError, json.JSONDecodeError, asyncio.IncompleteReadError):
+        except (ConnectionError, ValueError, asyncio.IncompleteReadError):
+            # ValueError covers JSONDecodeError AND UnicodeDecodeError —
+            # a non-UTF-8 frame from a corrupt/hostile server must take
+            # the clean connection-lost path, same as the server side
             pass
         except asyncio.CancelledError:
             raise
@@ -277,7 +328,11 @@ class RpcClient:
             raise RpcError("not connected")
         req_id = self._next_id
         self._next_id += 1
-        q: asyncio.Queue = asyncio.Queue()
+        # messaging-seam queue (OR004): bounded, block policy — the rx
+        # loop's awaited put is the backpressure point
+        q: RQueue = RQueue(
+            name=f"rpc.stream.{req_id}", maxsize=STREAM_BUF, policy="block"
+        )
         self._streams[req_id] = q
         self._writer.write(
             _dumps({"id": req_id, "method": method, "params": params or {}})
@@ -285,13 +340,31 @@ class RpcClient:
         await self._writer.drain()
 
         async def gen():
-            while True:
-                item = await q.get()
-                if item is _STREAM_END:
-                    return
-                if item is _STREAM_ERR:
-                    raise RpcError("stream broken")
-                yield item
+            try:
+                while True:
+                    try:
+                        item = await q.get()
+                    except QueueClosedError:
+                        # the rx loop declared this consumer stalled
+                        # (STREAM_STALL_S at the bound) and broke the
+                        # stream to protect the rest of the client
+                        raise RpcError(
+                            "stream dropped: consumer stalled past "
+                            "the buffer bound"
+                        ) from None
+                    if item is _STREAM_END:
+                        return
+                    if item is _STREAM_ERR:
+                        raise RpcError("stream broken")
+                    yield item
+            finally:
+                # consumer stopped iterating (break / aclose / GC):
+                # deregister AND close the queue, waking an rx loop
+                # blocked on `await q.put(...)` — otherwise one
+                # abandoned stream at the bound would stall every
+                # reply on this client forever
+                if self._streams.pop(req_id, None) is not None:
+                    q.close()
 
         return gen()
 

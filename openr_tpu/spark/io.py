@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from typing import Protocol
 
 from openr_tpu.common.constants import SPARK_INBOX_MAXSIZE, SPARK_MCAST_PORT
+from openr_tpu.messaging import RQueue
 
 
 class IoProvider(Protocol):
@@ -50,13 +51,23 @@ class MockIoHub:
 
     def __init__(self, inbox_max: int | None = None):
         self._links: list[_MockLink] = []
-        self._inboxes: dict[str, asyncio.Queue] = {}
+        self._inboxes: dict[str, RQueue] = {}
         self.inbox_max = self.INBOX_MAX if inbox_max is None else inbox_max
         self.inbox_drops: dict[str, int] = {}  # dst node -> dropped packets
         self._counters: dict[str, object] = {}  # dst node -> Counters
 
     def io_for(self, node: str) -> "MockIo":
-        self._inboxes.setdefault(node, asyncio.Queue())
+        # messaging-seam queue (OR004): the bound + shed-oldest policy
+        # live in the queue itself; _inbox_put keeps the per-node drop
+        # accounting (`spark.inbox_dropped`) at the shed point
+        self._inboxes.setdefault(
+            node,
+            RQueue(
+                name=f"spark.inbox.{node}",
+                maxsize=self.inbox_max,
+                policy="shed_oldest",
+            ),
+        )
         return MockIo(self, node)
 
     def set_counters(self, node: str, counters) -> None:
@@ -111,7 +122,7 @@ class MockIoHub:
         dst_node: str,
         dst_if: str,
         payload: bytes,
-        inbox: asyncio.Queue,
+        inbox: RQueue,
     ) -> None:
         """Final delivery of one packet onto the destination inbox — the
         per-delivery seam ChaosIoHub overrides to drop/delay/duplicate
@@ -130,8 +141,9 @@ class MockIoHub:
         inbox = self._inboxes.get(dst_node)
         if inbox is None:
             return
-        if self.inbox_max > 0 and inbox.qsize() >= self.inbox_max:
-            inbox.get_nowait()
+        if inbox.full:
+            # the RQueue sheds its own oldest at the bound; this branch
+            # just keeps the per-node drop accounting
             self.inbox_drops[dst_node] = self.inbox_drops.get(dst_node, 0) + 1
             c = self._counters.get(dst_node)
             if c is not None:
@@ -172,7 +184,10 @@ class UdpIoProvider:
     def __init__(self, inbox_max: int = SPARK_INBOX_MAXSIZE):
         self._transports: dict[str, asyncio.DatagramTransport] = {}
         self._peers: dict[str, tuple[str, int]] = {}
-        self._rx: asyncio.Queue = asyncio.Queue()
+        # messaging-seam rx queue (OR004): bounded shed-oldest
+        self._rx: RQueue = RQueue(
+            name="spark.udp.rx", maxsize=inbox_max, policy="shed_oldest"
+        )
         self.inbox_max = inbox_max
         self.rx_dropped = 0  # oldest-shed count at the rx bound
         self._counters = None
@@ -192,13 +207,10 @@ class UdpIoProvider:
 
         class Proto(asyncio.DatagramProtocol):
             def datagram_received(self, data, addr):
-                # bounded rx: shed oldest under overload (periodic Spark
-                # traffic is self-superseding) instead of growing RAM
-                if (
-                    provider.inbox_max > 0
-                    and rx.qsize() >= provider.inbox_max
-                ):
-                    rx.get_nowait()
+                # bounded rx: the RQueue sheds its oldest at the bound
+                # (periodic Spark traffic is self-superseding); count
+                # the drop here where the node identity is known
+                if rx.full:
                     provider.rx_dropped += 1
                     if provider._counters is not None:
                         provider._counters.increment("spark.inbox_dropped")

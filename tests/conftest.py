@@ -29,3 +29,151 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def pytest_report_header(config):
     return f"jax devices: {jax.devices()}"
+
+
+# --------------------------------------------------------------------------
+# asyncio sanitizer: every event loop a test creates (asyncio.run included)
+# runs in DEBUG mode with a recording exception handler and an
+# instrumented task factory. After each test the autouse fixture fails the
+# test if any task leaked an exception that was never retrieved, was
+# destroyed while still pending, or is still pending on a closed loop —
+# the failure classes `guard_task`/`reap` (openr_tpu.common.tasks) and
+# orlint OR002/OR005 exist to prevent. Opt out for a test that provokes
+# these on purpose with @pytest.mark.asyncio_sanitizer_off.
+
+import asyncio  # noqa: E402
+import gc  # noqa: E402
+import weakref  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+#: exception-handler messages that are task-hygiene failures. Everything
+#: else (e.g. "Error on transport creation" for a deliberately rejected
+#: TLS handshake, "Fatal error on transport" for a peer reset) is a
+#: transport-level condition a correct server hits under hostile peers —
+#: logged by asyncio but not a leak.
+_FAIL_SUBSTRINGS = (
+    "never retrieved",
+    "was destroyed but it is pending",
+    "Unhandled exception",
+    "Unhandled error",
+    "Exception in callback",
+    "unhandled exception during asyncio.run() shutdown",
+)
+
+
+class AsyncioSanitizer:
+    """Collects unhandled-asyncio evidence across every loop."""
+
+    def __init__(self):
+        self.events: list[str] = []
+        self._task_refs: list[weakref.ref] = []
+        # loop.set_debug() for loops created while True. The seeded
+        # cluster-storm suites (test_chaos/test_soak) opt down via
+        # @pytest.mark.asyncio_debug_off: debug's per-task traceback
+        # capture is a ~10x tax at 9-node-grid scale and breaks their
+        # convergence budgets — the sanitizer's handler, task
+        # accounting and teardown checks stay fully active there.
+        self.debug_enabled = True
+
+    # -- hooks installed on every new loop ---------------------------------
+
+    def handler(self, loop, context) -> None:
+        msg = context.get("message", "unhandled asyncio error")
+        if any(s in msg for s in _FAIL_SUBSTRINGS):
+            src = (
+                context.get("task")
+                or context.get("future")
+                or context.get("handle")
+            )
+            exc = context.get("exception")
+            self.events.append(f"{msg} [{src!r}] exc={exc!r}")
+        loop.default_exception_handler(context)
+
+    def task_factory(self, loop, coro, context=None):
+        # `context` arrives on Python >=3.11 (asyncio.Runner passes it);
+        # the Task ctor only accepts it there too
+        if context is None:
+            t = asyncio.tasks.Task(coro, loop=loop)
+        else:
+            t = asyncio.tasks.Task(coro, loop=loop, context=context)
+        self._task_refs.append(weakref.ref(t))
+        return t
+
+    # -- per-test accounting -----------------------------------------------
+
+    def drain(self) -> list[str]:
+        """Evidence since the last drain: recorded handler events plus
+        tasks still PENDING on a CLOSED loop (they can never complete —
+        a leaked fiber someone forgot to cancel/await)."""
+        out, self.events = self.events, []
+        live: list[weakref.ref] = []
+        for ref in self._task_refs:
+            t = ref()
+            if t is None:
+                continue
+            if not t.done() and t.get_loop().is_closed():
+                out.append(
+                    f"task still pending on closed loop: {t!r}"
+                )
+                continue  # reported once; drop the ref
+            live.append(ref)
+        self._task_refs = live
+        return out
+
+
+_SANITIZER = AsyncioSanitizer()
+
+
+class _SanitizerPolicy(asyncio.DefaultEventLoopPolicy):
+    def new_event_loop(self):
+        loop = super().new_event_loop()
+        # OPENR_ASYNCIO_DEBUG=0 turns off debug mode (slower loops) but
+        # keeps the sanitizer's handler + task accounting — useful when
+        # bisecting timing-sensitive failures
+        loop.set_debug(
+            _SANITIZER.debug_enabled
+            and os.environ.get("OPENR_ASYNCIO_DEBUG", "1") != "0"
+        )
+        # debug-mode's 100 ms "slow callback" warnings are noise for
+        # JAX-compiling tests; the sanitizer is after leaks, not latency
+        loop.slow_callback_duration = 10.0
+        loop.set_exception_handler(_SANITIZER.handler)
+        loop.set_task_factory(_SANITIZER.task_factory)
+        return loop
+
+
+asyncio.set_event_loop_policy(_SanitizerPolicy())
+
+
+# (the asyncio_sanitizer_off / asyncio_debug_off markers are registered
+# in pyproject.toml [tool.pytest.ini_options] markers — the single
+# declared registry)
+
+
+@pytest.fixture(autouse=True)
+def asyncio_sanitizer(request):
+    """Fail any test that leaks pending tasks or never-retrieved task
+    exceptions (GC is forced so parked exceptions surface NOW, in the
+    test that caused them, not in a random later one)."""
+    _SANITIZER.drain()  # don't blame this test for earlier leftovers
+    if request.node.get_closest_marker("asyncio_debug_off"):
+        _SANITIZER.debug_enabled = False
+    try:
+        yield
+    finally:
+        _SANITIZER.debug_enabled = True
+    gc.collect()
+    evidence = _SANITIZER.drain()
+    if not evidence:
+        return
+    if request.node.get_closest_marker("asyncio_sanitizer_off"):
+        return
+    details = "\n  ".join(evidence)
+    pytest.fail(
+        f"asyncio sanitizer: {len(evidence)} leaked task/exception "
+        f"event(s) during this test (guard fire-and-forget tasks with "
+        f"openr_tpu.common.tasks.guard_task; see docs/Linting.md):\n"
+        f"  {details}"
+    )

@@ -1,0 +1,39 @@
+"""Known-bad fixture for the orlint smoke lane (ci.sh) and self-tests.
+
+Every rule fires at least once below. The path deliberately contains a
+``decision`` component so the subsystem-scoped rules (OR003 atomicity,
+OR006 determinism) apply; the engine's directory walker skips
+``fixtures`` dirs, so this file is linted only when passed as an
+explicit argument (``python -m tools.orlint
+tests/fixtures/orlint/decision/known_bad.py``).
+
+EXPECTED: exactly one finding per rule, OR001..OR007 (asserted by
+tests/test_orlint.py::test_known_bad_fixture_covers_every_rule and the
+ci.sh smoke lane).
+"""
+
+import asyncio
+import random
+import time
+
+
+class Bad:
+    def __init__(self, counters):
+        self.counters = counters
+        self._pending = []
+        self.q = asyncio.Queue()  # OR004: raw queue outside messaging/
+
+    async def worker(self):
+        time.sleep(0.1)  # OR001: blocks the loop
+        asyncio.create_task(self.helper())  # OR002: discarded task
+        jitter = random.random()  # OR006: unseeded draw in decision path
+        pending = self._pending
+        await asyncio.sleep(jitter)
+        self._pending = pending + [1]  # OR003: stale read across await
+        self.counters.increment("bogus.counter.name")  # OR007: unregistered
+
+    async def helper(self):
+        try:
+            await asyncio.sleep(1)
+        except (asyncio.CancelledError, Exception):  # OR005: swallows cancel
+            pass

@@ -6,6 +6,7 @@ import asyncio
 import dataclasses
 import json
 import os
+from pathlib import Path
 
 import pytest
 
@@ -17,7 +18,9 @@ from openr_tpu.watchdog import Watchdog
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 # ------------------------------------------------------------ configstore
@@ -76,8 +79,8 @@ def test_persistent_store_atomic_write(tmp_path):
         st = PersistentStore(path)
         for i in range(20):
             await st.store("k", i)
-            with open(path) as f:
-                assert json.load(f)["k"] == i
+            raw = await asyncio.to_thread(Path(path).read_text)
+            assert json.loads(raw)["k"] == i
         assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
 
     run(body())

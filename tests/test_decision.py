@@ -20,7 +20,9 @@ from openr_tpu.utils import topogen
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 def mk_decision(name="node-0", backend="cpu"):

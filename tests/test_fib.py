@@ -19,7 +19,9 @@ from openr_tpu.types.routes import (
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 def mk_fib(dry_run=False, initial_retry_ms=4):

@@ -135,9 +135,7 @@ def test_spark_survives_garbage_packets():
         finally:
             await sp.stop()
 
-    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
-        body()
-    )
+    asyncio.run(body())
 
 
 def test_rpc_server_survives_garbage_frames():
@@ -173,9 +171,7 @@ def test_rpc_server_survives_garbage_frames():
         finally:
             await srv.stop()
 
-    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
-        body()
-    )
+    asyncio.run(body())
 
 
 async def _async_ret(value):

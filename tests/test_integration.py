@@ -7,12 +7,19 @@ import asyncio
 
 import pytest
 
+# multi-node cluster convergence suites: asyncio debug mode's per-task
+# traceback capture is a heavy tax at cluster scale; the sanitizer's
+# leak checks stay fully active (tests/conftest.py)
+pytestmark = pytest.mark.asyncio_debug_off
+
 from openr_tpu.emulator import Cluster, LinkSpec
 from openr_tpu.types.network import IpPrefix
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 def programmed_dests(node):

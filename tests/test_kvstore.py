@@ -20,7 +20,9 @@ from openr_tpu.types.kvstore import TTL_INFINITY, Publication, Value
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 def V(version, orig, value, ttl=TTL_INFINITY, ttl_version=0):

@@ -76,9 +76,9 @@ def test_two_process_convergence(tmp_path):
         ctrl_a, ctrl_b, kv_a, kv_b, udp_a, udp_b = _free_ports(6)
         cfg_a = tmp_path / "a.json"
         cfg_b = tmp_path / "b.json"
-        cfg_a.write_text(json.dumps(_node_cfg(
+        await asyncio.to_thread(cfg_a.write_text, json.dumps(_node_cfg(
             "proc-a", ctrl_a, kv_a, udp_a, udp_b, "10.99.0.1/32")))
-        cfg_b.write_text(json.dumps(_node_cfg(
+        await asyncio.to_thread(cfg_b.write_text, json.dumps(_node_cfg(
             "proc-b", ctrl_b, kv_b, udp_b, udp_a, "10.99.0.2/32")))
 
         procs = []
@@ -87,7 +87,9 @@ def test_two_process_convergence(tmp_path):
             for cfg in (cfg_a, cfg_b):
                 # log to files, not PIPEs: an unread full pipe buffer
                 # would deadlock a chatty/failing daemon
-                lf = open(str(cfg) + ".log", "wb")  # noqa: SIM115
+                lf = await asyncio.to_thread(  # noqa: SIM115
+                    open, str(cfg) + ".log", "wb"
+                )
                 logs.append(lf)
                 procs.append(
                     await asyncio.create_subprocess_exec(
@@ -125,4 +127,4 @@ def test_two_process_convergence(tmp_path):
             for lf in logs:
                 lf.close()
 
-    asyncio.new_event_loop().run_until_complete(main())
+    asyncio.run(main())

@@ -266,7 +266,9 @@ def test_kernel_mpls_add_dump_del():
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 @KERNEL
@@ -358,7 +360,8 @@ def test_netlink_interface_source():
         try:
             ev = await asyncio.wait_for(r.get(), 5)
             assert "lo" in {i.name for i in ev.interfaces}
-            subprocess.run(
+            await asyncio.to_thread(
+                subprocess.run,
                 ["ip", "addr", "add", "127.27.18.29/32", "dev", "lo"],
                 check=True, capture_output=True,
             )
@@ -375,7 +378,8 @@ def test_netlink_interface_source():
                         break
                 assert seen, "no live addr event"
             finally:
-                subprocess.run(
+                await asyncio.to_thread(
+                    subprocess.run,
                     ["ip", "addr", "del", "127.27.18.29/32", "dev", "lo"],
                     check=True, capture_output=True,
                 )

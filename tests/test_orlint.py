@@ -1,0 +1,451 @@
+"""orlint self-tests: per-rule positive/negative fixtures, suppression
+and baseline mechanics, the known-bad smoke fixture, and the shipped
+baseline's zero-stale self-check.
+
+Deleting any rule module must fail this suite: the catalog test pins
+the full OR001..OR007 set, and each rule has a positive fixture that
+yields no findings without its module.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from tools.orlint import iter_rules
+from tools.orlint.engine import load_baseline, run
+from tools.orlint.rules import all_rules
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
+
+ALL_CODES = {"OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007"}
+
+
+def lint_snippet(
+    tmp_path: pathlib.Path,
+    code: str,
+    rel: str = "openr_tpu/mod.py",
+    select: set[str] | None = None,
+    baseline: dict | None = None,
+):
+    """Write one snippet into a sandbox tree and lint it."""
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    bp = None
+    if baseline is not None:
+        bp = tmp_path / "baseline.json"
+        bp.write_text(json.dumps(baseline))
+    return run([rel], root=tmp_path, baseline_path=bp, select=select)
+
+
+def codes_of(res) -> list[str]:
+    return [f.code for f in res.findings]
+
+
+# ------------------------------------------------------------------ catalog
+
+
+def test_rule_catalog_is_complete():
+    """Every rule module is present and loadable — deleting one fails
+    here (and its positive fixture below)."""
+    assert {c.code for c in all_rules()} == ALL_CODES
+    rules = list(iter_rules())
+    assert len(rules) == len(ALL_CODES)
+    for r in rules:
+        assert r.description, f"{r.code} has no description"
+
+
+# ----------------------------------------------------------------- per-rule
+
+
+def test_or001_blocking_call_positive_negative(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import time, subprocess
+
+        async def bad():
+            time.sleep(1)
+            subprocess.run(["x"])
+            open("f")
+
+        async def good():
+            import asyncio
+            await asyncio.sleep(1)
+
+        def sync_ok():
+            time.sleep(1)  # not a coroutine: allowed
+        """,
+        select={"OR001"},
+    )
+    assert codes_of(res) == ["OR001", "OR001", "OR001"]
+    assert all("bad" in f.message for f in res.findings)
+
+
+def test_or001_nested_sync_def_not_flagged(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        async def outer():
+            def blocking_helper():
+                time.sleep(1)  # runs via to_thread: fine
+            import asyncio
+            await asyncio.to_thread(blocking_helper)
+        """,
+        select={"OR001"},
+    )
+    assert codes_of(res) == []
+
+
+def test_or002_dangling_task_variants(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        async def discarded():
+            asyncio.create_task(asyncio.sleep(1))
+
+        async def underscore():
+            _ = asyncio.create_task(asyncio.sleep(1))
+
+        async def unconsumed_name():
+            t = asyncio.create_task(asyncio.sleep(1))
+
+        async def awaited_ok():
+            t = asyncio.create_task(asyncio.sleep(1))
+            await t
+
+        async def callback_ok():
+            t = asyncio.create_task(asyncio.sleep(1))
+            t.add_done_callback(lambda _t: None)
+
+        async def collection_ok(tasks):
+            tasks.append(asyncio.create_task(asyncio.sleep(1)))
+
+        class CrossMethod:
+            def start(self):
+                self._t = asyncio.create_task(asyncio.sleep(1))
+
+            async def stop(self):
+                await self._t
+
+        class Leaky:
+            def start(self):
+                self._t = asyncio.create_task(asyncio.sleep(1))
+
+            def cancel(self):
+                self._t.cancel()  # cancel alone is not retention
+        """,
+        select={"OR002"},
+    )
+    scopes = sorted(f.fingerprint.split(":")[2] for f in res.findings)
+    assert scopes == ["discarded", "start", "unconsumed_name", "underscore"]
+    # only Leaky.start trips; CrossMethod.stop's await retains the task
+    leaky = [f for f in res.findings if "self._t" in f.message]
+    assert len(leaky) == 1 and leaky[0].line
+
+
+def test_or003_atomicity_positive_negative(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        class Rebuild:
+            async def stale_read(self):
+                snapshot = self.pending
+                await asyncio.sleep(0)
+                self.pending = snapshot + [1]  # clobbers concurrent pokes
+
+            async def same_stmt_await(self):
+                self.cache = await self.compute(self.cache)
+
+            async def reread_ok(self):
+                snapshot, self.pending = self.pending, []
+                await asyncio.sleep(0)
+                # RHS re-reads CURRENT self.pending: a fold, not a clobber
+                self.pending = self.pending + ["x"]
+
+            async def no_await_ok(self):
+                v = self.count
+                self.count = v + 1
+
+            async def different_attr_ok(self):
+                v = self.a
+                await asyncio.sleep(0)
+                self.b = v
+        """,
+        rel="openr_tpu/decision/mod.py",
+        select={"OR003"},
+    )
+    scopes = sorted(f.fingerprint.split(":")[2] for f in res.findings)
+    assert scopes == ["Rebuild.same_stmt_await", "Rebuild.stale_read"]
+
+
+def test_or003_scoped_to_decision_kvstore_fib(tmp_path):
+    snippet = """
+    import asyncio
+
+    class C:
+        async def f(self):
+            v = self.x
+            await asyncio.sleep(0)
+            self.x = v + 1
+    """
+    hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/kvstore/m.py", select={"OR003"}
+    )
+    miss = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/spark/m.py", select={"OR003"}
+    )
+    assert codes_of(hit) == ["OR003"] and codes_of(miss) == []
+
+
+def test_or004_raw_queue_scope(tmp_path):
+    snippet = """
+    import asyncio
+    q = asyncio.Queue(maxsize=8)
+    """
+    hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/foo/m.py", select={"OR004"}
+    )
+    exempt = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/messaging/m.py", select={"OR004"}
+    )
+    assert codes_of(hit) == ["OR004"] and codes_of(exempt) == []
+
+
+def test_or005_variants(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        async def tuple_catch():
+            try:
+                await asyncio.sleep(1)
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        async def bare():
+            try:
+                await asyncio.sleep(1)
+            except:  # noqa: E722
+                pass
+
+        async def broad_with_await():
+            try:
+                await asyncio.sleep(1)
+            except Exception:
+                pass
+
+        async def broad_no_await_ok():
+            try:
+                x = int("3")
+            except Exception:
+                x = 0
+            return x
+
+        async def reraise_ok():
+            try:
+                await asyncio.sleep(1)
+            except Exception:
+                raise
+
+        async def explicit_clause_ok():
+            try:
+                await asyncio.sleep(1)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+        async def conditional_reraise_ok(t):
+            try:
+                await t
+            except asyncio.CancelledError:
+                if not t.cancelled():
+                    raise
+            except Exception:
+                pass
+        """,
+        select={"OR005"},
+    )
+    scopes = sorted(f.fingerprint.split(":")[2] for f in res.findings)
+    assert scopes == ["bare", "broad_with_await", "tuple_catch"]
+
+
+def test_or006_determinism_scope_and_seeding(tmp_path):
+    snippet = """
+    import random, time, uuid
+    r = random.random()
+    t = time.time()
+    u = uuid.uuid4()
+    seeded = random.Random(42)       # explicit seed: allowed
+    unseeded = random.Random()       # OS-entropy: flagged
+    mono = time.monotonic()          # deltas: allowed
+    """
+    hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/emulator/m.py", select={"OR006"}
+    )
+    assert sorted(f.fingerprint.split(":")[3] for f in hit.findings) == [
+        "random.Random", "random.random", "time.time", "uuid.uuid4"
+    ]
+    miss = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/cli/m.py", select={"OR006"}
+    )
+    assert codes_of(miss) == []
+
+
+def test_or007_callsites(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        from openr_tpu.monitor import perf
+
+        class M:
+            def f(self):
+                self.counters.increment("kvstore.floods_sent")      # ok
+                self.counters.increment("queue.pubs.depth")         # template
+                self.counters.increment(f"{self.name}.fiber_crashes")  # tmpl
+                self.counters.increment("totally.made.up")          # BAD
+                self.counters.set("fib.program_fail_streak", 3)     # ok
+                self.counters.add_value(f"weird.{self.k}.stat", 1)  # BAD
+                pe.add_perf_event("FIB_PROGRAMMED")                 # ok
+                pe.add_perf_event("NOT_A_MARKER")                   # BAD
+                m = perf.FIB_PROGRAMMED                             # ok
+                n = perf.BOGUS_MARKER                               # BAD
+        """,
+        select={"OR007"},
+    )
+    subjects = sorted(f.fingerprint.split(":", 3)[3] for f in res.findings)
+    assert subjects == [
+        "NOT_A_MARKER", "perf.BOGUS_MARKER", "totally.made.up",
+        "weird.*.stat",
+    ]
+
+
+def test_or007_doc_parity_finalize(tmp_path):
+    """A sandbox docs/Monitor.md missing a marker and a documented-family
+    counter produces parity findings (the retired ci.sh heredoc
+    contract, now rule-owned)."""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "openr_tpu").mkdir()
+    (tmp_path / "openr_tpu" / "empty.py").write_text("")
+    from openr_tpu.monitor import names
+
+    doc_lines = [m for m in names.MARKERS if m != "FIB_PROGRAMMED"]
+    doc_lines += [n for n in sorted(names.DOCUMENTED)
+                  if n != "decision.rebuild.full"]
+    doc_lines += [d for d in names.TEMPLATES.values() if d]
+    (tmp_path / "docs" / "Monitor.md").write_text("\n".join(doc_lines))
+    res = run(["openr_tpu"], root=tmp_path, select={"OR007"})
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "FIB_PROGRAMMED" in msgs
+    assert "decision.rebuild.full" in msgs
+    assert len(res.findings) == 2
+
+
+# ------------------------------------------- suppression + baseline plumbing
+
+
+def test_inline_suppression(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+        q = asyncio.Queue()  # orlint: disable=OR004 — deliberate for test
+        q2 = asyncio.Queue()
+        """,
+        select={"OR004"},
+    )
+    assert len(res.findings) == 1 and len(res.suppressed) == 1
+    assert res.findings[0].line == 4  # q2; the suppressed q is line 3
+
+
+def test_file_level_suppression(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        # orlint: disable-file=OR004
+        import asyncio
+        q = asyncio.Queue()
+        q2 = asyncio.Queue()
+        """,
+        select={"OR004"},
+    )
+    assert not res.findings and len(res.suppressed) == 2
+
+
+def test_baseline_matches_and_stale_detection(tmp_path):
+    snippet = """
+    import asyncio
+    q = asyncio.Queue()
+    """
+    # discover the fingerprint, then baseline it
+    probe = lint_snippet(tmp_path, snippet, select={"OR004"})
+    fp = probe.findings[0].fingerprint
+    res = lint_snippet(
+        tmp_path,
+        snippet,
+        select={"OR004"},
+        baseline={"entries": [
+            {"fingerprint": fp, "justification": "known, migrating later"},
+            {"fingerprint": "OR004:gone.py:<module>:asyncio.Queue",
+             "justification": "stale"},
+        ]},
+    )
+    assert not res.findings
+    assert [j for _, j in res.baselined] == ["known, migrating later"]
+    assert res.stale_baseline == ["OR004:gone.py:<module>:asyncio.Queue"]
+    assert not res.ok  # stale entries fail the run
+
+
+def test_baseline_requires_justification(tmp_path):
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps(
+        {"entries": [{"fingerprint": "OR004:x", "justification": "  "}]}
+    ))
+    with pytest.raises(ValueError):
+        load_baseline(bp)
+
+
+# ------------------------------------------------------- whole-repo checks
+
+
+def test_known_bad_fixture_covers_every_rule():
+    """The ci.sh smoke lane contract: the known-bad fixture produces
+    exactly one finding per rule."""
+    res = run([KNOWN_BAD], root=REPO)
+    assert sorted(codes_of(res)) == sorted(ALL_CODES)
+
+
+def test_fixture_dirs_skipped_by_walker(tmp_path):
+    res = run(["tests/fixtures"], root=REPO)
+    assert res.files == 0  # fixtures only lint as explicit arguments
+
+
+def test_shipped_baseline_has_no_stale_entries_and_tree_is_clean():
+    """The acceptance gate: the real tree lints clean against the
+    shipped baseline (≤10 entries, each justified), with zero stale
+    entries."""
+    baseline = load_baseline(REPO / "tools/orlint/baseline.json")
+    assert len(baseline) <= 10
+    res = run(
+        ["openr_tpu", "tests", "benchmarks"],
+        root=REPO,
+        baseline_path=REPO / "tools/orlint/baseline.json",
+    )
+    assert res.stale_baseline == []
+    assert res.errors == []
+    assert not res.findings, "\n".join(
+        f"{f.path}:{f.line} {f.code} {f.message}" for f in res.findings
+    )

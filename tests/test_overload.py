@@ -15,6 +15,7 @@ import random
 import pytest
 
 from openr_tpu.common.backoff import ExponentialBackoff
+from openr_tpu.common.tasks import reap
 from openr_tpu.config import Config, NodeConfig
 from openr_tpu.messaging import (
     BLOCK,
@@ -33,7 +34,9 @@ from openr_tpu.types.kvstore import Publication, Value
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 # ------------------------------------------------------------ queue policies
@@ -532,11 +535,7 @@ def test_ctrl_slow_subscriber_evicts_oldest():
         assert [sorted(p["key_vals"]) for p in got] == [
             [f"k{i}"] for i in range(6, 10)
         ]
-        fan.cancel()
-        try:
-            await fan
-        except asyncio.CancelledError:
-            pass
+        await reap(fan)
 
     run(body())
 

@@ -231,7 +231,7 @@ def test_origination_policy_wired_through_config():
         assert na.counters.get("prefixmgr.policy_denied") == 1
         await c.stop()
 
-    asyncio.new_event_loop().run_until_complete(body())
+    asyncio.run(body())
 
 
 # ------------------------------------------------------------ route-maps

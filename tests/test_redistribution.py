@@ -27,7 +27,9 @@ from openr_tpu.types.topology import PrefixEntry, PrefixMetrics
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 class _RecordingKv:

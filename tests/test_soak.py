@@ -15,6 +15,11 @@ from types import SimpleNamespace
 
 import pytest
 
+# cluster-scale seeded storms: asyncio debug mode's per-task traceback
+# capture is a ~10x tax that blows the convergence budgets; the
+# sanitizer's leak checks stay fully active (tests/conftest.py)
+pytestmark = pytest.mark.asyncio_debug_off
+
 from openr_tpu.config import Config, NodeConfig
 from openr_tpu.emulator.invariants import check_queue_bounds
 from openr_tpu.emulator.soak import (
@@ -25,7 +30,9 @@ from openr_tpu.emulator.soak import (
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
 
 
 def grid_edges(n: int = 3) -> list[tuple[str, str]]:

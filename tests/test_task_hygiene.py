@@ -1,0 +1,422 @@
+"""Regression tests for the OR002/OR005 sweep: task guards, cancellation
+re-raise at every shutdown seam, and the asyncio sanitizer itself.
+
+Each test pins one concrete pre-PR bug:
+
+  * AsyncDebounce parked a crashed timer's exception on the replaced
+    Task (surfaced only at GC, caught by nothing) — now logged+counted;
+  * OpenrModule.stop / RpcServer.stop / RpcClient.close swallowed a
+    cancellation aimed at the CALLER (`except (CancelledError,
+    Exception)`), making graceful shutdown un-cancellable;
+  * KvStore.cleanup / Fib._warm_boot broad-excepts around awaits had no
+    explicit cancellation path;
+  * the sanitizer (tests/conftest.py) detects exactly the leak class
+    the pre-PR AsyncDebounce exhibited.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import logging
+
+import pytest
+
+from conftest import _SANITIZER
+from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.common.tasks import guard_task, reap
+from openr_tpu.common.throttle import AsyncDebounce
+from openr_tpu.monitor import Counters
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------ the sanitizer
+
+
+@pytest.mark.asyncio_sanitizer_off
+def test_sanitizer_catches_pre_pr_debounce_leak():
+    """The exact pre-PR AsyncDebounce pattern — a bare create_task whose
+    fn raises — produces a never-retrieved task exception that the
+    sanitizer records (and would fail the test without the opt-out
+    marker)."""
+
+    async def main():
+        async def boom():
+            raise RuntimeError("pre-PR debounce crash")
+
+        # pre-PR shape: retained on an attr, no done-callback; the
+        # reference is then dropped without ever being awaited — the
+        # deliberate OR002 violation this test exists to demonstrate
+        loop = asyncio.get_event_loop()
+        holder = loop.create_task(boom())  # orlint: disable=OR002
+        await asyncio.sleep(0.01)
+        assert holder.done()
+        del holder  # exception still parked on the Task
+
+    run(main())
+    gc.collect()
+    evidence = _SANITIZER.drain()
+    assert any("never retrieved" in e for e in evidence), evidence
+
+
+@pytest.mark.asyncio_sanitizer_off
+def test_sanitizer_catches_pending_task_on_closed_loop():
+    """A fiber nobody cancels or awaits is still pending when its loop
+    closes — the leak class `reap` exists to prevent."""
+    leaked = {}
+
+    async def main():
+        leaked["t"] = asyncio.get_event_loop().create_task(
+            asyncio.sleep(60)
+        )
+        await asyncio.sleep(0.01)
+
+    # run_until_complete without cleanup, as sloppy pre-PR helpers did
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(main())
+    finally:
+        loop.close()
+    evidence = _SANITIZER.drain()
+    assert any("pending on closed loop" in e for e in evidence), evidence
+    leaked.clear()
+    gc.collect()
+    _SANITIZER.drain()  # swallow the follow-on destroyed-pending event
+
+
+# ------------------------------------------------------- guard_task / reap
+
+
+def test_guarded_debounce_crash_is_logged_and_counted(caplog):
+    counters = Counters()
+
+    async def main():
+        async def boom():
+            raise RuntimeError("debounce fn crash")
+
+        d = AsyncDebounce(
+            min_ms=1, max_ms=5, fn=boom, owner="decision", counters=counters
+        )
+        with caplog.at_level(logging.ERROR, "openr_tpu.common.tasks"):
+            d.poke()
+            await asyncio.sleep(0.05)
+        # the replaced-task path: a second poke after the crash starts a
+        # fresh timer; the first task's exception was already retrieved
+        d.poke()
+        await asyncio.sleep(0.05)
+        d.cancel()
+
+    run(main())
+    gc.collect()
+    assert not _SANITIZER.drain()  # nothing parked, nothing leaked
+    assert counters.get("decision.task_exceptions") >= 1
+    assert any("crashed" in r.message for r in caplog.records)
+
+
+def test_reap_swallows_fiber_cancel_but_not_callers():
+    async def main():
+        async def stubborn():
+            try:
+                await asyncio.sleep(10)
+            except asyncio.CancelledError:
+                await asyncio.sleep(0.2)  # slow teardown
+                raise
+
+        # plain reap: swallows the fiber's own cancellation
+        t = asyncio.get_event_loop().create_task(stubborn())
+        await asyncio.sleep(0.01)
+        reaper = asyncio.get_event_loop().create_task(reap(t))
+        await asyncio.sleep(0.05)
+        # cancel the REAPER mid-await: must propagate, not be absorbed
+        reaper.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await reaper
+        assert reaper.cancelled()
+        await asyncio.sleep(0.3)  # let the stubborn fiber finish dying
+
+        # and a reap left alone completes quietly
+        t2 = asyncio.get_event_loop().create_task(stubborn())
+        await asyncio.sleep(0.01)
+        await reap(t2)
+        assert t2.cancelled()
+
+    run(main())
+
+
+def test_reap_retrieves_crashed_task_exception():
+    async def main():
+        async def boom():
+            raise ValueError("already dead")
+
+        t = guard_task(
+            asyncio.get_event_loop().create_task(boom()), owner="test"
+        )
+        await asyncio.sleep(0.01)
+        await reap(t)  # done-with-exception branch: retrieve, don't raise
+
+    run(main())
+    gc.collect()
+    assert not _SANITIZER.drain()
+
+
+# ------------------------------------------- module stop cancellation path
+
+
+def test_module_stop_is_cancellable():
+    """Pre-PR, OpenrModule.stop swallowed `CancelledError` from its own
+    cancellation while reaping fibers — a hung fiber teardown made node
+    shutdown un-interruptible."""
+
+    async def main():
+        class M(OpenrModule):
+            async def main(self):
+                self.spawn(self._stubborn(), name="m.stubborn")
+
+            async def _stubborn(self):
+                try:
+                    await asyncio.sleep(10)
+                except asyncio.CancelledError:
+                    await asyncio.sleep(0.2)  # slow teardown
+                    raise
+
+        m = M("m")
+        await m.start()
+        await asyncio.sleep(0.01)
+        stopper = asyncio.get_event_loop().create_task(m.stop())
+        await asyncio.sleep(0.05)  # stop() is now awaiting the fiber
+        stopper.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await stopper
+        assert stopper.cancelled(), "stop() absorbed its own cancellation"
+        await asyncio.sleep(0.3)  # fiber finishes dying on its own
+
+    run(main())
+
+
+def test_module_stop_still_reaps_crashed_fibers():
+    """The Exception arm of stop() still swallows fiber crashes (they
+    were already logged by _guard) — reaping must finish."""
+
+    async def main():
+        class M(OpenrModule):
+            async def main(self):
+                self.spawn(self._boom(), name="m.boom")
+
+            async def _boom(self):
+                raise RuntimeError("fiber crash")
+
+        m = M("m", counters=Counters())
+        await m.start()
+        await asyncio.sleep(0.02)
+        await m.stop()  # must not raise
+        assert m.counters.get("m.fiber_crashes") == 1
+
+    run(main())
+
+
+# --------------------------------------------------- per-seam cancel tests
+
+
+def test_kvstore_cleanup_reraises_cancellation():
+    from openr_tpu.config import Config
+    from openr_tpu.kvstore.kvstore import KvStore, _Peer, PeerSpec
+    from openr_tpu.kvstore.transport import InProcKvTransport
+    from openr_tpu.messaging import ReplicateQueue
+
+    async def main():
+        transport = InProcKvTransport()
+        store = KvStore(
+            Config.default("a"), transport, ReplicateQueue(name="pubs")
+        )
+
+        class HangingSession:
+            async def close(self):
+                await asyncio.sleep(10)
+
+        peer = _Peer(PeerSpec(node_name="b", area="0"), owner="a")
+        peer.session = HangingSession()
+        store.peers[("0", "b")] = peer
+        cleaner = asyncio.get_event_loop().create_task(store.cleanup())
+        await asyncio.sleep(0.05)
+        cleaner.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await cleaner
+        assert cleaner.cancelled(), "cleanup swallowed its cancellation"
+
+    run(main())
+
+
+def test_fib_warm_boot_reraises_cancellation():
+    from openr_tpu.config import Config, NodeConfig
+    from openr_tpu.fib.fib import Fib, MockFibHandler
+    from openr_tpu.messaging import ReplicateQueue
+
+    async def main():
+        class HangingHandler(MockFibHandler):
+            async def get_route_table_by_client(self, client_id):
+                await asyncio.sleep(10)
+
+        fib = Fib(
+            Config(NodeConfig(node_name="x")),
+            ReplicateQueue(name="routes").get_reader(),
+            HangingHandler(),
+        )
+        boot = asyncio.get_event_loop().create_task(fib._warm_boot())
+        await asyncio.sleep(0.05)
+        boot.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await boot
+        assert boot.cancelled(), "_warm_boot swallowed its cancellation"
+
+    run(main())
+
+
+def test_rpc_abandoned_stream_does_not_stall_client():
+    """A consumer that stops iterating a subscription early must not
+    wedge the rx loop at the stream queue's bound: the generator's
+    cleanup closes + deregisters the queue, and later call()s on the
+    same client still get replies even while the server keeps pushing
+    to the dead stream."""
+    from openr_tpu.rpc import RpcClient, RpcServer
+    from openr_tpu.rpc.core import STREAM_BUF
+
+    async def main():
+        server = RpcServer(name="s")
+        pushed = {"n": 0}
+
+        async def flood(params, stream):
+            # keep pushing well past the client-side bound
+            for i in range(STREAM_BUF + 64):
+                await stream.send({"i": i})
+                pushed["n"] = i + 1
+
+        async def ping(params):
+            return {"ok": True}
+
+        server.register_stream("flood", flood)
+        server.register("ping", ping)
+        port = await server.start("127.0.0.1", 0)
+        cli = RpcClient("127.0.0.1", port)
+        await cli.connect()
+        stream = await cli.subscribe("flood")
+        got = 0
+        async for _item in stream:
+            got += 1
+            if got >= 3:
+                break  # abandon the stream mid-flood
+        await stream.aclose()
+        # the rx loop must still serve plain calls promptly
+        assert (await cli.call("ping", timeout=10.0)) == {"ok": True}
+        assert cli._streams == {}  # deregistered by gen cleanup
+        await cli.close()
+        await server.stop()
+
+    run(main())
+    gc.collect()
+    assert not _SANITIZER.drain()
+
+
+def test_rpc_never_iterated_stream_times_out_not_stalls():
+    """A subscription whose generator is never even started has no
+    cleanup path (a GEN_CREATED async generator runs no body code on
+    close) — the rx loop's stall timeout must break that stream instead
+    of blocking every other reply forever."""
+    import openr_tpu.rpc.core as rpc_core
+    from openr_tpu.rpc import RpcClient, RpcServer
+
+    async def main(monkey_stall):
+        old_buf, old_stall = rpc_core.STREAM_BUF, rpc_core.STREAM_STALL_S
+        rpc_core.STREAM_BUF, rpc_core.STREAM_STALL_S = 4, monkey_stall
+        try:
+            server = RpcServer(name="s")
+
+            async def flood(params, stream):
+                for i in range(64):
+                    await stream.send({"i": i})
+
+            async def ping(params):
+                return {"ok": True}
+
+            server.register_stream("flood", flood)
+            server.register("ping", ping)
+            port = await server.start("127.0.0.1", 0)
+            cli = RpcClient("127.0.0.1", port)
+            await cli.connect()
+            abandoned = await cli.subscribe("flood")  # never iterated
+            # rx fills the 4-slot buffer, stalls, then breaks the stream
+            assert (await cli.call("ping", timeout=10.0)) == {"ok": True}
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while cli._streams and asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+            assert cli._streams == {}
+            # and plain calls still work after the break
+            assert (await cli.call("ping", timeout=10.0)) == {"ok": True}
+            # a late attempt to read the broken stream errors promptly
+            with pytest.raises(Exception):
+                async for _ in abandoned:
+                    pass
+            await cli.close()
+            await server.stop()
+        finally:
+            rpc_core.STREAM_BUF, rpc_core.STREAM_STALL_S = old_buf, old_stall
+
+    run(main(0.2))
+    gc.collect()
+    assert not _SANITIZER.drain()
+
+
+def test_rpc_client_survives_non_utf8_frame():
+    """Client-side symmetry of the server garbage-frame fix: a non-UTF-8
+    line from a corrupt server takes the clean connection-lost path, not
+    an rx-task crash."""
+    from openr_tpu.rpc import RpcClient
+    from openr_tpu.rpc.core import RpcError
+
+    async def main():
+        async def evil(reader, writer):
+            writer.write(b"\xff\xfe\x00garbage\n")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(evil, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        cli = RpcClient("127.0.0.1", port)
+        await cli.connect()
+        with pytest.raises(RpcError):
+            await cli.call("ping", timeout=5.0)
+        await cli.close()
+        server.close()
+        await server.wait_closed()
+
+    run(main())
+    gc.collect()
+    assert not _SANITIZER.drain()
+
+
+def test_rpc_client_close_is_cancellable_and_clean():
+    """close() reaps the rx task; a cancellation aimed at close() itself
+    propagates. Also: the guarded rx task leaves nothing for the
+    sanitizer."""
+    from openr_tpu.rpc import RpcClient, RpcServer
+
+    async def main():
+        server = RpcServer(name="s")
+
+        async def slow(params):
+            await asyncio.sleep(0.01)
+            return {"ok": True}
+
+        server.register("slow", slow)
+        port = await server.start("127.0.0.1", 0)
+        cli = RpcClient("127.0.0.1", port)
+        await cli.connect()
+        assert (await cli.call("slow")) == {"ok": True}
+        await cli.close()
+        await server.stop()
+
+    run(main())
+    gc.collect()
+    assert not _SANITIZER.drain()

@@ -1,0 +1,127 @@
+"""orlint: project-specific AST lint suite for openr_tpu.
+
+The stack leans on invariants nothing in a generic linter enforces:
+hot paths must stay deterministic (seeded chaos/soak replay), shared
+module state must not be mutated across ``await`` points mid-rebuild,
+and every inter-module queue must go through the bounded ``messaging/``
+seams. orlint turns those review-time contracts into CI-enforced rules
+(docs/Linting.md has the full catalog and the policy for suppressions).
+
+Architecture:
+
+  * :mod:`tools.orlint.engine` — file discovery, parsing, suppression
+    and baseline handling; produces :class:`Finding` objects.
+  * :mod:`tools.orlint.rules` — one module per rule (``or001_*.py`` …),
+    auto-discovered; each exports a :class:`Rule` subclass.
+  * :mod:`tools.orlint.reporters` — text and JSON output.
+
+Suppressions: append ``# orlint: disable=OR003`` (comma-separated codes
+or ``all``) to the flagged line, or put ``# orlint: disable-file=OR004``
+in the file's first ten lines. Known-deliberate findings that span
+refactors live in ``tools/orlint/baseline.json`` — every entry carries a
+one-line justification and stale entries fail the run.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location.
+
+    ``fingerprint`` is the stable identity used by suppression baselines:
+    ``<code>:<path>:<scope>:<subject>`` — no line numbers, so entries
+    survive unrelated churn in the same file.
+    """
+
+    code: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    fingerprint: str
+
+    def to_jsonable(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+@dataclass
+class ModuleCtx:
+    """Everything a rule needs about one parsed source file."""
+
+    path: str  # repo-relative posix path
+    tree: ast.Module
+    source: str
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    def part_set(self) -> set[str]:
+        """Path components (sans .py) — rules scope themselves by
+        subsystem directory (``decision``, ``kvstore`` …)."""
+        parts = self.path.split("/")
+        if parts and parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][: -len(".py")]
+        return set(parts)
+
+
+class Rule:
+    """Base class for orlint rules.
+
+    Subclasses set ``code``/``name``/``description`` and override
+    :meth:`check` (per-file) and/or :meth:`finalize` (whole-project pass
+    that runs once after every file was checked).
+    """
+
+    code: str = "OR000"
+    name: str = "base"
+    description: str = ""
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, ctxs: list[ModuleCtx], root: str) -> Iterable[Finding]:
+        return ()
+
+    # ------------------------------------------------------------ helpers
+
+    def finding(
+        self,
+        ctx: ModuleCtx | None,
+        node: ast.AST | None,
+        message: str,
+        scope: str = "<module>",
+        subject: str = "",
+        path: str = "",
+    ) -> Finding:
+        p = ctx.path if ctx is not None else path
+        return Finding(
+            code=self.code,
+            path=p,
+            line=getattr(node, "lineno", 0) if node is not None else 0,
+            col=getattr(node, "col_offset", 0) if node is not None else 0,
+            message=message,
+            fingerprint=f"{self.code}:{p}:{scope}:{subject}",
+        )
+
+
+def iter_rules() -> Iterator[Rule]:
+    """Instantiate every registered rule (auto-discovered from
+    :mod:`tools.orlint.rules`)."""
+    from tools.orlint.rules import all_rules
+
+    for cls in all_rules():
+        yield cls()

@@ -1,0 +1,97 @@
+"""CLI: ``python -m tools.orlint openr_tpu tests benchmarks``.
+
+Exit status: 0 clean (baselined/suppressed findings allowed), 1 when
+actionable findings, stale baseline entries, or parse errors remain,
+2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from tools.orlint import iter_rules
+from tools.orlint.engine import run
+from tools.orlint.reporters import render_json, render_text
+
+DEFAULT_BASELINE = "tools/orlint/baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="orlint", description="openr_tpu project lint suite"
+    )
+    ap.add_argument("paths", nargs="*", default=["openr_tpu"])
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file (known-deliberate findings with justifications)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings (justifications "
+        "start as TODO and MUST be filled in)",
+    )
+    ap.add_argument(
+        "--select", help="comma-separated rule codes to run (default: all)"
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in iter_rules():
+            print(f"{r.code} {r.name}: {r.description}")
+        return 0
+
+    root = pathlib.Path.cwd()
+    baseline = None if args.no_baseline else root / args.baseline
+    select = (
+        {c.strip().upper() for c in args.select.split(",")}
+        if args.select
+        else None
+    )
+    try:
+        res = run(args.paths or ["openr_tpu"], root, baseline, select)
+    except ValueError as e:
+        print(f"orlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        existing: dict[str, str] = {}
+        bp = root / args.baseline
+        if bp.exists():
+            for e in json.loads(bp.read_text()).get("entries", []):
+                existing[e["fingerprint"]] = e["justification"]
+        entries = [
+            {
+                "fingerprint": f.fingerprint,
+                "justification": existing.get(f.fingerprint, "TODO"),
+            }
+            for f in res.findings
+        ] + [
+            {"fingerprint": f.fingerprint, "justification": just}
+            for f, just in res.baselined
+        ]
+        entries.sort(key=lambda e: e["fingerprint"])
+        bp.write_text(json.dumps({"entries": entries}, indent=2) + "\n")
+        print(f"wrote {len(entries)} baseline entr"
+              f"{'y' if len(entries) == 1 else 'ies'} to {bp}")
+        return 0
+
+    print(render_text(res, args.verbose) if args.format == "text"
+          else render_json(res))
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

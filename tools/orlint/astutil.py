@@ -1,0 +1,136 @@
+"""Shared AST analysis helpers for orlint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_async_functions(
+    tree: ast.Module,
+) -> Iterator[tuple[ast.AsyncFunctionDef, str]]:
+    """Yield every ``async def`` with its dotted qualname. Nested
+    functions are yielded separately; a rule analysing one async
+    function must not descend into defs nested inside it (use
+    :func:`walk_in_scope`)."""
+
+    def rec(node: ast.AST, prefix: str) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                if isinstance(child, ast.AsyncFunctionDef):
+                    yield child, qn
+                yield from rec(child, f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.")
+            else:
+                yield from rec(child, prefix)
+
+    yield from rec(tree, "")
+
+
+def walk_in_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function or
+    class definitions (their bodies run in a different execution
+    context, e.g. a sync closure inside a coroutine)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue  # different scope
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def scope_has_awaits(node: ast.AST) -> bool:
+    """True when the try body / block contains an await point (await,
+    async for, async with) in the CURRENT scope."""
+    for n in walk_in_scope(node):
+        if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+    return isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+
+
+def block_has_awaits(stmts: list[ast.stmt]) -> bool:
+    for s in stmts:
+        if scope_has_awaits(s):
+            return True
+    return False
+
+
+def handler_reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body (current scope) contains a bare
+    ``raise`` or re-raises its bound exception name."""
+    bound = handler.name
+    for n in walk_in_scope(handler):
+        if isinstance(n, ast.Raise):
+            if n.exc is None:
+                return True
+            if (
+                bound
+                and isinstance(n.exc, ast.Name)
+                and n.exc.id == bound
+            ):
+                return True
+    return False
+
+
+def exception_types(handler: ast.ExceptHandler) -> list[str]:
+    """Dotted names of the caught exception types; [] for bare except."""
+    t = handler.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        dn = dotted_name(e)
+        if dn is not None:
+            out.append(dn)
+    return out
+
+
+def is_cancelled_name(dn: str) -> bool:
+    return dn in (
+        "CancelledError",
+        "asyncio.CancelledError",
+        "asyncio.exceptions.CancelledError",
+        "concurrent.futures.CancelledError",
+    )
+
+
+def normalized_fstring(node: ast.JoinedStr) -> str:
+    """Render an f-string with every interpolation replaced by ``*`` —
+    the template form matched against the name registry."""
+    out = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            out.append(str(v.value))
+        else:
+            out.append("*")
+    return "".join(out)
+
+
+def str_or_template(node: ast.AST) -> tuple[str, bool] | None:
+    """(value, is_template) for a string literal or f-string; None for
+    anything dynamic (plain Name, call result, …)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):
+        return normalized_fstring(node), True
+    return None

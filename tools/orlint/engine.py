@@ -1,0 +1,164 @@
+"""orlint engine: discovery, suppression, baseline, orchestration."""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+from tools.orlint import Finding, ModuleCtx, iter_rules
+
+# directories never walked (explicit file arguments bypass this)
+SKIP_DIRS = frozenset(
+    {"__pycache__", ".git", "node_modules", "fixtures", ".claude"}
+)
+
+_INLINE_RE = re.compile(r"#\s*orlint:\s*disable=([A-Za-z0-9,\s]+)")
+_FILE_RE = re.compile(r"#\s*orlint:\s*disable-file=([A-Za-z0-9,\s]+)")
+FILE_DIRECTIVE_LINES = 10  # disable-file must sit near the top
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)  # actionable
+    suppressed: list[Finding] = field(default_factory=list)  # inline/file
+    baselined: list[tuple[Finding, str]] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+    files: int = 0
+    errors: list[str] = field(default_factory=list)  # parse failures
+
+    @property
+    def ok(self) -> bool:
+        return not (self.findings or self.stale_baseline or self.errors)
+
+
+def discover(paths: list[str], root: pathlib.Path) -> list[pathlib.Path]:
+    """Python files under the given paths; directories are walked with
+    SKIP_DIRS pruned, explicit .py file arguments are always included
+    (that's how the ci smoke lane lints a known-bad fixture)."""
+    out: list[pathlib.Path] = []
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if not p.is_absolute():
+            p = root / raw
+        if p.is_file():
+            out.append(p)
+            continue
+        for f in sorted(p.rglob("*.py")):
+            # skip-dirs are judged on the repo-relative path, so walking
+            # tests/fixtures directly is still pruned — only an explicit
+            # FILE argument lints a fixture
+            try:
+                parts = f.resolve().relative_to(root.resolve()).parts
+            except ValueError:
+                parts = f.relative_to(p).parts
+            if any(part in SKIP_DIRS for part in parts):
+                continue
+            out.append(f)
+    # stable order, no duplicates
+    seen: set[pathlib.Path] = set()
+    uniq = []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return uniq
+
+
+def _codes(match_text: str) -> set[str]:
+    return {c.strip().upper() for c in match_text.split(",") if c.strip()}
+
+
+def _suppressions(source: str) -> tuple[set[str], dict[int, set[str]]]:
+    """(file-level codes, {line: codes}) from orlint comments."""
+    file_codes: set[str] = set()
+    line_codes: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _INLINE_RE.search(line)
+        if m:
+            line_codes[i] = _codes(m.group(1))
+        fm = _FILE_RE.search(line)
+        if fm and i <= FILE_DIRECTIVE_LINES:
+            file_codes |= _codes(fm.group(1))
+    return file_codes, line_codes
+
+
+def _is_suppressed(
+    f: Finding, file_codes: set[str], line_codes: dict[int, set[str]]
+) -> bool:
+    def hit(codes: set[str]) -> bool:
+        return f.code in codes or "ALL" in codes
+
+    if hit(file_codes):
+        return True
+    codes = line_codes.get(f.line)
+    return codes is not None and hit(codes)
+
+
+def load_baseline(path: pathlib.Path) -> dict[str, str]:
+    """{fingerprint: justification}; every entry MUST carry a non-empty
+    justification (the ≤10-entries acceptance bar is reviewed, not
+    enforced here — docs/Linting.md)."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    out: dict[str, str] = {}
+    for e in data.get("entries", []):
+        fp, just = e.get("fingerprint", ""), e.get("justification", "")
+        if not fp or not just.strip():
+            raise ValueError(
+                f"baseline entry missing fingerprint/justification: {e}"
+            )
+        out[fp] = just
+    return out
+
+
+def run(
+    paths: list[str],
+    root: pathlib.Path | None = None,
+    baseline_path: pathlib.Path | None = None,
+    select: set[str] | None = None,
+) -> RunResult:
+    root = root or pathlib.Path.cwd()
+    res = RunResult()
+    rules = [r for r in iter_rules() if select is None or r.code in select]
+    ctxs: list[ModuleCtx] = []
+    sup: dict[str, tuple[set[str], dict[int, set[str]]]] = {}
+    for f in discover(paths, root):
+        res.files += 1
+        try:
+            src = f.read_text()
+            tree = ast.parse(src)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            res.errors.append(f"{f}: {e}")
+            continue
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        ctxs.append(ModuleCtx(path=rel, tree=tree, source=src))
+        sup[rel] = _suppressions(src)
+
+    raw: list[Finding] = []
+    for rule in rules:
+        for ctx in ctxs:
+            raw.extend(rule.check(ctx))
+        raw.extend(rule.finalize(ctxs, str(root)))
+
+    baseline = (
+        load_baseline(baseline_path) if baseline_path is not None else {}
+    )
+    matched_fps: set[str] = set()
+    for f in sorted(raw, key=lambda x: (x.path, x.line, x.code)):
+        file_codes, line_codes = sup.get(f.path, (set(), {}))
+        if _is_suppressed(f, file_codes, line_codes):
+            res.suppressed.append(f)
+        elif f.fingerprint in baseline:
+            matched_fps.add(f.fingerprint)
+            res.baselined.append((f, baseline[f.fingerprint]))
+        else:
+            res.findings.append(f)
+    res.stale_baseline = sorted(set(baseline) - matched_fps)
+    return res

@@ -1,0 +1,52 @@
+"""Text and JSON reporters for orlint results."""
+
+from __future__ import annotations
+
+import json
+
+from tools.orlint.engine import RunResult
+
+
+def render_text(res: RunResult, verbose: bool = False) -> str:
+    lines: list[str] = []
+    for f in res.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.code} {f.message}")
+    for e in res.errors:
+        lines.append(f"error: {e}")
+    for fp in res.stale_baseline:
+        lines.append(
+            f"stale baseline entry (no longer matches any finding — "
+            f"delete it): {fp}"
+        )
+    if verbose:
+        for f, just in res.baselined:
+            lines.append(
+                f"baselined: {f.path}:{f.line} {f.code} [{just}]"
+            )
+        for f in res.suppressed:
+            lines.append(f"suppressed: {f.path}:{f.line} {f.code}")
+    lines.append(
+        f"orlint: {res.files} file(s), {len(res.findings)} finding(s), "
+        f"{len(res.suppressed)} suppressed, {len(res.baselined)} "
+        f"baselined, {len(res.stale_baseline)} stale baseline entr"
+        f"{'y' if len(res.stale_baseline) == 1 else 'ies'}"
+    )
+    return "\n".join(lines)
+
+
+def render_json(res: RunResult) -> str:
+    return json.dumps(
+        {
+            "ok": res.ok,
+            "files": res.files,
+            "findings": [f.to_jsonable() for f in res.findings],
+            "suppressed": [f.to_jsonable() for f in res.suppressed],
+            "baselined": [
+                {**f.to_jsonable(), "justification": just}
+                for f, just in res.baselined
+            ],
+            "stale_baseline": res.stale_baseline,
+            "errors": res.errors,
+        },
+        indent=2,
+    )

@@ -1,0 +1,37 @@
+"""Rule registry: every ``orNNN_*.py`` module in this package must
+export exactly one :class:`tools.orlint.Rule` subclass. Deleting a rule
+module makes the orlint self-tests fail (tests/test_orlint.py asserts
+the full catalog is loadable)."""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+
+from tools.orlint import Rule
+
+
+def all_rules() -> list[type[Rule]]:
+    """Discover rule classes from ``or*.py`` modules, sorted by code."""
+    out: list[type[Rule]] = []
+    for info in pkgutil.iter_modules(__path__):
+        if not info.name.startswith("or"):
+            continue
+        mod = importlib.import_module(f"{__name__}.{info.name}")
+        found = [
+            obj
+            for obj in vars(mod).values()
+            if isinstance(obj, type)
+            and issubclass(obj, Rule)
+            and obj is not Rule
+            and obj.__module__ == mod.__name__
+        ]
+        assert len(found) == 1, (
+            f"rule module {info.name} must export exactly one Rule "
+            f"subclass, found {len(found)}"
+        )
+        out.append(found[0])
+    out.sort(key=lambda c: c.code)
+    codes = [c.code for c in out]
+    assert len(codes) == len(set(codes)), f"duplicate rule codes: {codes}"
+    return out

@@ -1,0 +1,94 @@
+"""OR001: blocking call inside ``async def``.
+
+A synchronous sleep, subprocess, or blocking file/socket call inside a
+coroutine stalls the whole event loop — every module shares one loop
+here (messaging seams, Spark timers, KvStore flood pumps), so one
+blocked coroutine freezes the node. Use ``await asyncio.sleep``,
+``asyncio.to_thread``, or the async transport seams instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import (
+    dotted_name,
+    iter_async_functions,
+    walk_in_scope,
+)
+
+# dotted call targets that always block the loop
+BLOCKING_CALLS = frozenset(
+    {
+        "time.sleep",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "subprocess.Popen",
+        "os.system",
+        "os.popen",
+        "os.waitpid",
+        "socket.create_connection",
+        "socket.getaddrinfo",
+        "urllib.request.urlopen",
+        "requests.get",
+        "requests.post",
+        "requests.request",
+    }
+)
+
+# attribute method names that are blocking file I/O wherever they appear
+# (pathlib.Path and file objects; cheap metadata reads are allowed)
+BLOCKING_METHODS = frozenset(
+    {"read_text", "write_text", "read_bytes", "write_bytes"}
+)
+
+
+class BlockingCallRule(Rule):
+    code = "OR001"
+    name = "blocking-call"
+    description = (
+        "blocking call (time.sleep, subprocess, sync I/O) in async def"
+    )
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        for fn, qn in iter_async_functions(ctx.tree):
+            for node in walk_in_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func)
+                if dn in BLOCKING_CALLS:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"blocking call {dn}() inside async def {qn} — "
+                        f"use the async equivalent or asyncio.to_thread",
+                        scope=qn,
+                        subject=dn,
+                    )
+                    continue
+                if isinstance(node.func, ast.Name) and node.func.id == "open":
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"blocking open() inside async def {qn} — wrap the"
+                        f" file work in asyncio.to_thread",
+                        scope=qn,
+                        subject="open",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in BLOCKING_METHODS
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"blocking file I/O .{node.func.attr}() inside "
+                        f"async def {qn} — wrap in asyncio.to_thread",
+                        scope=qn,
+                        subject=node.func.attr,
+                    )

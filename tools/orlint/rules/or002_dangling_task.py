@@ -1,0 +1,179 @@
+"""OR002: dangling task — ``create_task``/``ensure_future`` whose
+result is neither retained, awaited, nor given a done-callback.
+
+A fire-and-forget task that raises has its exception silently parked on
+the Task object; it surfaces only as a GC-time "exception was never
+retrieved" log line, long after the state it corrupted mattered (the
+asyncio sanitizer in tests/conftest.py fails tests on exactly that).
+Retain the task AND attach a done-callback that logs + counts (see
+``openr_tpu.common.tasks.guard_task``), or use ``OpenrModule.spawn``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name, walk_in_scope
+
+SPAWN_ATTRS = ("create_task", "ensure_future")
+
+
+def _is_spawn_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr in SPAWN_ATTRS:
+        return True
+    if isinstance(node.func, ast.Name) and node.func.id in SPAWN_ATTRS:
+        return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _name_is_consumed(fn: ast.AST, name: str, assign: ast.AST) -> bool:
+    """True when ``name`` (bound to a task in ``assign``) is awaited,
+    given a done-callback, or otherwise consumed in the same function."""
+    for n in walk_in_scope(fn):
+        if n is assign:
+            continue
+        if isinstance(n, ast.Await) and (
+            isinstance(n.value, ast.Name) and n.value.id == name
+        ):
+            return True
+        if isinstance(n, ast.Call):
+            f = n.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "add_done_callback"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == name
+            ):
+                return True
+            # passed onward (gather, tracking set, helper): retained
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+        if isinstance(n, ast.Return) and isinstance(n.value, ast.Name):
+            if n.value.id == name:
+                return True
+    return False
+
+
+def _attr_is_consumed(cls: ast.ClassDef, attr: str) -> bool:
+    """True when ``self.<attr>`` is awaited or given a done-callback
+    anywhere in the class (cross-method retention, e.g. assigned in
+    start() and awaited in stop())."""
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Await) and _self_attr(n.value) == attr:
+            return True
+        if isinstance(n, ast.Call):
+            f = n.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "add_done_callback"
+                and _self_attr(f.value) == attr
+            ):
+                return True
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if _self_attr(arg) == attr:
+                    return True
+    return False
+
+
+class DanglingTaskRule(Rule):
+    code = "OR002"
+    name = "dangling-task"
+    description = (
+        "create_task result neither retained, awaited, nor done-callbacked"
+    )
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        # parent links for classification
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def enclosing(node: ast.AST, kinds) -> ast.AST | None:
+            cur = parents.get(node)
+            while cur is not None and not isinstance(cur, kinds):
+                cur = parents.get(cur)
+            return cur
+
+        for node in ast.walk(ctx.tree):
+            if not _is_spawn_call(node):
+                continue
+            dn = dotted_name(node.func) or getattr(
+                node.func, "attr", "create_task"
+            )
+            fn = enclosing(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+            )
+            qn = getattr(fn, "name", "<module>")
+            parent = parents.get(node)
+            # task = await? or consumed inline
+            if isinstance(parent, ast.Await):
+                continue
+            if isinstance(parent, ast.Expr):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}(...) result discarded in {qn} — retain the task"
+                    f" and attach a logging done-callback (guard_task)",
+                    scope=qn,
+                    subject=dn,
+                )
+                continue
+            if isinstance(parent, ast.Call):
+                # argument to append/add/gather/guard_task…: retained
+                continue
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                tgt = parent.targets[0]
+                if isinstance(tgt, ast.Name):
+                    if tgt.id == "_":
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"{dn}(...) assigned to _ in {qn} — the task is"
+                            f" not really retained; use guard_task",
+                            scope=qn,
+                            subject=dn,
+                        )
+                    elif fn is not None and not _name_is_consumed(
+                        fn, tgt.id, parent
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"task {tgt.id!r} from {dn}(...) in {qn} is never"
+                            f" awaited nor given a done-callback — its"
+                            f" exceptions vanish; use guard_task",
+                            scope=qn,
+                            subject=f"{dn}:{tgt.id}",
+                        )
+                    continue
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    cls = enclosing(node, (ast.ClassDef,))
+                    if cls is None or not _attr_is_consumed(cls, attr):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"task self.{attr} from {dn}(...) in {qn} is"
+                            f" never awaited nor given a done-callback"
+                            f" anywhere in the class — its exceptions"
+                            f" vanish; use guard_task",
+                            scope=qn,
+                            subject=f"{dn}:self.{attr}",
+                        )
+                    continue

@@ -1,0 +1,140 @@
+"""OR003: await-point atomicity — read-modify-write of the same
+``self.<attr>`` split across an ``await``.
+
+Decision/KvStore/Fib mutate rebuild state (pending publication maps,
+dirt sets, cached artifacts) from multiple coroutines on one loop. A
+value read before an ``await`` and written back after it clobbers every
+update that landed during the suspension — the dataflow-consistency
+TOCTOU class DeltaPath identifies as the hard part of incremental
+routing. Re-read the attribute after the await (and fold, not assign),
+or restructure so the read-modify-write has no await inside it.
+
+Scope: files under ``decision/``, ``kvstore/``, ``fib/``.
+
+Detection is a linear source-order scan per coroutine: loads of
+``self.<attr>`` taint the local names they're assigned to; a store to
+``self.<attr>`` whose RHS uses a value tainted by the same attr from
+BEFORE an intervening await is flagged. A store whose RHS re-reads
+``self.<attr>`` directly in the same statement is atomic and passes —
+unless that same statement also awaits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import iter_async_functions, walk_in_scope
+
+SCOPE_DIRS = ("decision", "kvstore", "fib")
+
+
+def _pos(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+def _self_loads(expr: ast.AST) -> set[str]:
+    """Attrs of ``self.<attr>`` loads within one expression."""
+    out: set[str] = set()
+    for n in ast.walk(expr):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.ctx, ast.Load)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+        ):
+            out.add(n.attr)
+    return out
+
+
+def _names_loaded(expr: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _has_await(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Await) for n in ast.walk(expr))
+
+
+class AwaitAtomicityRule(Rule):
+    code = "OR003"
+    name = "await-atomicity"
+    description = "self.<attr> read-modify-write split across an await"
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if not (ctx.part_set() & set(SCOPE_DIRS)):
+            return
+        for fn, qn in iter_async_functions(ctx.tree):
+            yield from self._check_fn(ctx, fn, qn)
+
+    def _check_fn(self, ctx, fn, qn) -> Iterable[Finding]:
+        # ordered event stream: (pos, kind, payload)
+        events: list[tuple[tuple[int, int], str, object]] = []
+        for node in walk_in_scope(fn):
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                events.append((_pos(node), "await", None))
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+                values = [node.value] * len(targets)
+                # pairwise tuple unpack: (a, self.x) = (expr1, expr2)
+                if (
+                    len(targets) == 1
+                    and isinstance(targets[0], ast.Tuple)
+                    and isinstance(node.value, ast.Tuple)
+                    and len(targets[0].elts) == len(node.value.elts)
+                ):
+                    targets = targets[0].elts
+                    values = node.value.elts
+                for tgt, val in zip(targets, values):
+                    events.append((_pos(node), "assign", (tgt, val, node)))
+            elif isinstance(node, ast.AugAssign):
+                events.append(
+                    (_pos(node), "assign", (node.target, node.value, node))
+                )
+        events.sort(key=lambda e: e[0])
+
+        # taint[name] = {(attr, pos_of_load)}; await positions seen so far
+        taint: dict[str, set[tuple[str, tuple[int, int]]]] = {}
+        awaits: list[tuple[int, int]] = []
+        for pos, kind, payload in events:
+            if kind == "await":
+                awaits.append(pos)
+                continue
+            tgt, val, stmt = payload  # type: ignore[misc]
+            sources: set[tuple[str, tuple[int, int]]] = set()
+            for attr in _self_loads(val):
+                sources.add((attr, pos))  # direct read, same statement
+            for name in _names_loaded(val):
+                sources |= taint.get(name, set())
+            if isinstance(tgt, ast.Name):
+                taint[tgt.id] = {(a, p) for a, p in sources} or set()
+                continue
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            attr = tgt.attr
+            stmt_awaits = _has_await(val)
+            for src_attr, src_pos in sources:
+                if src_attr != attr:
+                    continue
+                stale = any(src_pos < ap <= pos for ap in awaits if ap != pos)
+                same_stmt_toctou = src_pos == pos and stmt_awaits
+                if stale and src_pos < pos or same_stmt_toctou:
+                    yield self.finding(
+                        ctx,
+                        stmt,
+                        f"self.{attr} is written in {qn} from a value read"
+                        f" before an await — updates landing during the"
+                        f" suspension are clobbered; re-read and fold"
+                        f" after the await",
+                        scope=qn,
+                        subject=attr,
+                    )
+                    break

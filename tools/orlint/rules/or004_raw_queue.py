@@ -1,0 +1,53 @@
+"""OR004: raw ``asyncio.Queue`` constructed outside ``messaging/``.
+
+Every inter-module queue must go through the bounded, policy-carrying
+``openr_tpu.messaging`` seams (RQueue / ReplicateQueue): they export
+``queue.<name>.depth``/``highwater`` gauges the soak's bounded-depth
+invariant walks, and their overflow policies (block / coalesce /
+shed_oldest) are the overload-control design of record. A raw
+``asyncio.Queue`` is invisible to all of that — unbounded by default,
+uncounted always.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name
+
+RAW_QUEUES = frozenset(
+    {
+        "asyncio.Queue",
+        "asyncio.PriorityQueue",
+        "asyncio.LifoQueue",
+        "asyncio.queues.Queue",
+        "queue.Queue",
+        "queue.SimpleQueue",
+        "multiprocessing.Queue",
+    }
+)
+EXEMPT_DIR = "messaging"
+
+
+class RawQueueRule(Rule):
+    code = "OR004"
+    name = "raw-queue"
+    description = "asyncio.Queue constructed outside the messaging/ seams"
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if EXEMPT_DIR in ctx.part_set():
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn in RAW_QUEUES:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"raw {dn}() constructed outside messaging/ — use"
+                    f" RQueue/ReplicateQueue (bounded, gauged, policied)",
+                    subject=dn,
+                )

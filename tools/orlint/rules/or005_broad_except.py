@@ -1,0 +1,95 @@
+"""OR005: broad except in a coroutine that doesn't re-raise
+``CancelledError``.
+
+Graceful shutdown cancels every module fiber and AWAITS it; a coroutine
+that swallows the cancellation keeps running (or exits "cleanly" with
+half-finished state) and stop() hangs or lies. Flagged:
+
+  * bare ``except:`` / ``except BaseException:`` — swallow everything;
+  * ``except (..., asyncio.CancelledError, ...)`` — swallows the
+    cancellation explicitly;
+  * ``except Exception:`` around an await point with no preceding
+    ``except asyncio.CancelledError: raise`` clause — the codebase
+    convention makes the cancellation path explicit at every seam
+    (Python ≥3.8 keeps CancelledError out of Exception, but the
+    explicit clause is the enforced contract: it survives refactors
+    to tuple catches and documents the shutdown path).
+
+A handler that re-raises (bare ``raise`` or ``raise err``) passes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import (
+    block_has_awaits,
+    exception_types,
+    handler_reraises,
+    is_cancelled_name,
+    iter_async_functions,
+    walk_in_scope,
+)
+
+
+class BroadExceptRule(Rule):
+    code = "OR005"
+    name = "broad-except-cancellation"
+    description = "broad except in coroutine without CancelledError re-raise"
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        for fn, qn in iter_async_functions(ctx.tree):
+            for node in walk_in_scope(fn):
+                if not isinstance(node, (ast.Try,)):
+                    continue
+                yield from self._check_try(ctx, node, qn)
+
+    def _check_try(self, ctx, node: ast.Try, qn: str) -> Iterable[Finding]:
+        cancelled_handled = False
+        for handler in node.handlers:
+            types = exception_types(handler)
+            caught_cancelled = any(is_cancelled_name(t) for t in types)
+            bare = handler.type is None
+            base_exc = "BaseException" in types
+            broad_exc = "Exception" in types
+            if caught_cancelled and handler_reraises(handler):
+                cancelled_handled = True
+                continue
+            if bare or base_exc or caught_cancelled:
+                if handler_reraises(handler):
+                    continue
+                what = (
+                    "bare except"
+                    if bare
+                    else (
+                        "except BaseException"
+                        if base_exc
+                        else "except catching asyncio.CancelledError"
+                    )
+                )
+                yield self.finding(
+                    ctx,
+                    handler,
+                    f"{what} in coroutine {qn} swallows task cancellation"
+                    f" — add `except asyncio.CancelledError: raise` before"
+                    f" it (or re-raise)",
+                    scope=qn,
+                    subject=what,
+                )
+                continue
+            if broad_exc and not cancelled_handled:
+                if handler_reraises(handler):
+                    continue
+                if block_has_awaits(node.body):
+                    yield self.finding(
+                        ctx,
+                        handler,
+                        f"except Exception around an await in coroutine"
+                        f" {qn} without a preceding `except"
+                        f" asyncio.CancelledError: raise` clause — make"
+                        f" the cancellation path explicit",
+                        scope=qn,
+                        subject="except Exception",
+                    )

@@ -1,0 +1,77 @@
+"""OR006: nondeterminism on a replay-critical path.
+
+The chaos/soak machinery replays failures from a seed: any failing run
+prints ``--seed N`` and the SAME byte-for-byte behavior must reproduce.
+That only holds if ``decision/``, ``kvstore/`` and ``emulator/`` code
+draws randomness through ``stable_rng``/named ChaosPlan substreams and
+time through the injected clocks — a stray ``random.random()`` or
+``time.time()`` silently breaks every recorded replay hint.
+
+Allowed: ``random.Random(seed)`` WITH an explicit seed argument (how
+``stable_rng`` and ChaosPlan build their streams), ``time.monotonic``
+/ ``perf_counter`` (delta measurement, not identity).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name
+
+SCOPE_DIRS = ("decision", "kvstore", "emulator")
+
+BANNED_CALLS = frozenset(
+    {
+        "random.random",
+        "random.randint",
+        "random.randrange",
+        "random.choice",
+        "random.choices",
+        "random.shuffle",
+        "random.sample",
+        "random.uniform",
+        "random.gauss",
+        "random.getrandbits",
+        "random.seed",
+        "time.time",
+        "time.time_ns",
+        "uuid.uuid1",
+        "uuid.uuid4",
+    }
+)
+SEEDED_CTORS = frozenset({"random.Random", "numpy.random.default_rng"})
+
+
+class DeterminismRule(Rule):
+    code = "OR006"
+    name = "determinism"
+    description = "unseeded randomness / wall-clock in replay-critical path"
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if not (ctx.part_set() & set(SCOPE_DIRS)):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            if dn in BANNED_CALLS:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}() breaks seeded replay in {ctx.path} — draw"
+                    f" through stable_rng/ChaosPlan.rng or the injected"
+                    f" clock seams",
+                    subject=dn,
+                )
+            elif dn in SEEDED_CTORS and not (node.args or node.keywords):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}() without a seed breaks seeded replay — pass"
+                    f" an explicit seed (see stable_rng)",
+                    subject=dn,
+                )

@@ -1,0 +1,207 @@
+"""OR007: counter / gauge / marker names must come from the central
+registry (``openr_tpu/monitor/names.py``) and the operator-facing
+families must be documented in ``docs/Monitor.md``.
+
+This one rule subsumes the three bash-heredoc doc lints ci.sh used to
+carry (perf markers, ``decision.rebuild.*``, flood/program/queue/ctrl/
+watchdog/spark counters):
+
+  * per file — every string literal (or f-string, normalized to a
+    ``*``-template) passed to ``Counters.increment/set/add_value/touch``
+    must resolve against the registry; every literal stage marker passed
+    to ``add_perf_event``/``PerfEvents.start`` must be in the marker
+    vocabulary; ``perf.<NAME>`` attribute references must name a marker
+    (or a known module export);
+  * whole-project — every marker, every :data:`DOCUMENTED` counter and
+    every documented template form must appear in docs/Monitor.md, and
+    the messaging seams may only emit the :data:`QUEUE_FIELDS` gauge
+    vocabulary (checked statically against messaging/__init__.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name, str_or_template
+
+COUNTER_METHODS = ("increment", "add_value", "touch")
+DOC_PATH = "docs/Monitor.md"
+MESSAGING_PATH = "openr_tpu/messaging/__init__.py"
+
+
+def _registry():
+    from openr_tpu.monitor import names
+
+    return names
+
+
+class NamesRegistryRule(Rule):
+    code = "OR007"
+    name = "names-registry"
+    description = (
+        "counter/marker literals must come from monitor/names.py; "
+        "documented families must match docs/Monitor.md"
+    )
+
+    # ------------------------------------------------------------ per-file
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        names = _registry()
+        if ctx.path in names.CALLSITE_EXEMPT:
+            return
+        parts = ctx.part_set()
+        if not (
+            ctx.path.startswith("openr_tpu")
+            or {"fixtures", "orlint"} <= parts  # self-test sandboxes
+        ):
+            # counters stamped from tests/benchmarks are synthetic
+            return
+        imports_perf = (
+            "from openr_tpu.monitor import perf" in ctx.source
+            or "from openr_tpu.monitor import" in ctx.source
+            and re.search(
+                r"from openr_tpu\.monitor import [^\n]*\bperf\b", ctx.source
+            )
+        )
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and imports_perf:
+                if (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id == "perf"
+                    and node.attr.isupper()
+                    and node.attr not in names.MARKERS
+                    and node.attr not in names.PERF_MODULE_EXPORTS
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"perf.{node.attr} is not a registered stage marker"
+                        f" (monitor/names.py MARKERS)",
+                        subject=f"perf.{node.attr}",
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute) or not node.args:
+                continue
+            meth = node.func.attr
+            lit = str_or_template(node.args[0])
+            if lit is None:
+                continue
+            value, _is_tmpl = lit
+            if meth == "add_perf_event" or (
+                meth == "start"
+                and (dotted_name(node.func) or "").endswith("PerfEvents.start")
+            ):
+                if value not in names.MARKERS:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"stage marker {value!r} is not in the registry"
+                        f" vocabulary (monitor/names.py MARKERS)",
+                        subject=value,
+                    )
+                continue
+            if meth in COUNTER_METHODS or (
+                meth == "set"
+                and len(node.args) == 2
+                and self._counterish_receiver(node.func.value)
+            ):
+                if not names.is_registered(value):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"counter name {value!r} is not in the registry —"
+                        f" add it to monitor/names.py (and docs/Monitor.md"
+                        f" for operator-facing families)",
+                        subject=value,
+                    )
+
+    @staticmethod
+    def _counterish_receiver(recv: ast.AST) -> bool:
+        dn = dotted_name(recv) or ""
+        return dn.endswith("counters") or dn in ("c", "ctrs")
+
+    # ------------------------------------------------------- whole-project
+
+    def finalize(self, ctxs, root: str) -> Iterable[Finding]:
+        names = _registry()
+        rootp = pathlib.Path(root)
+        docp = rootp / DOC_PATH
+        if not docp.exists():
+            # fixture sandboxes without docs skip parity (the real tree
+            # always has docs/Monitor.md — engine roots at the repo)
+            return
+        doc = docp.read_text()
+        for m in names.MARKERS:
+            if m not in doc:
+                yield self.finding(
+                    None,
+                    None,
+                    f"stage marker {m} missing from {DOC_PATH}",
+                    subject=f"marker:{m}",
+                    path=DOC_PATH,
+                )
+        for n in sorted(names.DOCUMENTED):
+            if n not in doc:
+                yield self.finding(
+                    None,
+                    None,
+                    f"documented-family counter {n} missing from {DOC_PATH}",
+                    subject=f"counter:{n}",
+                    path=DOC_PATH,
+                )
+        for tmpl, doc_form in sorted(names.TEMPLATES.items()):
+            if doc_form is not None and doc_form not in doc:
+                yield self.finding(
+                    None,
+                    None,
+                    f"template doc-form {doc_form} (for {tmpl}) missing"
+                    f" from {DOC_PATH}",
+                    subject=f"template:{tmpl}",
+                    path=DOC_PATH,
+                )
+        yield from self._check_messaging_fields(names, rootp)
+
+    def _check_messaging_fields(self, names, rootp) -> Iterable[Finding]:
+        msgp = rootp / MESSAGING_PATH
+        if not msgp.exists():
+            return
+        tree = ast.parse(msgp.read_text())
+        fields: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.JoinedStr):
+                tmpl = str_or_template(node)[0]  # type: ignore[index]
+                m = re.fullmatch(r"queue\.\*\.([a-z_]+)", tmpl)
+                if m:
+                    fields.add(m.group(1))
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_count"
+                and node.args
+            ):
+                lit = str_or_template(node.args[0])
+                if lit is not None and "*" not in lit[0]:
+                    fields.add(lit[0])
+        if not fields:
+            yield self.finding(
+                None,
+                None,
+                "no queue.* gauge fields found in messaging (check broken?)",
+                subject="messaging:none",
+                path=MESSAGING_PATH,
+            )
+            return
+        for f in sorted(fields - set(names.QUEUE_FIELDS)):
+            yield self.finding(
+                None,
+                None,
+                f"messaging emits queue field {f!r} outside the registry"
+                f" QUEUE_FIELDS vocabulary",
+                subject=f"field:{f}",
+                path=MESSAGING_PATH,
+            )
