@@ -336,6 +336,145 @@ def measure_prefix_churn(
     }
 
 
+def measure_topo_churn(
+    nodes: int = 320,
+    rounds: int = 60,
+    solver: str = "cpu",
+    force_full: bool = False,
+    seed: int = 5,
+    warmup_rounds: int = 2,
+    check_parity_every: int = 0,
+    revert_every: int = 4,
+):
+    """Seeded link-flap / metric-change storm microbench: the
+    topology-delta warm-start's headline (`--topo-churn`).
+
+    Fixed grid topology; each round flaps ONE random non-root link's
+    metric through the REAL publication path and drives the rebuild
+    coroutine directly (no debounce timing noise), sampling
+    `Decision._last_spf_ms`. Every `revert_every`-th round reverts the
+    previous flap (flap-then-revert, the convergence-critical shape).
+    On the warm pipeline every round is a `decision.rebuild.topo_delta`
+    with zero full area solves; `force_full=True` runs the SAME
+    workload down the from-scratch path for the speedup comparison.
+
+    With `check_parity_every=N > 0`, every Nth round's published RIB is
+    compared byte-for-byte against a from-scratch `compute_rib` — the
+    CI smoke lane's gate.
+
+    Returns `topo_churn_p50_ms`/p99 plus the counters proving which
+    path ran (`rebuild_topo_delta`, `rebuild_full`, `warm_starts`,
+    `engine_solves`, `churn_area_solves`) and `parity` ("ok" /
+    "MISMATCH:<round>" / "unchecked").
+    """
+    import dataclasses
+
+    from openr_tpu.monitor import Counters
+    from openr_tpu.utils import topogen
+
+    side = max(2, int(round(nodes ** 0.5)))
+    adj_dbs, prefix_dbs = topogen.grid(side, side)
+    counters = Counters()
+    dec, _pubs, _routes, pub_for = build_decision(
+        adj_dbs, prefix_dbs, solver=solver, counters=counters
+    )
+    if solver == "tpu":
+        # the native single-root engine has no warm-start path (its
+        # artifact carries no neighbor distance columns): measure the
+        # batched-kernel pipeline the delta path targets
+        if dec._tpu is not None:
+            dec._tpu.native_rib = "off"
+    dec.force_full_rebuild = force_full
+    rng = np.random.default_rng(seed)
+    adj_cur = {db.this_node_name: db for db in adj_dbs}
+    names = [db.this_node_name for db in adj_dbs]
+    versions = {n: 1 for n in names}
+    parity = ["unchecked"]
+
+    def flap(node: str, k: int, metric: int):
+        db = adj_cur[node]
+        adjs = list(db.adjacencies)
+        adjs[k] = dataclasses.replace(adjs[k], metric=metric)
+        db = dataclasses.replace(db, adjacencies=tuple(adjs))
+        adj_cur[node] = db
+        versions[node] += 1
+        dec.process_publication(pub_for(db, version=versions[node]))
+
+    async def run():
+        samples: list[float] = []
+        await dec._rebuild_routes()  # initial full build (jit compile)
+        solves0 = dec._area_solves
+        parity_solves = 0
+        last: tuple | None = None
+        for r in range(rounds):
+            if last is not None and revert_every and r % revert_every == 0:
+                node, k, old_metric = last
+                flap(node, k, old_metric)  # flap-then-revert
+                last = None
+            else:
+                # never the RIB root: a root-incident metric change
+                # legitimately falls back to full (nexthop slot metrics
+                # move) — that case is covered by tests, not the bench
+                node = names[int(rng.integers(1, len(names)))]
+                db = adj_cur[node]
+                k = int(rng.integers(0, len(db.adjacencies)))
+                old_metric = int(db.adjacencies[k].metric)
+                new_metric = old_metric
+                while new_metric == old_metric:
+                    # a draw equal to the current metric would be a
+                    # no-op round (no rebuild → stale latency sample,
+                    # missed counter) — re-roll, still seed-determined
+                    new_metric = int(rng.integers(1, 64))
+                flap(node, k, new_metric)
+                last = (node, k, old_metric)
+            await dec._rebuild_routes()
+            if r >= warmup_rounds:
+                samples.append(dec._last_spf_ms)
+            if check_parity_every and r % check_parity_every == 0:
+                before = dec._area_solves
+                ref = dec.compute_rib()
+                parity_solves += dec._area_solves - before
+                if (
+                    dec.rib.unicast_routes != ref.unicast_routes
+                    or dec.rib.mpls_routes != ref.mpls_routes
+                ):
+                    parity[0] = f"MISMATCH:{r}"
+                    break
+                if parity[0] == "unchecked":
+                    parity[0] = "ok"
+        return samples, solves0, parity_solves
+
+    samples, solves0, parity_solves = asyncio.run(run())
+    arr = np.array(samples) if samples else np.array([0.0])
+    engine_solves = (
+        dec._tpu.solve_count if dec._tpu is not None else dec._area_solves
+    )
+    warm_engine = dec._tpu.warm_solves if dec._tpu is not None else None
+    return {
+        "topo_churn_p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "topo_churn_p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "nodes": len(adj_dbs),
+        "rounds": rounds,
+        "engine": solver,
+        "forced_full": force_full,
+        "rebuild_topo_delta": int(
+            counters.get("decision.rebuild.topo_delta")
+        ),
+        "rebuild_full": int(counters.get("decision.rebuild.full")),
+        "warm_starts": int(counters.get("decision.spf.warm_starts")),
+        "warm_fallbacks": int(
+            counters.get("decision.spf.warm_fallbacks")
+        ),
+        "area_solves": dec._area_solves,
+        # full-area solves the CHURN itself cost (parity-check
+        # compute_rib calls excluded): zero on the warm pipeline
+        "churn_area_solves": dec._area_solves - solves0 - parity_solves,
+        "engine_solves": engine_solves,
+        "engine_warm_solves": warm_engine,
+        "parity": parity[0],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1280)
@@ -360,14 +499,87 @@ def main() -> None:
     ap.add_argument("--prefix-rounds", type=int, default=120)
     ap.add_argument(
         "--force-full", action="store_true",
-        help="with --prefix-churn: skip the scoped run and measure only "
-        "the forced full-rebuild path",
+        help="with --prefix-churn/--topo-churn: skip the scoped/warm "
+        "run and measure only the forced full-rebuild path",
+    )
+    ap.add_argument(
+        "--topo-churn", action="store_true",
+        help="run the seeded link-flap + metric-change storm on a fixed "
+        "grid: measures the topology-delta warm-start path "
+        "(decision.rebuild.topo_delta), and the same workload forced "
+        "down the full path for the speedup ratio",
+    )
+    ap.add_argument("--topo-rounds", type=int, default=60)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="with --topo-churn: CI gate mode — byte-parity checked "
+        "against from-scratch compute_rib every few rounds, and the "
+        "process exits 1 unless the warm-start path was actually taken "
+        "(counter-asserted) and parity held",
     )
     args = ap.parse_args()
     if args.backend == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.topo_churn:
+        full = measure_topo_churn(
+            nodes=args.nodes, rounds=max(10, args.topo_rounds // 3),
+            solver="tpu", force_full=True,
+        )
+        scoped = None
+        if not args.force_full:
+            scoped = measure_topo_churn(
+                nodes=args.nodes, rounds=args.topo_rounds, solver="tpu",
+                check_parity_every=5 if args.smoke else 0,
+            )
+        head = scoped or full
+        detail = {
+            "warm": scoped,
+            "forced_full": full,
+            "backend": _backend(),
+        }
+        if scoped is not None:
+            detail["speedup_vs_full"] = round(
+                full["topo_churn_p50_ms"]
+                / max(scoped["topo_churn_p50_ms"], 1e-6),
+                1,
+            )
+        print(
+            json.dumps(
+                {
+                    "metric": "topo_churn_p50_ms",
+                    "value": head["topo_churn_p50_ms"],
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "detail": detail,
+                }
+            )
+        )
+        if args.smoke and scoped is not None:
+            # CI gate: the warm path must actually have been taken —
+            # a single-link metric change must never pay a full
+            # per-area solve — and byte-parity must hold
+            ok = (
+                scoped["parity"] == "ok"
+                and scoped["rebuild_topo_delta"] >= args.topo_rounds - 2
+                and scoped["rebuild_full"] == 1  # the initial build only
+                and scoped["warm_starts"] > 0
+                and scoped["churn_area_solves"] == 0
+            )
+            if not ok:
+                print(
+                    "topo-churn smoke FAILED: "
+                    f"parity={scoped['parity']} "
+                    f"topo_delta={scoped['rebuild_topo_delta']} "
+                    f"full={scoped['rebuild_full']} "
+                    f"warm={scoped['warm_starts']} "
+                    f"churn_solves={scoped['churn_area_solves']}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        return
 
     if args.prefix_churn:
         full = measure_prefix_churn(

@@ -49,6 +49,15 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007; do
 done
 echo "ok: known-bad fixture trips all 7 rules"
 
+echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
+# the topology-delta acceptance gate (docs/Decision.md): single-link
+# metric changes on a 320-node grid must take the warm-start path
+# (decision.rebuild.topo_delta, zero full area solves) and stay
+# byte-equal to from-scratch compute_rib — bench_churn --smoke exits 1
+# on any counter or parity violation
+JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
+    --topo-churn --nodes 320 --topo-rounds 30 --smoke --backend cpu
+
 echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
 # the tier-1-safe slice of the long-horizon soak: storms + background
 # prefix churn + all five invariant classes + memory watermark, with

@@ -123,6 +123,20 @@ class DecisionConfig:
     # single-device (latency shape).
     mesh_sources: int = 0
     mesh_graph: int = 1
+    # topology-delta warm start (DeltaPath/Bounded-Dijkstra): metric-only
+    # link churn re-solves only the affected region from the cached
+    # SolveArtifact instead of paying a full per-area solve
+    # (REBUILD_TOPO_DELTA; docs/Decision.md). False forces every
+    # topology change down the full path.
+    enable_topo_delta: bool = True
+    # fallback-to-full threshold: a warm start is refused when the
+    # changed-edge DELTA SET exceeds this fraction of the graph's
+    # edges — past that a cold solve is cheaper than per-edge
+    # bookkeeping. The affected REGION is deliberately uncapped: it may
+    # legitimately cover most of the graph (a raised edge near the
+    # root of a uniform-metric topology), and its worst case costs
+    # about one cold solve.
+    topo_delta_max_frac: float = 0.25
 
 
 @dataclass

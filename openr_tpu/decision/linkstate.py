@@ -259,15 +259,36 @@ class LinkState:
         Returns True if the topology changed (triggers SPF recompute —
         the reference returns a LinkStateChange bitset; we collapse to bool).
         """
+        return self.update_adjacency_db_delta(db)[0]
+
+    def update_adjacency_db_delta(
+        self, db: AdjacencyDatabase
+    ) -> tuple[bool, list[tuple[str, str]] | None]:
+        """Insert/replace a node's adjacency database, reporting the
+        change *shape*: (changed, pairs) where `pairs` is the list of
+        directed (node, neighbor) edges whose metric (or rtt) changed
+        when the update was METRIC-ONLY, or None for any structural
+        change (adjacency set, overload bits, labels, weights, first
+        insert). Decision's topology-delta rebuild classifier consumes
+        the pairs; everything else keeps the plain bool contract via
+        `update_adjacency_db`."""
         old = self._adj_dbs.get(db.this_node_name)
         if old == db:
-            return False
+            return False, []
         self._adj_dbs[db.this_node_name] = db
         self.rev += 1
+        # computed unconditionally (not only when a CSR base is cached):
+        # the dirt classifier needs the metric-only verdict even before
+        # the first to_csr() / on the oracle path, which never builds one
+        delta = _metric_only_delta(old, db) if old is not None else None
+        pairs = (
+            [(db.this_node_name, a.other_node_name) for a in delta]
+            if delta is not None
+            else None
+        )
         base = self._csr_cell[0]
-        if base is not None and old is not None:
-            delta = _metric_only_delta(old, db)
-            if delta is not None and (
+        if base is not None and delta is not None:
+            if (
                 len(self._pending) + len(delta)
                 <= max(64, base.num_edges // 8)  # compaction cap
             ):
@@ -275,10 +296,10 @@ class LinkState:
                     (db.this_node_name, a) for a in delta
                 ]
                 # cell's patched view stays: to_csr applies the suffix
-                return True
+                return True, pairs
         self._csr_cell = [None, None, 0]
         self._pending = []
-        return True
+        return True, pairs
 
     def delete_adjacency_db(self, node: str) -> bool:
         if node in self._adj_dbs:
@@ -335,6 +356,31 @@ class LinkState:
     def node_label(self, node: str) -> int:
         db = self._adj_dbs.get(node)
         return db.node_label if db else 0
+
+    def effective_metric(self, u: str, v: str) -> int | None:
+        """Current directed SPF edge weight u→v — min clamped metric over
+        the usable parallel adjacencies — or None when no usable edge
+        exists. Same usability rules as `build_csr`/`build_adjacency`
+        (bidirectional check, either-side drain, METRIC_MAX clamp), but
+        O(deg) for ONE pair instead of O(E) for the graph: the
+        topology-delta warm start resolves each flapped pair's new
+        weight through this."""
+        db = self._adj_dbs.get(u)
+        dbv = self._adj_dbs.get(v)
+        if db is None or dbv is None:
+            return None
+        if not any(x.other_node_name == u for x in dbv.adjacencies):
+            return None  # bidirectional check failed
+        best: int | None = None
+        for a in db.adjacencies:
+            if a.other_node_name != v or a.is_overloaded:
+                continue
+            if self.link_drained_by_peer(u, a):
+                continue
+            m = min(int(a.metric), METRIC_MAX)
+            if best is None or m < best:
+                best = m
+        return best
 
     # ---- CSR materialization ---------------------------------------------
 

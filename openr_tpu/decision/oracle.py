@@ -50,6 +50,12 @@ class SpfResult:
     dist: dict[str, int]
     # dest node -> set of first-hop neighbor node names (ECMP set)
     first_hops: dict[str, set[str]]
+    # dest node -> equal-cost predecessor set (the ECMP DAG run_spf
+    # derives first_hops from). Retained so the topology-delta warm
+    # start (`warm_spf`) can repair the DAG locally instead of
+    # re-deriving it from scratch; None on results built by legacy
+    # constructors (warm start then falls back to a full solve).
+    preds: dict[str, set[str]] | None = None
 
 
 @dataclass
@@ -75,6 +81,50 @@ class SolveArtifact:
     # --- TPU engine state: the solve() tuple -------------------------
     # (csr, dist, fh, nbr_ids, lfa); see TpuSpfSolver.solve
     solved: tuple | None = None
+    # --- warm-start bookkeeping (oracle engine; built lazily on the
+    # first topology-delta round, carried forward across warm rounds,
+    # dropped by Decision.trim_caches' eviction policy) ---------------
+    radj: dict[str, dict[str, int]] | None = None  # reverse adjacency
+    # min edge weight seen (may be stale-LOW across warm rounds, which
+    # is the safe direction for the >= 1 guard warm_spf needs for its
+    # strict pred-DAG distance ordering)
+    min_metric: int | None = None
+
+    def warm_state_bytes(self) -> int:
+        """Rough footprint of the warm-start-only state (what
+        `drop_warm_state` reclaims) — the soak watermark reads this."""
+        import sys
+
+        total = 0
+        if self.radj is not None:
+            total += sys.getsizeof(self.radj)
+            total += sum(sys.getsizeof(d) for d in self.radj.values())
+        if self.spf is not None and self.spf.preds is not None:
+            total += sys.getsizeof(self.spf.preds)
+            total += sum(
+                sys.getsizeof(s) for s in self.spf.preds.values()
+            )
+        if self.solved is not None:
+            dist = self.solved[1]
+            np_mat = getattr(dist, "_np", None)  # _LazyDist host mirror
+            if np_mat is not None:
+                total += np_mat.nbytes
+        return total
+
+    def drop_warm_state(self) -> None:
+        """Release warm-start-only memory, keeping everything the
+        prefix-only fast path needs (neither `_unicast_route` nor the
+        scoped reassembly reads preds). The next topology-delta round
+        rebuilds what it can cheaply (radj, TPU host dist mirror) or —
+        with preds gone — falls back to ONE full solve that mints a
+        fresh warm-capable artifact."""
+        self.radj = None
+        if self.spf is not None:
+            self.spf.preds = None
+        if self.solved is not None:
+            dist = self.solved[1]
+            if hasattr(dist, "_np"):
+                dist._np = None
 
 
 def build_adjacency(ls: LinkState) -> dict[str, dict[str, int]]:
@@ -154,7 +204,7 @@ def run_spf(
             else:
                 fh |= first_hops.get(p, set())
         first_hops[v] = fh
-    return SpfResult(dist=dist, first_hops=first_hops)
+    return SpfResult(dist=dist, first_hops=first_hops, preds=preds)
 
 
 def metric_key(e: PrefixEntry) -> tuple[int, int, int]:
@@ -337,6 +387,37 @@ def _unicast_route(art: SolveArtifact, prefix, per_node) -> RibEntry | None:
     )
 
 
+def _mpls_node_route(
+    ls: LinkState, my_node: str, spf: SpfResult, node: str, label: int
+) -> RibMplsEntry | None:
+    """One node-segment label route against a completed SPF, or None
+    when the node is unreachable. The single source of the SWAP/PHP
+    construction: the full `compute_routes` loop and the topology-delta
+    warm path both call it, so the scoped MPLS reassembly is byte-equal
+    to a from-scratch build by construction."""
+    if node not in spf.dist or not spf.first_hops.get(node):
+        return None
+    base = _nexthops_to_nodes(ls, my_node, spf, [node])
+    nhs = tuple(
+        NextHop(
+            address=nh.address,
+            if_name=nh.if_name,
+            metric=nh.metric,
+            neighbor_node=nh.neighbor_node,
+            area=nh.area,
+            mpls_action=(
+                MplsAction(action=MplsActionType.PHP)
+                if nh.neighbor_node == node
+                else MplsAction(action=MplsActionType.SWAP, swap_label=label)
+            ),
+        )
+        for nh in base
+    )
+    if not nhs:
+        return None
+    return RibMplsEntry(label=label, nexthops=nhs)
+
+
 def assemble_prefix_routes(
     art: SolveArtifact, ps: PrefixState, prefixes
 ) -> dict:
@@ -397,27 +478,9 @@ def compute_routes(
         label = ls.node_label(node)
         if label < MPLS_LABEL_MIN or node == my_node:
             continue
-        if node not in spf.dist or not spf.first_hops.get(node):
-            continue
-        igp = spf.dist[node]
-        base = _nexthops_to_nodes(ls, my_node, spf, [node])
-        nhs = tuple(
-            NextHop(
-                address=nh.address,
-                if_name=nh.if_name,
-                metric=nh.metric,
-                neighbor_node=nh.neighbor_node,
-                area=nh.area,
-                mpls_action=(
-                    MplsAction(action=MplsActionType.PHP)
-                    if nh.neighbor_node == node
-                    else MplsAction(action=MplsActionType.SWAP, swap_label=label)
-                ),
-            )
-            for nh in base
-        )
-        if nhs:
-            rdb.mpls_routes[label] = RibMplsEntry(label=label, nexthops=nhs)
+        entry = _mpls_node_route(ls, my_node, spf, node, label)
+        if entry is not None:
+            rdb.mpls_routes[label] = entry
 
     # ---- MPLS adjacency-label routes -------------------------------------
     my_db = ls.adjacency_db(my_node)
@@ -446,3 +509,365 @@ def compute_routes(
                 ),
             )
     return (rdb, art) if return_artifact else rdb
+
+
+# ---------------------------------------------------------------------------
+# Topology-delta warm start (DeltaPath 1808.06893 + Bounded Dijkstra
+# 1903.00436): recompute an SPF after a bounded set of metric-only edge
+# changes in cost proportional to the AFFECTED REGION, not the graph.
+# ---------------------------------------------------------------------------
+
+
+def warm_spf(
+    adj: dict[str, dict[str, int]],
+    radj: dict[str, dict[str, int]],
+    old: SpfResult,
+    overloaded: set[str],
+    root: str,
+    changes: list[tuple[str, str, int, int]],
+    node_budget: int,
+):
+    """Exact incremental re-solve of `run_spf` after metric-only edge
+    changes; returns (SpfResult, changed_nodes, region) or None to
+    demand a full solve (affected region exceeded `node_budget`).
+
+    `changes` is [(u, v, w_old, w_new)] over the DIRECTED min-metric
+    edges; `adj`/`radj` already carry the NEW weights. Requires every
+    edge weight >= 1 (strict pred-DAG distance ordering — the caller
+    guards); `overloaded` is the no-transit set, unchanged by metric
+    churn (overload toggles are structural and take the full path).
+
+    Three phases, each output-sensitive:
+
+      1. **Increase cone** — the closure of OLD tight edges from each
+         raised edge's head: every node whose distance can increase is
+         inside it (any old shortest path that degraded runs through a
+         raised edge and then along old tight edges). Cone distances
+         are removed; everything outside keeps its old distance, which
+         is thereby a valid UPPER bound (it can only improve).
+      2. **Bounded Dijkstra** — seeded with the cone boundary's best
+         non-cone tentatives and the lowered edges' direct relaxations;
+         standard improve-only Dijkstra then touches exactly the nodes
+         whose distance changes (plus the cone), truncated by the
+         old-distance bound implicitly: a relaxation that cannot beat
+         the standing (old) distance never enters the heap.
+      3. **DAG repair** — predecessor sets are recomputed only where
+         membership can have moved (changed distance at either endpoint
+         or a changed edge weight), and first-hop sets are re-derived
+         down the pred DAG in distance order, stopping wherever the
+         recomputed set equals the old one.
+    """
+    dist_old = old.dist
+    D = dict(dist_old)
+    P = dict(old.preds)
+    FH = dict(old.first_hops)
+    cw = {(u, v): (wo, wn) for (u, v, wo, wn) in changes}
+
+    # ---- phase 1: conservative increase cone (old tight-edge closure)
+    cone: set[str] = set()
+    stack: list[str] = []
+    for u, v, w_old, _w_new in changes:
+        if _w_new <= w_old:
+            continue
+        du = dist_old.get(u)
+        if du is None or v not in dist_old:
+            continue
+        if u != root and u in overloaded:
+            continue  # u never relaxed: the edge was not on any path
+        if du + w_old == dist_old[v] and v not in cone:
+            cone.add(v)
+            stack.append(v)
+    while stack:
+        x = stack.pop()
+        if len(cone) > node_budget:
+            return None
+        if x != root and x in overloaded:
+            continue  # no transit: no tight out-edges contribute
+        dx = dist_old[x]
+        for y, w in adj.get(x, {}).items():
+            wo = cw.get((x, y), (w,))[0]  # OLD weight for tightness
+            if y in dist_old and dx + wo == dist_old[y] and y not in cone:
+                cone.add(y)
+                stack.append(y)
+    for x in cone:
+        del D[x]
+
+    # ---- phase 2: bounded Dijkstra over the affected region ----------
+    pq: list[tuple[int, str]] = []
+    touched: set[str] = set(cone)
+
+    def push(nd: int, v: str) -> None:
+        if nd < D.get(v, DIST_INF):
+            D[v] = nd
+            heapq.heappush(pq, (nd, v))
+            touched.add(v)
+
+    for x in cone:
+        best = DIST_INF
+        for u, w in radj.get(x, {}).items():
+            if u in cone:
+                continue
+            du = D.get(u)
+            if du is None or (u != root and u in overloaded):
+                continue
+            nd = du + w
+            if nd < best:
+                best = nd
+        if best < DIST_INF:
+            heapq.heappush(pq, (best, x))
+            D[x] = best
+    for u, v, w_old, w_new in changes:
+        if w_new >= w_old or u in cone:
+            continue  # raised edges handled by the cone; coned u relaxes
+        du = D.get(u)
+        if du is None or (u != root and u in overloaded):
+            continue
+        nd = du + w_new
+        if nd < DIST_INF:
+            push(nd, v)
+    budget = node_budget
+    while pq:
+        d, x = heapq.heappop(pq)
+        if d != D.get(x):
+            continue  # stale heap entry
+        budget -= 1
+        if budget < 0:
+            return None
+        if x != root and x in overloaded:
+            continue
+        for y, w in adj.get(x, {}).items():
+            nd = d + w
+            if nd >= DIST_INF:
+                continue
+            push(nd, y)
+
+    # ---- phase 3: DAG repair (preds, then first hops) ----------------
+    dist_changed = {
+        x for x in touched if D.get(x) != dist_old.get(x)
+    }
+    repair: set[str] = set()
+    for x in dist_changed:
+        if x in D:
+            repair.add(x)
+        else:
+            P.pop(x, None)
+            FH.pop(x, None)
+        for y in adj.get(x, {}):
+            if y in D:
+                repair.add(y)
+    for _u, v, _wo, _wn in changes:
+        if v in D:
+            repair.add(v)
+    repair.discard(root)
+    for v in repair:
+        dv = D[v]
+        ps_: set[str] = set()
+        for u, w in radj.get(v, {}).items():
+            du = D.get(u)
+            if du is None or (u != root and u in overloaded):
+                continue
+            if du + w == dv:
+                ps_.add(u)
+        P[v] = ps_
+
+    work = [(D[v], v) for v in repair]
+    heapq.heapify(work)
+    done: set[str] = set()
+    fh_changed: set[str] = set()
+    while work:
+        dv, v = heapq.heappop(work)
+        if v in done or dv != D.get(v):
+            continue
+        done.add(v)
+        fh: set[str] = set()
+        for p in P.get(v, ()):
+            if p == root:
+                fh.add(v)
+            else:
+                fh |= FH.get(p, set())
+        if fh != FH.get(v):
+            FH[v] = fh
+            fh_changed.add(v)
+            # the change propagates only down the pred DAG (strictly
+            # larger distances — weights >= 1), so heap order processes
+            # every ancestor before its descendants
+            for y in adj.get(v, {}):
+                if y in D and v in P.get(y, ()) and y not in done:
+                    heapq.heappush(work, (D[y], y))
+
+    changed_nodes = dist_changed | fh_changed
+    region = len(touched | changed_nodes)
+    return (
+        SpfResult(dist=D, first_hops=FH, preds=P),
+        changed_nodes,
+        region,
+    )
+
+
+def resolve_metric_changes(
+    art: SolveArtifact, ls: LinkState, edge_pairs
+):
+    """Map the dirt classifier's (u, v) pairs onto the oracle artifact:
+    [(u, v, w_old, w_new)] with no-op pairs dropped, or None when the
+    pairs are not a pure metric delta against the cached adjacency
+    (structural doubt -> full solve)."""
+    changes: list[tuple[str, str, int, int]] = []
+    for u, v in sorted(edge_pairs):
+        w_old = art.adj.get(u, {}).get(v)
+        w_new = ls.effective_metric(u, v)
+        if w_old is None and w_new is None:
+            continue  # edge unusable before and after: irrelevant
+        if w_old is None or w_new is None:
+            return None  # edge appeared/vanished: not metric-only
+        if w_old != w_new:
+            changes.append((u, v, w_old, w_new))
+    return changes
+
+
+def warm_compute_routes(
+    art: SolveArtifact,
+    ls: LinkState,
+    ps: PrefixState,
+    my_node: str,
+    edge_pairs,
+    prefix_dirt,
+    cached_rdb: RouteDatabase,
+    max_frac: float,
+):
+    """Topology-delta warm rebuild for one area on the oracle engine.
+
+    Returns (rdb, new_artifact, touched_prefixes, touched_labels,
+    region_nodes) or None to demand a full solve. Byte-equality
+    contract: the returned rdb must equal a from-scratch
+    `compute_routes(ls, ps, my_node)` — the reassembly runs the same
+    `_unicast_route` / `_mpls_node_route` code over a provably
+    sufficient touched set (see docs/Decision.md for the bound
+    derivation), and everything else is reused by object identity.
+    """
+    spf = art.spf
+    if spf is None or spf.preds is None or art.adj is None:
+        return None
+    if art.lfa_spfs is not None:
+        return None  # LFA artifacts are per-neighbor solves: full path
+    if any(u == my_node for u, _v in edge_pairs):
+        return None  # root-incident: my own nexthop slot metrics moved
+    changes = resolve_metric_changes(art, ls, edge_pairs)
+    if changes is None:
+        return None
+    n_nodes = len(art.adj)
+    n_edges = sum(len(vs) for vs in art.adj.values())
+    if len(changes) > max(16, int(max_frac * max(n_edges, 1))):
+        return None  # delta set too large: a full solve is cheaper
+    if art.min_metric is None:
+        art.min_metric = min(
+            (w for vs in art.adj.values() for w in vs.values()),
+            default=1,
+        )
+    min_metric = min(
+        art.min_metric, min((wn for *_x, wn in changes), default=DIST_INF)
+    )
+    if min_metric < 1:
+        return None  # zero-weight edges break the strict DAG ordering
+    if art.overloaded_set is None:
+        art.overloaded_set = {
+            n for n in art.ls.nodes if art.ls.is_node_overloaded(n)
+        }
+    if art.radj is None:
+        radj: dict[str, dict[str, int]] = {}
+        for u, vs in art.adj.items():
+            for v, w in vs.items():
+                radj.setdefault(v, {})[u] = w
+        art.radj = radj
+
+    if not changes:
+        # pure no-op window (flap fully reverted inside one debounce):
+        # keep the solved state, only the prefix dirt needs reassembly
+        adj2, radj2, spf2 = art.adj, art.radj, spf
+        changed_nodes: set[str] = set()
+        region = 0
+    else:
+        # copy-on-write patched adjacency (rows for changed sources /
+        # dests only; the artifact's maps stay valid for the fallback)
+        adj2 = dict(art.adj)
+        radj2 = dict(art.radj)
+        patched_rows: set[str] = set()
+        patched_rrows: set[str] = set()
+        for u, v, _wo, wn in changes:
+            if u not in patched_rows:
+                adj2[u] = dict(adj2.get(u, {}))
+                patched_rows.add(u)
+            adj2[u][v] = wn
+            if v not in patched_rrows:
+                radj2[v] = dict(radj2.get(v, {}))
+                patched_rrows.add(v)
+            radj2[v][u] = wn
+        # the node budget is the WHOLE graph: the configurable fraction
+        # caps the delta SET (above); the affected region itself is
+        # allowed to grow to the graph — worst case the warm solve
+        # costs one cold solve, and single-link changes near the root
+        # of a uniform-metric graph legitimately touch half of it
+        res = warm_spf(
+            adj2, radj2, spf, art.overloaded_set, my_node, changes,
+            node_budget=n_nodes + 1,
+        )
+        if res is None:
+            return None
+        spf2, changed_nodes, region = res
+
+    art2 = SolveArtifact(
+        my_node=my_node,
+        ls=ls,
+        adj=adj2,
+        spf=spf2,
+        lfa_spfs=None,
+        overloaded_set=art.overloaded_set,
+        ksp_k=art.ksp_k,
+        radj=radj2,
+        min_metric=min_metric,
+    )
+
+    # ---- touched unicast prefixes ------------------------------------
+    # a route can change only if an advertiser's (dist, first-hop) class
+    # changed, or the prefix itself is dirty, or it is KSP (k-disjoint
+    # paths depend on the whole graph, not just advertiser distances)
+    touched: set = set(prefix_dirt)
+    for prefix, per_node in ps.prefixes.items():
+        if prefix in touched:
+            continue
+        for n, e in per_node.items():
+            if (
+                n in changed_nodes
+                or e.forwarding_algorithm
+                == ForwardingAlgorithm.KSP2_ED_ECMP
+            ):
+                touched.add(prefix)
+                break
+    entries = assemble_prefix_routes(art2, ps, touched)
+    rdb = RouteDatabase(this_node_name=my_node)
+    rdb.unicast_routes = dict(cached_rdb.unicast_routes)
+    rdb.mpls_routes = dict(cached_rdb.mpls_routes)
+    for p in touched:
+        e = entries.get(p)
+        if e is None:
+            rdb.unicast_routes.pop(p, None)
+        else:
+            rdb.unicast_routes[p] = e
+
+    # ---- touched MPLS node segments ----------------------------------
+    # node labels are structural (a label change is a full rebuild), so
+    # only CHANGED nodes' segment routes can differ; my own adjacency
+    # labels cannot move (root-incident changes bailed above)
+    touched_labels: set[int] = set()
+    for n in changed_nodes:
+        if n == my_node:
+            continue
+        label = ls.node_label(n)
+        if label < MPLS_LABEL_MIN:
+            continue
+        touched_labels.add(label)
+        entry = _mpls_node_route(ls, my_node, spf2, n, label)
+        if entry is None:
+            rdb.mpls_routes.pop(label, None)
+        else:
+            rdb.mpls_routes[label] = entry
+    return rdb, art2, touched, touched_labels, region

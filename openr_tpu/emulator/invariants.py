@@ -253,14 +253,15 @@ def check_counter_sanity(cluster) -> list[Violation]:
         c = node.counters
         full = c.get("decision.rebuild.full")
         pfx = c.get("decision.rebuild.prefix_only")
+        delta = c.get("decision.rebuild.topo_delta")
         runs = c.get("decision.spf_runs")
-        if full + pfx != runs:
+        if full + pfx + delta != runs:
             out.append(
                 Violation(
                     "counters.rebuild_sum",
                     name,
                     f"rebuild.full({full}) + rebuild.prefix_only({pfx}) "
-                    f"!= spf_runs({runs})",
+                    f"+ rebuild.topo_delta({delta}) != spf_runs({runs})",
                 )
             )
         live_peers = len(node.kvstore.peers)

@@ -79,6 +79,14 @@ class SoakConfig:
     mem_rss_slack_mb: float = 96.0
     mem_obj_rel_tol: float = 0.10
     mem_obj_abs_tol: int = 50_000
+    # warm-start solve-state watermark: the summed
+    # Decision.warm_cache_bytes() across nodes (reverse adjacency /
+    # pred-DAG aux / host distance mirrors held by cached
+    # SolveArtifacts) must stay within this slack of the post-round-1
+    # baseline — the enlarged artifact state the topology-delta path
+    # retains is exactly the leak class a storm-heavy soak would grow
+    # if the idle-trim eviction policy regressed
+    warm_cache_slack_mb: float = 32.0
     # control knob: build the cluster with messaging bounds DISABLED
     # (caps stay configured, queues unbounded) to prove the watermark
     # checks catch unbounded growth
@@ -92,6 +100,7 @@ class RoundSample:
     objects: int
     churn_events: int
     schedule_hash: str
+    warm_mb: float = 0.0  # summed Decision warm-start cache footprint
 
 
 @dataclass
@@ -105,7 +114,8 @@ class SoakReport:
             rss = f"{s.rss_mb:.0f}MB" if s.rss_mb is not None else "n/a"
             lines.append(
                 f"  round {s.round}: rss={rss} objects={s.objects} "
-                f"churn={s.churn_events} schedule={s.schedule_hash[:12]}"
+                f"churn={s.churn_events} warm={s.warm_mb}MB "
+                f"schedule={s.schedule_hash[:12]}"
             )
         return "\n".join(lines)
 
@@ -242,7 +252,7 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
         await cluster.wait_converged(timeout=cfg.quiesce_timeout_s)
         report = SoakReport(seed=cfg.seed)
         churn_rng = plan.rng("soak/churn")
-        baseline: tuple[float | None, int] | None = None
+        baseline: tuple[float | None, int, float] | None = None
         for rnd in range(cfg.rounds):
             plan.active = True
             cluster.make_storm(
@@ -275,6 +285,13 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
             except AssertionError as e:
                 raise SoakError(str(e)) from e
             rss_mb, objects = _memory_sample()
+            warm_mb = (
+                sum(
+                    n.decision.warm_cache_bytes()
+                    for n in cluster.nodes.values()
+                )
+                / 1e6
+            )
             report.rounds.append(
                 RoundSample(
                     round=rnd,
@@ -282,18 +299,27 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                     objects=objects,
                     churn_events=churner.events,
                     schedule_hash=plan.schedule_hash(),
+                    warm_mb=round(warm_mb, 2),
                 )
             )
             log.info(
-                "soak round %d clean: rss=%s objects=%d churn=%d",
-                rnd, rss_mb, objects, churner.events,
+                "soak round %d clean: rss=%s objects=%d churn=%d warm=%.1fMB",
+                rnd, rss_mb, objects, churner.events, warm_mb,
             )
             if rnd == 0:
                 # round 1 is the warmup baseline (JIT caches, interned
                 # bytes); monotone growth is judged from here on
-                baseline = (rss_mb, objects)
+                baseline = (rss_mb, objects, warm_mb)
                 continue
-            base_rss, base_obj = baseline
+            base_rss, base_obj, base_warm = baseline
+            if warm_mb > base_warm + cfg.warm_cache_slack_mb:
+                raise SoakError(
+                    f"warm-cache watermark breach ({context}): "
+                    f"{warm_mb:.1f}MB of warm-start solve state > "
+                    f"baseline {base_warm:.1f}MB + "
+                    f"{cfg.warm_cache_slack_mb:.0f}MB slack "
+                    "(SolveArtifact eviction policy regressed?)"
+                )
             if (
                 rss_mb is not None
                 and base_rss is not None

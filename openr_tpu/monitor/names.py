@@ -46,10 +46,14 @@ COUNTERS: frozenset[str] = frozenset(
         "decision.lsdb_changes",
         "decision.rebuild.full",
         "decision.rebuild.prefix_only",
+        "decision.rebuild.topo_delta",
         "decision.rebuild.cached_areas",
         "decision.rebuild.area_solves",
         "decision.rebuild_ms",
         "decision.spf.solves",
+        "decision.spf.warm_starts",
+        "decision.spf.warm_fallbacks",
+        "decision.spf.warm_region_nodes",
         "decision.spf_ms",
         "decision.spf_runs",
         "decision.spf_solve_ms",
@@ -158,6 +162,7 @@ QUEUE_FIELDS: frozenset[str] = frozenset(
 #: convention and only needs registry membership).
 DOCUMENTED: frozenset[str] = frozenset(
     {n for n in COUNTERS if n.startswith("decision.rebuild.")}
+    | {n for n in COUNTERS if n.startswith("decision.spf.warm_")}
     | {n for n in COUNTERS if n.startswith("kvstore.flood")}
     | {n for n in COUNTERS if n.startswith("fib.program")}
     | {n for n in COUNTERS if n.startswith("ctrl.sub_")}

@@ -20,6 +20,8 @@ docs/Monitor.md — ci.sh lints this):
   REBUILD_FULL        the rebuild took the from-scratch path (SPF solves)
   REBUILD_PREFIX_ONLY the rebuild took the dirty-scoped prefix-only path
                       (zero SPF solves — cached artifacts re-assembled)
+  REBUILD_TOPO_DELTA  the rebuild warm-started from the cached solve
+                      (bounded-region recompute; zero full area solves)
   SPF_SOLVE_DONE      SPF solve + RIB assembly + diff finished
   ROUTE_UPDATE_SENT   the route delta was pushed toward Fib
   FIB_PROGRAMMED      Fib programmed the delta into the dataplane
@@ -45,14 +47,15 @@ DECISION_RECEIVED = "DECISION_RECEIVED"
 DECISION_DEBOUNCED = "DECISION_DEBOUNCED"
 REBUILD_FULL = "REBUILD_FULL"
 REBUILD_PREFIX_ONLY = "REBUILD_PREFIX_ONLY"
+REBUILD_TOPO_DELTA = "REBUILD_TOPO_DELTA"
 SPF_SOLVE_DONE = "SPF_SOLVE_DONE"
 ROUTE_UPDATE_SENT = "ROUTE_UPDATE_SENT"
 FIB_PROGRAMMED = "FIB_PROGRAMMED"
 
 # canonical spark→fib stage order; doubles as the doc-lint source of
-# truth. REBUILD_FULL / REBUILD_PREFIX_ONLY are alternatives at the same
-# stage position — exactly one of them is stamped per rebuild, recording
-# which pipeline the debounced batch took.
+# truth. REBUILD_FULL / REBUILD_PREFIX_ONLY / REBUILD_TOPO_DELTA are
+# alternatives at the same stage position — exactly one of them is
+# stamped per rebuild, recording which pipeline the debounced batch took.
 ALL_MARKERS = (
     NEIGHBOR_EVENT,
     ADJ_DB_UPDATED,
@@ -61,6 +64,7 @@ ALL_MARKERS = (
     DECISION_DEBOUNCED,
     REBUILD_FULL,
     REBUILD_PREFIX_ONLY,
+    REBUILD_TOPO_DELTA,
     SPF_SOLVE_DONE,
     ROUTE_UPDATE_SENT,
     FIB_PROGRAMMED,

@@ -243,6 +243,47 @@ def _compact_ids(mask_ids, vp, cap, dead):
     return jnp.where(ids < vp, ids, dead)
 
 
+def _make_dense_sweep(
+    base_nbr, base_wgt, ov_ids, ov_nbr, ov_wgt,
+    over_base, over_ov, roots, has_overloads, gs,
+):
+    """Trace-time builder for the (optionally Gauss-Seidel-chunked)
+    dense relax sweep, shared by the cold and warm-start kernels."""
+    vp, w = base_nbr.shape
+    b = roots.shape[0]
+    csz = vp // gs
+
+    def dense_sweep(dist):
+        if gs == 1:
+            new = _relax_rows(
+                dist, base_nbr, base_wgt, over_base, roots, has_overloads
+            )
+            new = jnp.minimum(new, dist)
+        else:
+            def chunk(c, dist):
+                o = c * csz
+                nbr = jax.lax.dynamic_slice(base_nbr, (o, 0), (csz, w))
+                wgt = jax.lax.dynamic_slice(base_wgt, (o, 0), (csz, w))
+                ovl = (
+                    jax.lax.dynamic_slice(over_base, (o, 0), (csz, w))
+                    if has_overloads
+                    else None
+                )
+                blk = _relax_rows(dist, nbr, wgt, ovl, roots, has_overloads)
+                cur = jax.lax.dynamic_slice(dist, (o, 0), (csz, b))
+                return jax.lax.dynamic_update_slice(
+                    dist, jnp.minimum(blk, cur), (o, 0)
+                )
+
+            new = jax.lax.fori_loop(0, gs, chunk, dist)
+        ov_new = _relax_rows(
+            dist, ov_nbr, ov_wgt, over_ov, roots, has_overloads
+        )
+        return new.at[ov_ids].min(ov_new)
+
+    return dense_sweep
+
+
 GS_CHUNKS = 4
 # Below this many node rows, chunked sweeps cost more in fori_loop /
 # dynamic-slice overhead than the sweep-count win is worth
@@ -313,35 +354,10 @@ def batched_sssp_split(
     gs = gs_chunks if gs_chunks is not None else pick_gs_chunks(vp)
     if vp % gs:  # explicit override that doesn't divide: no chunking
         gs = 1
-    csz = vp // gs
-
-    def dense_sweep(dist):
-        if gs == 1:
-            new = _relax_rows(
-                dist, base_nbr, base_wgt, over_base, roots, has_overloads
-            )
-            new = jnp.minimum(new, dist)
-        else:
-            def chunk(c, dist):
-                o = c * csz
-                nbr = jax.lax.dynamic_slice(base_nbr, (o, 0), (csz, w))
-                wgt = jax.lax.dynamic_slice(base_wgt, (o, 0), (csz, w))
-                ovl = (
-                    jax.lax.dynamic_slice(over_base, (o, 0), (csz, w))
-                    if has_overloads
-                    else None
-                )
-                blk = _relax_rows(dist, nbr, wgt, ovl, roots, has_overloads)
-                cur = jax.lax.dynamic_slice(dist, (o, 0), (csz, b))
-                return jax.lax.dynamic_update_slice(
-                    dist, jnp.minimum(blk, cur), (o, 0)
-                )
-
-            new = jax.lax.fori_loop(0, gs, chunk, dist)
-        ov_new = _relax_rows(
-            dist, ov_nbr, ov_wgt, over_ov, roots, has_overloads
-        )
-        return new.at[ov_ids].min(ov_new)
+    dense_sweep = _make_dense_sweep(
+        base_nbr, base_wgt, ov_ids, ov_nbr, ov_wgt,
+        over_base, over_ov, roots, has_overloads, gs,
+    )
 
     # ---- phase 1: dense sweeps while the changed set is large ----------
     # carry: (dist, changed mask of the last sweep, its count, iter)
@@ -497,6 +513,147 @@ def batched_sssp_split_rib(
         lfa = lfa_matrix(dist, my_id, nbr_ids, nbr_over)
         parts.append(jnp.packbits(lfa, axis=1).reshape(-1))
     return dist, jnp.concatenate(parts)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "has_overloads", "tail_cap", "tail_rounds_cap", "gs_chunks",
+    ),
+)
+def batched_sssp_split_warm_rib(
+    base_nbr: jax.Array,
+    base_wgt: jax.Array,
+    ov_ids: jax.Array,
+    ov_nbr: jax.Array,
+    ov_wgt: jax.Array,
+    out_nbr: jax.Array,
+    node_overloaded: jax.Array,
+    roots: jax.Array,        # [B]: col 0 = the RIB root, 1.. = neighbors
+    nbr_metric: jax.Array,   # [B-1] i32 metric(root → neighbor i)
+    nbr_ids: jax.Array,      # [B-1] i32 (padding → dead slot)
+    nbr_over: jax.Array,     # [B-1] bool (padding → True)
+    dist0: jax.Array,        # [vp, B] warm init (see below)
+    seed_mask: jax.Array,    # [vp] bool: nodes whose dist may change
+    has_overloads: bool = False,
+    tail_cap: int = 8192,
+    tail_rounds_cap: int = 64,
+    gs_chunks: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Warm-start production solve after a bounded metric-only delta
+    (DeltaPath 1808.06893 / delta-stepping 2105.06145 shape): same
+    fixpoint, packed outputs, and byte layout as
+    `batched_sssp_split_rib`, but seeded from the PREVIOUS solve.
+
+    Soundness: the relax system is a monotone min fixpoint — from any
+    per-entry UPPER bound of the true distances (with dist[root] = 0)
+    the sweeps converge to exactly the cold-start fixpoint. The caller
+    builds `dist0` as the previous distance matrix with the raised
+    edges' conservative downstream cones scattered to INF (everything
+    outside a cone can only improve, so its old value IS an upper
+    bound), and `seed_mask` as cone ∪ lowered-edge heads. The kernel
+    then runs frontier rounds that relax only the seeds and whatever
+    they reach — bounded-region cost, truncated exactly where old
+    distances already stand (Bounded Dijkstra 1903.00436) — with the
+    cold kernel's spill-to-dense safety net keeping exactness if the
+    frontier outgrows its static capacity.
+    """
+    vp = base_nbr.shape[0]
+    b = roots.shape[0]
+    dead = vp - 1
+    iota = jnp.arange(vp, dtype=jnp.int32)
+
+    if has_overloads:
+        over_base = node_overloaded[base_nbr]
+        over_ov = node_overloaded[ov_nbr]
+    else:
+        over_base = over_ov = None
+    gs = gs_chunks if gs_chunks is not None else pick_gs_chunks(vp)
+    if vp % gs:
+        gs = 1
+    dense_sweep = _make_dense_sweep(
+        base_nbr, base_wgt, ov_ids, ov_nbr, ov_wgt,
+        over_base, over_ov, roots, has_overloads, gs,
+    )
+
+    dist = dist0
+    frontier = _compact_ids(
+        jnp.where(seed_mask, iota, vp), vp, tail_cap, dead
+    )
+    entry_spill = seed_mask.sum() > tail_cap
+
+    def cond_t(state):
+        _dist, frontier, spilled, it = state
+        return (frontier[0] != dead) & (~spilled) & (it < tail_rounds_cap)
+
+    def body_t(state):
+        dist, frontier, _sp, it = state
+        # rows whose pull could change = the frontier ITSELF (cone
+        # nodes must re-pull their boundary tentatives — their
+        # in-neighbors did not change) ∪ its out-neighbors (decrease
+        # propagation); the cold tail only needs the latter because its
+        # frontier is always "rows that just changed"
+        exp = jnp.sort(
+            jnp.concatenate([out_nbr[frontier].reshape(-1), frontier])
+        )
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), exp[1:] != exp[:-1]]
+        ) & (exp != dead)
+        spilled = first.sum() > tail_cap
+        rows = _compact_ids(jnp.where(first, exp, vp), vp, tail_cap, dead)
+        sub_new = _relax_rows(
+            dist, base_nbr[rows], base_wgt[rows],
+            over_base[rows] if has_overloads else None,
+            roots, has_overloads,
+        )
+        ov_new = _relax_rows(
+            dist, ov_nbr, ov_wgt, over_ov, roots, has_overloads
+        )
+        dist2 = dist.at[rows].min(sub_new)
+        dist2 = dist2.at[ov_ids].min(ov_new)
+        changed_rows = (dist2[rows] < dist[rows]).any(axis=1)
+        ov_changed = (dist2[ov_ids] < dist[ov_ids]).any(axis=1)
+        both = jnp.concatenate(
+            [
+                jnp.where(changed_rows, rows, vp),
+                jnp.where(ov_changed, ov_ids, vp),
+            ]
+        )
+        srt = jnp.sort(both)
+        firstb = jnp.concatenate(
+            [jnp.ones((1,), bool), srt[1:] != srt[:-1]]
+        ) & (srt < vp)
+        spilled = spilled | (firstb.sum() > tail_cap)
+        nf = _compact_ids(jnp.where(firstb, srt, vp), vp, tail_cap, dead)
+        return dist2, nf, spilled, it + 1
+
+    dist, frontier, spilled, _ = jax.lax.while_loop(
+        cond_t, body_t, (dist, frontier, entry_spill, jnp.int32(0))
+    )
+
+    # exactness net: dense sweeps to fixpoint if the tail spilled or hit
+    # its round cap with work left (identical to the cold kernel's)
+    def cond_d(state):
+        _dist, changed, it = state
+        return changed & (it < vp)
+
+    def body_d(state):
+        dist, _c, it = state
+        new = dense_sweep(dist)
+        return new, jnp.any(new < dist), it + 1
+
+    dist, _, _ = jax.lax.while_loop(
+        cond_d, body_d, (dist, spilled | (frontier[0] != dead), jnp.int32(0))
+    )
+
+    fh = first_hop_matrix(dist, nbr_metric, nbr_ids, nbr_over)
+    packed = jnp.concatenate(
+        [
+            jax.lax.bitcast_convert_type(dist[:, 0], jnp.uint8).reshape(-1),
+            jnp.packbits(fh, axis=1).reshape(-1),
+        ]
+    )
+    return dist, packed
 
 
 _BYTE_ORDER_OK: bool | None = None

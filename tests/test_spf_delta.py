@@ -1,0 +1,467 @@
+"""Topology-delta warm-start tests (docs/Decision.md).
+
+The contract under test: a bounded metric-only topology delta (link
+flap / metric change) takes the REBUILD_TOPO_DELTA warm-start path —
+`decision.rebuild.topo_delta` increments, `decision.rebuild.full` and
+the per-area full-solve counter stay flat — and every warm round stays
+BYTE-EQUAL with a from-scratch `compute_rib`, proven by seeded
+randomized flap sequences (metric increase + decrease, flap-then-
+revert, node down, cross-area) on both engines, plus a direct
+`warm_spf` vs `run_spf` fuzz.
+"""
+
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+
+from openr_tpu.common.constants import DEFAULT_AREA, adj_key, prefix_key
+from openr_tpu.config import Config, NodeConfig
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.oracle import run_spf, warm_spf
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters
+from openr_tpu.types.kvstore import Publication, Value
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.serde import to_wire
+from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+from openr_tpu.utils import topogen
+
+
+def run(coro):
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
+
+
+def mk_decision(backend="cpu", name="node-0"):
+    cfg = Config(NodeConfig(node_name=name))
+    # the native single-root engine has no warm path (its artifact
+    # carries no neighbor distance columns): pin the batched kernel so
+    # the tpu parametrization exercises the warm kernel deterministically
+    cfg.node.decision.native_rib = "off"
+    pubs = ReplicateQueue(name="pubs")
+    routes = ReplicateQueue(name="routes")
+    return Decision(
+        cfg, pubs.get_reader(), routes, solver=backend, counters=Counters()
+    )
+
+
+def adj_pub(adj_dbs, area=DEFAULT_AREA, version=1):
+    return Publication(
+        area=area,
+        key_vals={
+            adj_key(db.this_node_name): Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(db),
+            ).with_hash()
+            for db in adj_dbs
+        },
+    )
+
+
+def prefix_pub(prefix_dbs, area=DEFAULT_AREA, version=1):
+    kv = {}
+    for db in prefix_dbs:
+        for e in db.prefix_entries:
+            key = prefix_key(db.this_node_name, area, str(e.prefix.prefix))
+            kv[key] = Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(
+                    PrefixDatabase(
+                        this_node_name=db.this_node_name,
+                        prefix_entries=(e,),
+                        area=area,
+                    )
+                ),
+            ).with_hash()
+    return Publication(area=area, key_vals=kv)
+
+
+def one_prefix_pub(node, pstr, area=DEFAULT_AREA, version=1):
+    return prefix_pub(
+        [
+            PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=(PrefixEntry(prefix=IpPrefix(prefix=pstr)),),
+                area=area,
+            )
+        ],
+        area=area,
+        version=version,
+    )
+
+
+def assert_parity(d, step=None):
+    """The warm-start pipeline's published RIB must be byte-equal to a
+    from-scratch compute over the same LSDB."""
+    ref = d.compute_rib()
+    assert d.rib.unicast_routes == ref.unicast_routes, step
+    assert d.rib.mpls_routes == ref.mpls_routes, step
+
+
+def flap_pub(adj_cur, node, k, metric, version, area=DEFAULT_AREA):
+    """Re-advertise `node`'s adjacency db with adjacency k's metric set
+    to `metric` (one directed link's weight — a metric-only delta)."""
+    db = adj_cur[node]
+    adjs = list(db.adjacencies)
+    adjs[k] = dataclasses.replace(adjs[k], metric=metric)
+    db = dataclasses.replace(db, adjacencies=tuple(adjs))
+    adj_cur[node] = db
+    return adj_pub([db], version=version, area=area)
+
+
+# ---------------------------------------------------------------- warm_spf
+
+
+def _random_graph(rng, n):
+    adj = {f"n{i}": {} for i in range(n)}
+    for i in range(n):
+        for _ in range(int(rng.integers(1, 5))):
+            j = int(rng.integers(0, n))
+            if j != i:
+                adj[f"n{i}"][f"n{j}"] = int(rng.integers(1, 12))
+    radj = {}
+    for u, vs in adj.items():
+        for v, w in vs.items():
+            radj.setdefault(v, {})[u] = w
+    return adj, radj
+
+
+class _LsStub:
+    def __init__(self, overloaded):
+        self._over = overloaded
+
+    def is_node_overloaded(self, x):
+        return x in self._over
+
+
+def test_warm_spf_fuzz_vs_run_spf():
+    """Direct fuzz: warm_spf after random batched metric changes equals
+    run_spf from scratch — dist, preds AND first-hop sets — across
+    random graphs, with and without overloaded (no-transit) nodes."""
+    rng = np.random.default_rng(7)
+    for _trial in range(120):
+        n = int(rng.integers(5, 28))
+        adj, radj = _random_graph(rng, n)
+        overloaded = (
+            {f"n{int(rng.integers(1, n))}"} if rng.integers(0, 3) == 0 else set()
+        )
+        root = "n0"
+        old = run_spf(_LsStub(overloaded), root, adj)
+        edges = [(u, v) for u, vs in adj.items() for v in vs]
+        adj2 = {u: dict(vs) for u, vs in adj.items()}
+        radj2 = {u: dict(vs) for u, vs in radj.items()}
+        changes, seen = [], set()
+        for _ in range(int(rng.integers(1, 4))):
+            u, v = edges[int(rng.integers(0, len(edges)))]
+            if (u, v) in seen or u == root:
+                continue
+            seen.add((u, v))
+            wo, wn = adj[u][v], int(rng.integers(1, 12))
+            if wn == wo:
+                continue
+            changes.append((u, v, wo, wn))
+            adj2[u][v] = wn
+            radj2[v][u] = wn
+        res = warm_spf(adj2, radj2, old, overloaded, root, changes, n + 1)
+        assert res is not None
+        spf2, changed, _region = res
+        ref = run_spf(_LsStub(overloaded), root, adj2)
+        assert spf2.dist == ref.dist
+        assert spf2.first_hops == ref.first_hops
+        assert spf2.preds == ref.preds
+        # the changed-node report covers every route-visible difference
+        for x in set(old.dist) | set(ref.dist):
+            if old.dist.get(x) != ref.dist.get(x):
+                assert x in changed
+            if old.first_hops.get(x) != ref.first_hops.get(x):
+                assert x in changed
+
+
+# ------------------------------------------------------------ decision path
+
+
+def test_metric_change_zero_full_solves_320_grid():
+    """Acceptance gate: a single-link metric change on a >=320-node grid
+    triggers ZERO full per-area solves — `decision.rebuild.topo_delta`
+    increments, `decision.rebuild.full` does not — and the warm RIB is
+    byte-equal to from-scratch."""
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.grid(18, 18)  # 324 nodes
+        assert len(adj_dbs) >= 320
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 1
+
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        solves0 = d._area_solves
+        d.process_publication(flap_pub(adj_cur, "node-200", 0, 9, 2))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 1
+        assert d.counters.get("decision.rebuild.full") == 1  # unchanged
+        assert d.counters.get("decision.spf.warm_starts") == 1
+        assert d._area_solves == solves0  # zero full area solves
+        assert_parity(d)
+
+    run(body())
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_increase_decrease_and_revert(backend):
+    """Metric increase, decrease, and flap-then-revert all take the
+    warm path with byte parity; after the revert the RIB returns to the
+    original routes exactly."""
+
+    async def body():
+        d = mk_decision(backend)
+        adj_dbs, prefix_dbs = topogen.grid(5, 5, metric=10)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        base_unicast = dict(d.rib.unicast_routes)
+        base_mpls = dict(d.rib.mpls_routes)
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        engine0 = d._tpu.warm_solves if d._tpu is not None else None
+
+        # increase
+        d.process_publication(flap_pub(adj_cur, "node-7", 1, 30, 2))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 1
+        assert_parity(d, "increase")
+        # decrease on another link
+        d.process_publication(flap_pub(adj_cur, "node-12", 0, 2, 3))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 2
+        assert_parity(d, "decrease")
+        # revert both (flap-then-revert)
+        d.process_publication(flap_pub(adj_cur, "node-7", 1, 10, 4))
+        await d._rebuild_routes()
+        d.process_publication(flap_pub(adj_cur, "node-12", 0, 10, 5))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") >= 3
+        assert d.counters.get("decision.rebuild.full") == 1
+        assert_parity(d, "revert")
+        assert d.rib.unicast_routes == base_unicast
+        assert d.rib.mpls_routes == base_mpls
+        if engine0 is not None:
+            assert d._tpu.warm_solves > engine0  # the kernel warm path ran
+
+    run(body())
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_randomized_flap_sequence_parity(backend):
+    """Parity contract: after EVERY rebuild of a seeded randomized
+    flap sequence — metric churn mixed with prefix churn, node-down
+    (adj expiry) and node re-advertisement — the incremental RIB equals
+    a from-scratch compute_rib, on both engines, and the warm path was
+    actually exercised."""
+
+    async def body():
+        d = mk_decision(backend)
+        adj_dbs, prefix_dbs = topogen.fat_tree(4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        assert_parity(d, "initial")
+
+        rng = np.random.default_rng(1234)
+        names = [db.this_node_name for db in adj_dbs]
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        expired: set[str] = set()
+        for step in range(20):
+            op = int(rng.integers(0, 10))
+            name = names[int(rng.integers(1, len(names)))]  # never self
+            if op < 6 and name not in expired:
+                # metric flap — the warm-start path
+                db = adj_cur[name]
+                k = int(rng.integers(0, len(db.adjacencies)))
+                pub = flap_pub(
+                    adj_cur, name, k, int(rng.integers(1, 32)), step + 2
+                )
+            elif op < 8:
+                # prefix advertise/withdraw riding the same windows
+                i = int(rng.integers(0, len(names)))
+                pstr = f"10.45.{i}.0/24"
+                if rng.integers(0, 2):
+                    pub = one_prefix_pub(names[i], pstr, version=step + 2)
+                else:
+                    pub = Publication(
+                        expired_keys=[
+                            prefix_key(names[i], DEFAULT_AREA, pstr)
+                        ]
+                    )
+            elif op < 9 and name not in expired:
+                # node down via adj-key expiry (structural -> full)
+                expired.add(name)
+                pub = Publication(expired_keys=[adj_key(name)])
+            else:
+                # (re-)advertise the node's adjacency db
+                expired.discard(name)
+                pub = adj_pub([adj_cur[name]], version=step + 2)
+            d.process_publication(pub)
+            await d._rebuild_routes()
+            assert_parity(d, f"step {step}")
+        assert d.counters.get("decision.rebuild.topo_delta") > 0
+
+    run(body())
+
+
+def test_node_down_falls_back_to_full():
+    """An adj-key expiry (node down) is structural: the rebuild takes
+    the full path, never a stale warm start — and parity holds."""
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.ring(5)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        d.process_publication(Publication(expired_keys=[adj_key("node-2")]))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 2
+        assert d.counters.get("decision.rebuild.topo_delta") == 0
+        assert_parity(d)
+
+    run(body())
+
+
+def test_root_incident_flap_falls_back_to_full():
+    """A metric change on MY OWN adjacency moves my nexthop interface
+    selection: the warm attempt must refuse (decision.spf.warm_fallbacks)
+    and the round goes full — with parity."""
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.grid(4, 4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        d.process_publication(flap_pub(adj_cur, "node-0", 0, 21, 2))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 2
+        assert d.counters.get("decision.rebuild.topo_delta") == 0
+        assert d.counters.get("decision.spf.warm_fallbacks") == 1
+        assert_parity(d)
+
+    run(body())
+
+
+def test_cross_area_delta_keeps_clean_area_cached():
+    """Metric dirt in one area must not touch the other: the clean
+    area's RIB is reused (decision.rebuild.cached_areas) while the
+    dirty area warm-starts, and the scoped cross-area merge (unicast +
+    MPLS labels) stays byte-equal."""
+
+    async def body():
+        d = mk_decision("cpu")
+        ring_a, pfx_a = topogen.ring(4)
+        ring_b, pfx_b = topogen.ring(5, metric=7)
+        d.process_publication(adj_pub(ring_a, area="a"))
+        d.process_publication(prefix_pub(pfx_a, area="a"))
+        d.process_publication(adj_pub(ring_b, area="b"))
+        d.process_publication(prefix_pub(pfx_b, area="b"))
+        await d._rebuild_routes()
+        assert_parity(d, "initial")
+
+        solves0 = d._area_solves
+        adj_cur = {db.this_node_name: db for db in ring_b}
+        d.process_publication(
+            flap_pub(adj_cur, "node-2", 0, 19, 2, area="b")
+        )
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 1
+        # area "a" AND the (empty) configured default area both reused
+        assert d.counters.get("decision.rebuild.cached_areas") == 2
+        assert d._area_solves == solves0
+        assert_parity(d, "after warm")
+
+    run(body())
+
+
+def test_topo_delta_disabled_takes_full_path():
+    """enable_topo_delta=False forces every topology change down the
+    full path (the pre-PR behavior)."""
+
+    async def body():
+        d = mk_decision("cpu")
+        d.config.node.decision.enable_topo_delta = False
+        adj_dbs, prefix_dbs = topogen.grid(4, 4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        d.process_publication(flap_pub(adj_cur, "node-5", 0, 13, 2))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 2
+        assert d.counters.get("decision.rebuild.topo_delta") == 0
+        assert_parity(d)
+
+    run(body())
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_mixed_topo_and_prefix_dirt_one_window(backend):
+    """A metric flap and a prefix advertisement coalesced into ONE
+    debounce window take a single topo_delta round that lands BOTH
+    changes, byte-equal to from-scratch."""
+
+    async def body():
+        d = mk_decision(backend)
+        adj_dbs, prefix_dbs = topogen.grid(4, 4)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        new = IpPrefix(prefix="10.99.0.0/24")
+        d.process_publication(flap_pub(adj_cur, "node-9", 1, 27, 2))
+        d.process_publication(one_prefix_pub("node-3", "10.99.0.0/24"))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 1
+        assert new in d.rib.unicast_routes
+        assert_parity(d)
+
+    run(body())
+
+
+def test_warm_trim_frees_state_and_rearms():
+    """trim_warm_state() reclaims the warm-only artifact memory
+    (warm_cache_bytes drops to zero); the next topology delta pays ONE
+    re-arming full solve, after which the warm path resumes."""
+
+    async def body():
+        d = mk_decision("cpu")
+        adj_dbs, prefix_dbs = topogen.grid(5, 5)
+        d.process_publication(adj_pub(adj_dbs))
+        d.process_publication(prefix_pub(prefix_dbs))
+        await d._rebuild_routes()
+        adj_cur = {db.this_node_name: db for db in adj_dbs}
+        d.process_publication(flap_pub(adj_cur, "node-7", 0, 17, 2))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 1
+        grown = d.warm_cache_bytes()
+        assert grown > 0  # radj + preds retained
+        d.trim_warm_state()
+        assert d.warm_cache_bytes() == 0
+        # next delta: preds gone -> one full re-arming solve, counted
+        # as a warm fallback, with parity intact
+        d.process_publication(flap_pub(adj_cur, "node-7", 0, 3, 3))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.full") == 2
+        assert d.counters.get("decision.spf.warm_fallbacks") == 1
+        assert_parity(d)
+        # ...and the path resumes on the flap after that
+        d.process_publication(flap_pub(adj_cur, "node-7", 0, 9, 4))
+        await d._rebuild_routes()
+        assert d.counters.get("decision.rebuild.topo_delta") == 2
+        assert_parity(d)
+
+    run(body())
