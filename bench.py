@@ -702,15 +702,41 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     # ---- TPU batched engine (v3 split kernel) -------------------------
     # OPENR_BENCH_TRACE=<dir> captures an xprof trace of the timed
     # iterations (SURVEY §5.1; solve/assembly phases are annotated)
-    from openr_tpu.monitor import profiling
+    from openr_tpu.monitor import compile_ledger, profiling
+
+    # Per-stage compile split: every stage warms with one first call
+    # that pays trace+XLA-compile; _compiled() times it and attributes
+    # the ledger's compile delta to the stage, so BENCH_r0x trajectories
+    # report compile_ms/compiles per stage SEPARATELY from the
+    # steady-state p50s (which, post-warmup, must be pure cache hits —
+    # the headline loop's compile count is asserted into the row too).
+    led = compile_ledger.install()
+    compile_stages: dict = {}
+
+    def _compiled(stage: str, fn):
+        before = led.snapshot()
+        t0 = time.perf_counter()
+        out = fn()
+        ms = (time.perf_counter() - t0) * 1e3
+        compile_stages[stage] = {
+            "compile_ms": round(ms, 3),
+            "compiles": sum(before.delta(led.snapshot()).values()),
+        }
+        return out
+
+    detail["compile"] = compile_stages
 
     tpu = TpuSpfSolver(native_rib="off")  # batched kernel path
     part["stage"] = "kernel-compile+warmup"
     _sidecar_flush(part)
-    for w in range(warmup):
+    solved = _compiled("headline-solve", lambda: tpu.solve(ls, "node-0"))
+    part["stage"] = f"warmup 1/{warmup} done"
+    _sidecar_flush(part)
+    for w in range(1, warmup):
         solved = tpu.solve(ls, "node-0")
         part["stage"] = f"warmup {w + 1}/{warmup} done"
         _sidecar_flush(part)
+    led.mark_warm()
     times = []
     with profiling.trace(os.environ.get("OPENR_BENCH_TRACE")):
         for i in range(iters):
@@ -722,6 +748,15 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
             part["stage"] = f"headline-solve {i + 1}/{iters}"
             part["value"] = round(_p50_p99(times)[0], 3)
             _sidecar_flush(part)
+    steady = led.compiles_since_warm()
+    led.reset_warm()
+    compile_stages["headline-solve"]["steady_state_compiles"] = sum(
+        steady.values()
+    )
+    if steady:  # name the leak — this is the row a regression shows in
+        compile_stages["headline-solve"]["steady_state_fns"] = sorted(
+            steady
+        )
     solve_p50, solve_p99 = _p50_p99(times)
     _csr, dist, _fh, nbr_ids, _ = solved
     detail["spf_batch"] = int(dist.shape[1])
@@ -838,7 +873,9 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     # plain-prefix path + MPLS node segments)
     part["stage"] = "full-rib"
     _sidecar_flush(part)
-    tpu.compute_routes(ls, ps, "node-0")  # warm assembly caches
+    _compiled(  # warm assembly caches; splits RIB-path compile cost
+        "full-rib", lambda: tpu.compute_routes(ls, ps, "node-0")
+    )
     times_full = []
     for _ in range(max(2, iters // 2)):
         t0 = time.perf_counter()
@@ -864,7 +901,9 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
         n_nodes, avg_degree=AVG_DEGREE, seed=0, max_metric=1
     )
     uniform_before = tpu.spf_kernel_stats["uniform_metric"]
-    tpu.solve(ls_h, "node-0")  # table upload + warm run
+    # table upload + warm run — same table shapes as the headline, so
+    # `compiles` here MUST come out 0 (any recompile is a bucket leak)
+    _compiled("hop-metric-regime", lambda: tpu.solve(ls_h, "node-0"))
     hop_times = []
     for _ in range(max(3, iters // 2)):
         t0 = time.perf_counter()
@@ -885,8 +924,12 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     part["stage"] = "b256-all-sources"
     _sidecar_flush(part)
     b256 = np.arange(256, dtype=np.int32) % csr.num_nodes
-    warm = tpu._solve_dist(csr, b256)  # compile + run
-    float(np.asarray(warm[:, 0]).sum())  # drain the warmup execution
+
+    def _b256_warm():  # compile + run, drained so the compile is paid here
+        warm = tpu._solve_dist(csr, b256)
+        float(np.asarray(warm[:, 0]).sum())
+
+    _compiled("b256-all-sources", _b256_warm)
     b256_times = []
     for _ in range(3):  # p50-of-3: a single tunnel hiccup moved this
         t0 = time.perf_counter()  # row 13% in the r5 window (538 vs

@@ -252,13 +252,14 @@ def measure_prefix_churn(
     `rebuild_full`, `area_solves`, `engine_solves`).
     """
     from openr_tpu.common import constants as C
-    from openr_tpu.monitor import Counters
+    from openr_tpu.monitor import Counters, compile_ledger
     from openr_tpu.types.kvstore import Publication, Value
     from openr_tpu.types.network import IpPrefix
     from openr_tpu.types.serde import to_wire
     from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
     from openr_tpu.utils import topogen
 
+    led = compile_ledger.install()
     k = max(4, int(round((nodes * 4 / 5) ** 0.5 / 2)) * 2)
     adj_dbs, prefix_dbs = topogen.fat_tree(k, metric=10)
     counters = Counters()
@@ -277,6 +278,11 @@ def measure_prefix_churn(
         await dec._rebuild_routes()  # initial full build (jit compile)
         solves0 = dec._area_solves
         for r in range(rounds):
+            if r == warmup_rounds:
+                # post-warmup rounds must be pure jit-cache hits: any
+                # later XLA compile is a ledger violation the smoke
+                # lane exits 1 on
+                led.mark_warm()
             for _ in range(burst):
                 i = int(rng.integers(0, pool_n))
                 node = names[i % len(names)]
@@ -314,6 +320,8 @@ def measure_prefix_churn(
         return samples, solves0
 
     samples, solves0 = asyncio.new_event_loop().run_until_complete(run())
+    steady_compiles = led.compiles_since_warm()
+    led.reset_warm()
     arr = np.array(samples) if samples else np.array([0.0])
     engine_solves = (
         dec._tpu.solve_count if dec._tpu is not None else dec._area_solves
@@ -321,6 +329,8 @@ def measure_prefix_churn(
     return {
         "prefix_churn_p50_ms": round(float(np.percentile(arr, 50)), 3),
         "prefix_churn_p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "steady_state_compiles": sum(steady_compiles.values()),
+        "steady_state_compile_fns": sorted(steady_compiles),
         "nodes": len(adj_dbs),
         "rounds": rounds,
         "burst": burst,
@@ -369,9 +379,10 @@ def measure_topo_churn(
     """
     import dataclasses
 
-    from openr_tpu.monitor import Counters
+    from openr_tpu.monitor import Counters, compile_ledger
     from openr_tpu.utils import topogen
 
+    led = compile_ledger.install()
     side = max(2, int(round(nodes ** 0.5)))
     adj_dbs, prefix_dbs = topogen.grid(side, side)
     counters = Counters()
@@ -407,6 +418,12 @@ def measure_topo_churn(
         parity_solves = 0
         last: tuple | None = None
         for r in range(rounds):
+            if r == warmup_rounds:
+                # zero-steady-state-recompile gate (ci.sh smoke lane):
+                # every post-warmup round — warm kernel, cone scatter,
+                # patch scatter, parity compute_rib — must hit the jit
+                # cache; the ledger counts anything that doesn't
+                led.mark_warm()
             if last is not None and revert_every and r % revert_every == 0:
                 node, k, old_metric = last
                 flap(node, k, old_metric)  # flap-then-revert
@@ -445,6 +462,8 @@ def measure_topo_churn(
         return samples, solves0, parity_solves
 
     samples, solves0, parity_solves = asyncio.run(run())
+    steady_compiles = led.compiles_since_warm()
+    led.reset_warm()
     arr = np.array(samples) if samples else np.array([0.0])
     engine_solves = (
         dec._tpu.solve_count if dec._tpu is not None else dec._area_solves
@@ -453,6 +472,8 @@ def measure_topo_churn(
     return {
         "topo_churn_p50_ms": round(float(np.percentile(arr, 50)), 3),
         "topo_churn_p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "steady_state_compiles": sum(steady_compiles.values()),
+        "steady_state_compile_fns": sorted(steady_compiles),
         "nodes": len(adj_dbs),
         "rounds": rounds,
         "engine": solver,
@@ -473,6 +494,31 @@ def measure_topo_churn(
         "engine_warm_solves": warm_engine,
         "parity": parity[0],
     }
+
+
+def _smoke_gate(label: str, scoped: dict, checks: dict[str, bool]) -> None:
+    """Shared CI-gate core for the churn smoke lanes: every named check
+    must hold, plus the clause common to EVERY lane — zero post-warmup
+    XLA compiles (the compile-ledger invariant; a steady-state recompile
+    means a shape leaked past the padding buckets, docs/Linting.md
+    OR008-OR010). On failure: one diagnostic line naming the failed
+    checks with the full counter row, then exit 1."""
+    checks = dict(checks)
+    checks["zero steady-state compiles"] = (
+        scoped["steady_state_compiles"] == 0
+    )
+    failed = [name for name, ok in checks.items() if not ok]
+    if not failed:
+        return
+    counters = {
+        k: v for k, v in scoped.items() if not k.endswith("_ms")
+    }
+    print(
+        f"{label} smoke FAILED: {'; '.join(failed)} — "
+        f"counters: {json.dumps(counters)}",
+        file=sys.stderr,
+    )
+    sys.exit(1)
 
 
 def main() -> None:
@@ -512,10 +558,14 @@ def main() -> None:
     ap.add_argument("--topo-rounds", type=int, default=60)
     ap.add_argument(
         "--smoke", action="store_true",
-        help="with --topo-churn: CI gate mode — byte-parity checked "
+        help="CI gate mode. With --topo-churn: byte-parity checked "
         "against from-scratch compute_rib every few rounds, and the "
         "process exits 1 unless the warm-start path was actually taken "
-        "(counter-asserted) and parity held",
+        "(counter-asserted) and parity held. With --prefix-churn: the "
+        "scoped path must run zero SPF solves. Both paths additionally "
+        "assert ZERO post-warmup XLA compiles via the runtime compile "
+        "ledger (monitor/compile_ledger.py) — a steady-state recompile "
+        "means a shape leaked past the padding buckets",
     )
     args = ap.parse_args()
     if args.backend == "cpu":
@@ -561,24 +611,15 @@ def main() -> None:
             # CI gate: the warm path must actually have been taken —
             # a single-link metric change must never pay a full
             # per-area solve — and byte-parity must hold
-            ok = (
-                scoped["parity"] == "ok"
-                and scoped["rebuild_topo_delta"] >= args.topo_rounds - 2
-                and scoped["rebuild_full"] == 1  # the initial build only
-                and scoped["warm_starts"] > 0
-                and scoped["churn_area_solves"] == 0
-            )
-            if not ok:
-                print(
-                    "topo-churn smoke FAILED: "
-                    f"parity={scoped['parity']} "
-                    f"topo_delta={scoped['rebuild_topo_delta']} "
-                    f"full={scoped['rebuild_full']} "
-                    f"warm={scoped['warm_starts']} "
-                    f"churn_solves={scoped['churn_area_solves']}",
-                    file=sys.stderr,
-                )
-                sys.exit(1)
+            _smoke_gate("topo-churn", scoped, {
+                "parity": scoped["parity"] == "ok",
+                "warm path every round": (
+                    scoped["rebuild_topo_delta"] >= args.topo_rounds - 2
+                ),
+                "one initial full build": scoped["rebuild_full"] == 1,
+                "warm starts taken": scoped["warm_starts"] > 0,
+                "zero churn solves": scoped["churn_area_solves"] == 0,
+            })
         return
 
     if args.prefix_churn:
@@ -614,6 +655,17 @@ def main() -> None:
                 }
             )
         )
+        if args.smoke and scoped is not None:
+            # CI gate: the scoped pipeline must take the prefix-only
+            # path for every churn round (the initial build is the one
+            # full) and run ZERO SPF solves
+            _smoke_gate("prefix-churn", scoped, {
+                "prefix-only path every round": (
+                    scoped["rebuild_prefix_only"] >= args.prefix_rounds - 1
+                ),
+                "one initial full build": scoped["rebuild_full"] == 1,
+                "zero churn solves": scoped["churn_area_solves"] == 0,
+            })
         return
 
     from openr_tpu.utils import topogen

@@ -39,7 +39,7 @@ if [ "$smoke_rc" -ne 1 ]; then
     echo "$smoke_out"
     exit 1
 fi
-for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007; do
+for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 OR010; do
     if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
         echo "orlint smoke: rule $code produced no finding on the" \
              "known-bad fixture (rule deleted or broken?)"
@@ -47,16 +47,26 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007; do
         exit 1
     fi
 done
-echo "ok: known-bad fixture trips all 7 rules"
+echo "ok: known-bad fixture trips all 10 rules"
 
 echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # the topology-delta acceptance gate (docs/Decision.md): single-link
 # metric changes on a 320-node grid must take the warm-start path
 # (decision.rebuild.topo_delta, zero full area solves) and stay
 # byte-equal to from-scratch compute_rib — bench_churn --smoke exits 1
-# on any counter or parity violation
+# on any counter or parity violation, and (compile ledger,
+# monitor/compile_ledger.py) on ANY post-warmup XLA compile: steady
+# state under churn must be pure jit-cache hits (docs/Linting.md
+# OR008-OR010)
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --topo-churn --nodes 320 --topo-rounds 30 --smoke --backend cpu
+
+echo "== prefix-churn smoke (scoped-path counters + compile ledger gate) =="
+# the prefix-only rebuild path under the same zero-steady-state-
+# recompile gate: every churn round must be decision.rebuild.
+# prefix_only with zero SPF solves and zero post-warmup compiles
+JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
+    --prefix-churn --nodes 80 --prefix-rounds 40 --smoke --backend cpu
 
 echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
 # the tier-1-safe slice of the long-horizon soak: storms + background

@@ -34,7 +34,7 @@ from openr_tpu.decision.oracle import (
 from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
 from openr_tpu.decision.oracle import metric_key
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
-from openr_tpu.monitor import perf
+from openr_tpu.monitor import compile_ledger, perf
 from openr_tpu.types.kvstore import Publication, Value
 from openr_tpu.types.routes import (
     RouteDatabase,
@@ -1255,6 +1255,11 @@ class Decision(OpenrModule):
                 self.counters.set(
                     "decision.spf.solves", self._tpu.solve_count
                 )
+                # process-wide jax compile/transfer ledger (zeroes
+                # until monitor.compile_ledger.install() hooks
+                # jax_log_compiles — tests/conftest and the bench/churn
+                # lanes install it; see docs/Monitor.md)
+                compile_ledger.export_to(self.counters)
         first = not self.rib_computed.is_set()
         self.rib = new_rib
         self._last_completed_snapshot_t0 = t0

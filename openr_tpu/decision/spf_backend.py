@@ -30,7 +30,7 @@ from openr_tpu.decision.ksp import (
 )
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
 from openr_tpu.decision.oracle import SolveArtifact, metric_key
-from openr_tpu.monitor import profiling
+from openr_tpu.monitor import compile_ledger, profiling
 from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
     INF_DIST,
@@ -59,6 +59,24 @@ from openr_tpu.types.network import (
 from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
 
 log = logging.getLogger(__name__)
+
+# Warm-start cone-scatter pad tiers. pad_batch's power-of-two buckets
+# would compile a distinct eager scatter variant per cone-size bucket —
+# up to ~17 over a churn run, and a fresh one can land long after
+# warmup (the compile ledger's zero-steady-state-recompile gate caught
+# exactly this). Three fixed tiers bound the variant count at 3 for the
+# whole process while keeping ONE dispatch per warm solve; the padding
+# slots repeat the last (row, col) and a duplicate .set of the same
+# INF_DIST is a no-op. Cones beyond the top tier chunk by it.
+_WARM_SCATTER_TIERS = (8192, 131_072, 1_048_576)
+
+
+def _warm_scatter_pad(n: int) -> int:
+    for t in _WARM_SCATTER_TIERS:
+        if n <= t:
+            return t
+    top = _WARM_SCATTER_TIERS[-1]
+    return -(-n // top) * top
 
 
 def _class_groups(cls_arr: np.ndarray):
@@ -127,6 +145,7 @@ class _LazyDist:
     def _materialize(self) -> np.ndarray:
         if self._np is None:
             self._np = np.asarray(self._dev)
+            compile_ledger.record_transfer(self._np.nbytes)
         return self._np
 
     def __array__(self, dtype=None, copy=None):
@@ -691,6 +710,7 @@ class TpuSpfSolver:
                     gs_chunks=gs,
                 )
                 buf = np.asarray(packed)
+                compile_ledger.record_transfer(buf.nbytes)
             d_root, fh, lfa = unpack_rib_buffer(buf, vp, b, self.enable_lfa)
             return csr, _LazyDist(dist_dev, d_root), fh, nbr_ids, lfa
 
@@ -1001,16 +1021,17 @@ class TpuSpfSolver:
             dist_dev = old_dist._dev
             if rows_all:
                 n_sc = len(rows_all)
-                nb = pad_batch(n_sc)
-                rows = np.array(
-                    rows_all + [rows_all[-1]] * (nb - n_sc), np.int32
-                )
-                cols = np.array(
-                    cols_all + [cols_all[-1]] * (nb - n_sc), np.int32
-                )
-                dist_dev = dist_dev.at[
-                    jnp.asarray(rows), jnp.asarray(cols)
-                ].set(INF_DIST)
+                nb = _warm_scatter_pad(n_sc)
+                rows = np.full(nb, rows_all[-1], np.int32)
+                rows[:n_sc] = rows_all
+                cols = np.full(nb, cols_all[-1], np.int32)
+                cols[:n_sc] = cols_all
+                top = _WARM_SCATTER_TIERS[-1]
+                for off in range(0, nb, top):
+                    dist_dev = dist_dev.at[
+                        jnp.asarray(rows[off : off + top]),
+                        jnp.asarray(cols[off : off + top]),
+                    ].set(INF_DIST)
             gs = pick_gs_chunks(vp)
             with profiling.annotate("spf:warm_solve"):
                 dist_dev2, packed = batched_sssp_split_warm_rib(
@@ -1023,6 +1044,7 @@ class TpuSpfSolver:
                     has_overloads=has_over, gs_chunks=gs,
                 )
                 buf = np.asarray(packed)
+                compile_ledger.record_transfer(buf.nbytes)
             d_root, fh, _ = unpack_rib_buffer(buf, vp, bb, False)
             self.solve_count += 1
             self.warm_solves += 1

@@ -121,6 +121,10 @@ COUNTERS: frozenset[str] = frozenset(
         "prefixmgr.redistributed",
         # common/tasks guard_task default
         "task.uncaught_exceptions",
+        # jax compile ledger (monitor/compile_ledger.py; process-wide)
+        "jax.compiles.total",
+        "jax.transfers.host_reads",
+        "jax.transfers.host_bytes",
     }
 )
 
@@ -145,6 +149,9 @@ TEMPLATES: dict[str, str | None] = {
     "decision.decode.*": None,
     "decision.dev_cache.*": None,
     "decision.spf.*": None,
+    # per-jitted-function compile counts (monitor/compile_ledger.py) —
+    # the fn segment is the jit wrapper's name
+    "jax.compiles.*": "jax.compiles.<fn>",
     # platform error taxonomy
     "platform.*": None,
 }
@@ -168,6 +175,7 @@ DOCUMENTED: frozenset[str] = frozenset(
     | {n for n in COUNTERS if n.startswith("ctrl.sub_")}
     | {n for n in COUNTERS if n.startswith("watchdog.")}
     | {n for n in COUNTERS if n.startswith("spark.inbox_")}
+    | {n for n in COUNTERS if n.startswith("jax.")}
 )
 
 #: source files exempt from the per-callsite check: the registry's own

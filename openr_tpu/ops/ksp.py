@@ -54,7 +54,7 @@ def build_ksp_blocked(
 
 
 @functools.partial(jax.jit, static_argnames=("k", "max_hops"))
-def ksp_edge_disjoint_dense(
+def _ksp_edge_disjoint_dense_jit(
     nbr: jax.Array,  # [Vp, D] i32 in-neighbor ids (padding: wgt == INF)
     wgt: jax.Array,  # [Vp, D] i32 metric; INF_DIST padding
     blocked: jax.Array,  # [Vp, D] bool base mask (build_ksp_blocked)
@@ -242,6 +242,49 @@ def ksp_edge_disjoint_dense(
         (banned0, costs0, paths0, hops0, jnp.int32(0), jnp.bool_(True)),
     )
     return costs, paths, hops
+
+
+def ksp_edge_disjoint_dense(
+    nbr,
+    wgt,
+    blocked,
+    root,
+    dests,
+    *,
+    k: int,
+    max_hops: int,
+    dist0=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Canonicalizing entry point for the jitted kernel above.
+
+    The jit cache keys on dtype AND weak-type/commitment, so a python
+    int root, an ``np.int32`` scalar, and a ``jnp.int32`` array are
+    three distinct cache entries for identical math — measured three
+    compiles on jax 0.4.37 (tests/test_jit_cache.py pins this). Every
+    array is coerced to its strong contract dtype here, once, so all
+    equivalent call spellings share one compiled variant.
+    """
+    return _ksp_edge_disjoint_dense_jit(
+        jnp.asarray(nbr, jnp.int32),
+        jnp.asarray(wgt, jnp.int32),
+        jnp.asarray(blocked, bool),
+        jnp.asarray(root, jnp.int32),
+        jnp.asarray(dests, jnp.int32),
+        k=k,
+        max_hops=max_hops,
+        dist0=None if dist0 is None else jnp.asarray(dist0, DIST_DTYPE),
+    )
+
+
+# the undecorated kernel body, for tests that re-jit it under forced
+# configs (test_ksp_relax_branches_agree), and the compiled-variant
+# count for the jit-cache stability suite
+ksp_edge_disjoint_dense.__wrapped__ = (
+    _ksp_edge_disjoint_dense_jit.__wrapped__
+)
+ksp_edge_disjoint_dense.cache_size = (
+    _ksp_edge_disjoint_dense_jit._cache_size
+)
 
 
 def paths_to_host(

@@ -193,6 +193,14 @@ def batched_sssp_pallas(
             "(spf_kernel='split') on TPU; the Pallas kernel is an "
             "interpreter-mode design reference."
         )
+    # strong-type the inputs once: a python-int-shaped roots list, an
+    # np.int32 table and a jnp.int32 table must all share ONE compiled
+    # variant of _relax_once (weak-type/commitment is part of the jit
+    # cache key — tests/test_jit_cache.py)
+    nbr = jnp.asarray(nbr, jnp.int32)
+    wgt = jnp.asarray(wgt, jnp.int32)
+    node_overloaded = jnp.asarray(node_overloaded, bool)
+    roots = jnp.asarray(roots, jnp.int32)
     vp = nbr.shape[0]
     b = roots.shape[0]
     chosen = pick_tile(vp, b, nbr.shape[1], want=tile)
@@ -213,6 +221,10 @@ def batched_sssp_pallas(
         dist, changed = _relax_once(
             nbr, wgt, over_t, roots, dist, tile, has_overloads, interpret
         )
-        if int(changed) == 0:
+        # the per-sweep scalar readback IS this kernel's documented
+        # design limitation (module docstring): interpreter-only
+        # reference formulation; production solves use spf_split's
+        # fused lax.while_loop with zero in-loop syncs
+        if int(changed) == 0:  # orlint: disable=OR009
             break
     return dist

@@ -15,7 +15,10 @@ Three levers vs the r2 kernel (which gathered Vp_pow2 x D_max rows per
 sweep — 8.4 M at the 100k benchmark):
 
   1. **Tight node padding** — `tight_nodes()` pads V to a multiple of
-     512 instead of a power of two (100 000 -> 100 352, not 131 072).
+     512 quantized onto the 1/8-octave grid {m * 2^k : 8 <= m < 16}
+     instead of a full power of two (100 000 -> 106 496, not 131 072);
+     the grid keeps node-count churn from re-minting traced shapes
+     (orlint OR010) at < 12.5% overpad.
   2. **Split-width tables** — a base table of width W covering ~98% of
      in-edges plus a compacted overflow table holding slots W..indeg of
      the few high-degree rows. For Poisson-degree graphs the gathered
@@ -60,10 +63,24 @@ DIST_DTYPE = jnp.int32
 
 
 def tight_nodes(n: int, step: int = 512) -> int:
-    """Node padding for the v3 kernel: next multiple of `step` STRICTLY
-    greater than n, so slot vp-1 is always a dead slot (used to pad
-    neighbor-id and frontier arrays). 100_000 -> 100_352."""
-    return (n // step + 1) * step
+    """Node padding for the v3 kernel: the next multiple of `step`
+    STRICTLY greater than n — slot vp-1 is always a dead slot (used to
+    pad neighbor-id and frontier arrays) — quantized up to the
+    power-of-two-ish grid {m * 2^k : 8 <= m < 16}.
+
+    The grid is the churn defense (orlint OR010): a raw multiple-of-512
+    pad mints a new traced shape — a full kernel recompile — every
+    ±512-node structural change at 100k scale; on the 1/8-octave grid
+    the variant count is O(log V) and a bucket absorbs ~6-12% growth.
+    Overpad is bounded: < 12.5% beyond the 512-step value (vs ~31% for
+    a plain power of two), ≤ 2x overall. 100_000 -> 106_496 (13*2^13;
+    the pre-grid r3 kernel used 100_352). Every result stays a
+    multiple of 512 for vp >= 4096 — the gs-chunking alignment
+    pick_gs_chunks relies on — because the grid spacing 2^k is then
+    itself a multiple of 512."""
+    v = (n // step + 1) * step
+    g = 1 << max(v.bit_length() - 4, 0)  # grid spacing: m lands in 8..15
+    return -(-v // g) * g
 
 
 def _pow2(n: int, minimum: int = 8) -> int:

@@ -152,6 +152,49 @@ asyncio.set_event_loop_policy(_SanitizerPolicy())
 # declared registry)
 
 
+# --------------------------------------------------------------------------
+# jit compile sanitizer: the compile-stability analogue of the asyncio
+# one. The session installs the process compile ledger (hooks
+# jax_log_compiles; openr_tpu/monitor/compile_ledger.py). A test marked
+# @pytest.mark.jit_steady_state declares a warmup boundary by calling
+# compile_ledger.mark_warm() once its warmup calls are done; the autouse
+# fixture then FAILS the test if any jax compilation (jit cache miss,
+# new eager-op shape, static-arg variant) lands after the mark — the
+# invariant the padding buckets and OR008-OR010 exist to uphold.
+# Unmarked tests are unaffected (the ledger only counts).
+
+from openr_tpu.monitor import compile_ledger  # noqa: E402
+
+compile_ledger.install()
+
+
+@pytest.fixture(autouse=True)
+def jit_compile_sanitizer(request):
+    marked = request.node.get_closest_marker("jit_steady_state")
+    led = compile_ledger.ledger()
+    led.reset_warm()
+    yield
+    if not marked:
+        led.reset_warm()
+        return
+    if not led.warm_marked:
+        pytest.fail(
+            "@pytest.mark.jit_steady_state test never called "
+            "compile_ledger.mark_warm() — mark the end of warmup so "
+            "the steady-state rounds can be checked"
+        )
+    new = led.compiles_since_warm()
+    led.reset_warm()
+    if new:
+        detail = ", ".join(f"{fn} x{n}" for fn, n in sorted(new.items()))
+        pytest.fail(
+            f"jit compile sanitizer: {sum(new.values())} steady-state "
+            f"compilation(s) after mark_warm() ({detail}) — a shape "
+            f"leaked past the padding buckets or a static arg took a "
+            f"fresh value (docs/Linting.md OR008-OR010)"
+        )
+
+
 @pytest.fixture(autouse=True)
 def asyncio_sanitizer(request):
     """Fail any test that leaks pending tasks or never-retrieved task

@@ -7,7 +7,7 @@ OR006 determinism) apply; the engine's directory walker skips
 explicit argument (``python -m tools.orlint
 tests/fixtures/orlint/decision/known_bad.py``).
 
-EXPECTED: exactly one finding per rule, OR001..OR007 (asserted by
+EXPECTED: exactly one finding per rule, OR001..OR010 (asserted by
 tests/test_orlint.py::test_known_bad_fixture_covers_every_rule and the
 ci.sh smoke lane).
 """
@@ -37,3 +37,27 @@ class Bad:
             await asyncio.sleep(1)
         except (asyncio.CancelledError, Exception):  # OR005: swallows cancel
             pass
+
+# ---- JAX layer (OR008-OR010) ----------------------------------------
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def bad_kernel(x, n, k):
+    if n > 3:  # OR008: python control flow on a traced value
+        x = x + jnp.int32(k)
+    return x
+
+
+def bad_callers(jobs):
+    for _ in range(3):
+        d = bad_kernel(jnp.ones(4, jnp.int32), jnp.int32(2), k=2)
+        _total = int(d)  # OR009: per-iteration readback of kernel result
+    fixed = np.zeros(8, np.int32)
+    # OR010: static k varies per call — one full recompile per job count
+    return bad_kernel(jnp.asarray(fixed), jnp.int32(1), k=len(jobs))

@@ -16,7 +16,18 @@ from openr_tpu.ops.ksp import (
     ksp_edge_disjoint_dense,
     paths_to_host,
 )
-from openr_tpu.ops.spf import INF_DIST, build_dense_tables
+from openr_tpu.ops.spf import INF_DIST, build_dense_tables, pad_batch
+
+
+def pad_dests(dests: np.ndarray, root_id: int) -> np.ndarray:
+    """The production dest-batch discipline (spf_backend._ksp_batch):
+    pad to a power-of-two bucket with dest==root dead jobs, so every
+    batch size in a bucket reuses one compiled kernel variant (orlint
+    OR010). Padded jobs yield cost=INF / empty paths by construction."""
+    b = pad_batch(len(dests))
+    out = np.full(b, root_id, dtype=np.int32)
+    out[: len(dests)] = dests
+    return out
 
 
 def random_graph(rng, n, p=0.25, max_metric=10):
@@ -61,7 +72,8 @@ def test_ksp_kernel_matches_oracle(k, seed):
     )
     blocked = build_ksp_blocked(nbr, over_mask, root_id)
     costs, paths, _hops = ksp_edge_disjoint_dense(
-        nbr, wgt, blocked, np.int32(root_id), dests, k=k, max_hops=n - 1
+        nbr, wgt, blocked, np.int32(root_id), pad_dests(dests, root_id),
+        k=k, max_hops=n - 1,
     )
     costs, paths = np.asarray(costs), np.asarray(paths)
 
@@ -161,6 +173,7 @@ def test_ksp_kernel_dist0_path_byte_equal(seed):
         dtype=np.int32,
     )
     blocked = build_ksp_blocked(nbr, over_mask, root_id)
+    dests = pad_dests(dests, root_id)
     ref_c, ref_p, ref_h = ksp_edge_disjoint_dense(
         nbr, wgt, blocked, np.int32(root_id), dests, k=16, max_hops=n - 1
     )

@@ -22,7 +22,10 @@ from tools.orlint.rules import all_rules
 REPO = pathlib.Path(__file__).resolve().parents[1]
 KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
 
-ALL_CODES = {"OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007"}
+ALL_CODES = {
+    "OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007",
+    "OR008", "OR009", "OR010",
+}
 
 
 def lint_snippet(
@@ -352,6 +355,208 @@ def test_or007_doc_parity_finalize(tmp_path):
     assert "FIB_PROGRAMMED" in msgs
     assert "decision.rebuild.full" in msgs
     assert len(res.findings) == 2
+
+
+def test_or008_jit_hygiene_variants(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def traced_if(x, flag):
+            if flag:                      # traced: flagged
+                return x + 1
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("flag",))
+        def static_if(x, flag):
+            if flag:                      # static: fine
+                return x + 1
+            return x
+
+        @jax.jit
+        def shape_if(x):
+            w = x.shape[0]
+            if w > 8:                     # shape is trace-time python: fine
+                return x[:8]
+            return x
+
+        @jax.jit
+        def none_check(x, y=None):
+            if y is None:                 # structural: fine
+                return x
+            return x + y
+
+        @jax.jit
+        def numpy_leak(x):
+            return np.minimum(x, 3)       # np on a tracer: flagged
+
+        @jax.jit
+        def weak_literal(n):
+            return jnp.full(8, 0.0) + n   # no dtype: flagged
+
+        @jax.jit
+        def typed_literal(n):
+            return jnp.full(8, 0.0, jnp.float32) + n  # dtype: fine
+
+        @functools.partial(jax.jit, static_argnames=("opts",))
+        def unhashable_default(x, opts=[]):  # flagged
+            return x
+        """,
+        select={"OR008"},
+    )
+    subjects = sorted(f.fingerprint.split(":", 3)[2] for f in res.findings)
+    assert subjects == [
+        "numpy_leak", "traced_if", "unhashable_default", "weak_literal",
+    ]
+
+
+def test_or008_static_argnums_resolved_positionally(tmp_path):
+    """static_argnums int positions map onto the positional signature:
+    a branch on an argnums-static param is trace-time python (no OR008
+    false positive), and OR010 still sees it as a static to check."""
+    res = lint_snippet(
+        tmp_path,
+        """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def argnums_static(x, n):
+            if n > 3:                     # static via argnums: fine
+                return x + 1
+            return x
+
+        @functools.partial(jax.jit, static_argnums=1)
+        def argnums_scalar(x, n):
+            if n > 3:                     # bare-int spelling: fine too
+                return x + 1
+            return x
+        """,
+        select={"OR008"},
+    )
+    assert codes_of(res) == []
+
+
+def test_or008_nested_jit_reported_once(tmp_path):
+    """A violation inside a nested jit-decorated def belongs to the
+    nested function's own pass — the enclosing jit scope's body walk
+    must not report it a second time under its own fingerprint."""
+    res = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def outer(x, flag):
+            @jax.jit
+            def inner(y, cond):
+                if cond:                  # traced: exactly ONE finding
+                    return y + 1
+                return y
+
+            return inner(x, flag)
+        """,
+        select={"OR008"},
+    )
+    assert len(res.findings) == 1
+    assert "inner" in res.findings[0].fingerprint
+
+
+def test_or009_host_sync_variants(tmp_path):
+    snippet = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def kernel(x):
+        return x + 1
+
+    def per_sweep_readback(x):
+        for _ in range(10):
+            x, changed = kernel(x)
+            if int(changed) == 0:          # flagged: readback per sweep
+                break
+        return x
+
+    def pipelined_ok(chunks):
+        rows, pending = [], None
+        for c in chunks:
+            d = kernel(c)
+            if pending is not None:
+                rows.append(np.asarray(pending))  # overlapped: fine
+            pending = d
+        return rows
+
+    def sync_only_loop(devs):
+        out = []
+        for d in devs:
+            out.append(np.asarray(d))      # flagged: no dispatch in loop
+        return out
+
+    def timing(x):
+        kernel(x).block_until_ready()      # flagged anywhere in scope
+    """
+    hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/ops/m.py", select={"OR009"}
+    )
+    subjects = sorted(f.fingerprint.split(":", 3)[3] for f in hit.findings)
+    assert [s.split(":")[0] for s in subjects] == [
+        "asarray", "block_until_ready", "int",
+    ]
+    # out of scope (no ops/parallel/decision path part): silent
+    miss = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/spark/m.py", select={"OR009"}
+    )
+    assert codes_of(miss) == []
+
+
+def test_or010_recompile_hazard_variants(tmp_path):
+    res = lint_snippet(
+        tmp_path,
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from openr_tpu.common.util import pad_bucket as pad_batch
+
+        @functools.partial(jax.jit, static_argnames=("k", "flag"))
+        def kern(x, k, flag=False):
+            return x * k
+
+        K_CONST = 4
+
+        def stable_sites(jobs, cfg):
+            kern(jnp.ones(4), k=8)                    # literal: fine
+            kern(jnp.ones(4), k=K_CONST)              # constant: fine
+            kern(jnp.ones(4), k=cfg.k)                # config attr: fine
+            b = pad_batch(len(jobs))
+            kern(jnp.ones(4), k=b)                    # bucketed: fine
+            kern(jnp.ones(4), k=8, flag=bool(jobs))   # bool static: fine
+            padded = np.zeros(b, np.int32)
+            return kern(jnp.asarray(padded), k=8)     # padded feed: fine
+
+        def varying_static(jobs):
+            return kern(jnp.ones(4), k=len(jobs))     # flagged
+
+        def unpadded_feed(jobs):
+            raw = np.zeros(len(jobs), np.int32)
+            return kern(jnp.asarray(raw), k=8)        # flagged
+        """,
+        select={"OR010"},
+    )
+    subjects = sorted(f.fingerprint.split(":", 3)[3] for f in res.findings)
+    assert subjects == ["shape:kern:raw", "static:kern:k"]
 
 
 # ------------------------------------------- suppression + baseline plumbing

@@ -1,0 +1,90 @@
+"""Padding-bucket audit regressions (the OR010 runtime contract).
+
+Every jit-facing capacity is quantized by one of three helpers —
+``pad_bucket``/``pad_batch`` (power-of-two buckets), ``tight_nodes``
+(the v3 kernel's node grid), ``_pow2`` (table widths). The compile
+ledger's zero-steady-state-recompile assertions (conftest sanitizer,
+ci.sh churn smoke) rest on these being *bucket functions*: monotone,
+idempotent-ish (few distinct outputs over a churn range), and with
+bounded overpad so the quantization never silently doubles HBM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from openr_tpu.common.util import pad_bucket
+from openr_tpu.ops.spf_split import _pow2, tight_nodes
+
+RANGE = range(1, 200_001)
+
+
+def test_pad_bucket_monotone_bounded_pow2():
+    prev = 0
+    for n in range(1, 5000):
+        b = pad_bucket(n)
+        assert b >= n
+        assert b & (b - 1) == 0, "power-of-two buckets"
+        assert b >= prev, "monotone"
+        prev = b
+        if n >= 8:  # below the minimum the floor dominates, by design
+            assert b <= 2 * n, "<= 2x overpad"
+    assert pad_bucket(1) == 8  # the documented floor
+
+
+def test_pow2_matches_pad_bucket_contract():
+    for n in range(1, 5000):
+        assert _pow2(n) == pad_bucket(n)
+
+
+def test_tight_nodes_monotone_and_bounded():
+    prev = 0
+    for n in RANGE:
+        v = tight_nodes(n)
+        assert v > n, "strictly greater: slot vp-1 must be a dead slot"
+        assert v >= prev, "monotone"
+        prev = v
+        assert v <= 2 * n + 512, "<= 2x overpad (+floor for tiny graphs)"
+        if n >= 4096:
+            # the gs-chunking / shard-divisibility alignment contract
+            assert v % 512 == 0, (n, v)
+            # grid shape: m * 2^k with 8 <= m < 16
+            k = v.bit_length() - 4
+            assert v % (1 << k) == 0 and 8 <= v >> k < 16, (n, v)
+    # overpad beyond the raw 512-step pad is the grid's 1/8 octave
+    for n in (10_000, 50_000, 100_000, 150_000):
+        raw = (n // 512 + 1) * 512
+        assert tight_nodes(n) / raw < 1.125 + 1e-9
+
+
+def test_tight_nodes_absorbs_churn():
+    """The point of the grid: node-count churn maps to FEW traced
+    shapes. ±6% structural churn around the 100k bench scale must stay
+    within at most two buckets (the raw 512-step rule produced ~24)."""
+    sizes = {tight_nodes(n) for n in range(94_000, 100_001)}
+    assert len(sizes) <= 2, sorted(sizes)
+    # and across a 2x range the variant count stays logarithmic
+    sizes = {tight_nodes(n) for n in range(50_000, 100_001)}
+    assert len(sizes) <= 9, sorted(sizes)
+
+
+def test_tight_nodes_small_graphs_unchanged():
+    """Below 4096 the 512-step values already sit on the grid — the
+    emulator-scale paddings (and their compiled kernels) are identical
+    to the pre-grid rule."""
+    for n in range(1, 4097):
+        raw = (n // 512 + 1) * 512
+        assert tight_nodes(n) == raw
+
+
+def test_solver_vp_consistency():
+    """build_split_tables and the backend's solve_vp() must agree on
+    the padded node dimension for every scale (the packed-buffer
+    decode reads vp bytes — a mismatch corrupts the RIB)."""
+    from openr_tpu.ops.spf_split import build_split_tables
+
+    for n in (60, 513, 5000):
+        e = np.zeros(0, np.int32)
+        t = build_split_tables(e, e, e, n)
+        assert t["vp"] == tight_nodes(n)
+        assert t["base_nbr"].shape[0] == t["vp"]

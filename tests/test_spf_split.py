@@ -165,7 +165,7 @@ def test_split_disconnected_and_line():
 
 
 def test_tight_nodes_and_width_picker():
-    assert tight_nodes(100_000) == 100_352
+    assert tight_nodes(100_000) == 106_496  # 13 * 2^13 (1/8-octave grid)
     assert tight_nodes(512) == 1024  # strictly greater => dead slot exists
     assert tight_nodes(511) == 512
     # Poisson(22) (the 100k ER bench profile) -> W=32: base covers

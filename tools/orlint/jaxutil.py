@@ -1,0 +1,325 @@
+"""Shared JAX-AST analysis helpers for the OR008-OR010 rule family.
+
+Everything here is pure AST — the linted files are never imported. The
+central abstractions:
+
+  * :func:`jit_decoration` — recognize ``@jax.jit`` /
+    ``@functools.partial(jax.jit, static_argnames=...)`` decorations and
+    pull out the static-argument names.
+  * :class:`StaticEnv` — a per-function, assignment-order walk that
+    classifies local names as STATIC (python-level at trace time: shapes,
+    dtypes, static args, constants and arithmetic over them) or TRACED
+    (values that are jax tracers inside the jit scope). Conservative in
+    the lint-friendly direction: unknown constructs default to STATIC so
+    rules only fire on provably-traced data flow.
+  * :func:`collect_jit_registry` — whole-project map of jit-decorated
+    function names to their static_argnames + positional signature, used
+    by the cross-file call-site checks (OR009/OR010).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.orlint import ModuleCtx
+from tools.orlint.astutil import dotted_name
+
+#: spellings of the jit transform at a decorator's call root
+_JIT_ROOTS = frozenset({"jax.jit", "jit", "pjit", "jax.pjit"})
+
+#: helpers whose result is a quantized/bucketed capacity — expressions
+#: routed through one of these are shape-stable under churn by
+#: construction (ops/spf_split.py, common/util.py). Matched by substring
+#: against call names so project wrappers (``self._pick_gs_and_count``)
+#: are covered too.
+BUCKET_TOKENS = (
+    "pad_batch",
+    "pad_bucket",
+    "tight_nodes",
+    "pick_",  # the pick_* selector family: small fixed codomains
+    "_pow2",
+    "bit_length",
+)
+
+#: attribute accesses on a traced value that yield trace-time-static
+#: python data
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+
+@dataclass
+class JitInfo:
+    """One jit-decorated function: its AST node and static-arg names."""
+
+    node: ast.FunctionDef
+    static_argnames: frozenset[str]
+    qualname: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+def _const_str_seq(node: ast.AST) -> list[str] | None:
+    """Names from a constant str / tuple / list of str, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def jit_decoration(fn: ast.FunctionDef) -> frozenset[str] | None:
+    """If `fn` is jit-decorated, return its static argument NAMES
+    (possibly empty); else None. Handles ``@jax.jit``,
+    ``@jax.jit(...)``, ``@functools.partial(jax.jit,
+    static_argnames=(...))`` — and ``static_argnums``, whose integer
+    positions are resolved against `fn`'s positional signature (a
+    dropped argnum would make OR008 flag a genuinely-static parameter
+    as traced AND make OR010 skip its stability check)."""
+    for dec in fn.decorator_list:
+        root = dec.func if isinstance(dec, ast.Call) else dec
+        dn = dotted_name(root)
+        if dn in _JIT_ROOTS:
+            return _static_names_of(dec, fn)
+        if dn in ("functools.partial", "partial") and isinstance(
+            dec, ast.Call
+        ):
+            if dec.args and dotted_name(dec.args[0]) in _JIT_ROOTS:
+                return _static_names_of(dec, fn)
+    return None
+
+
+def _const_int_seq(node: ast.AST) -> list[int] | None:
+    """Positions from a constant int / tuple / list of int, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _static_names_of(dec: ast.AST, fn: ast.FunctionDef) -> frozenset[str]:
+    names: set[str] = set()
+    pos = [*fn.args.posonlyargs, *fn.args.args]
+    if isinstance(dec, ast.Call):
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                got = _const_str_seq(kw.value)
+                if got is not None:
+                    names.update(got)
+            elif kw.arg == "static_argnums":
+                nums = _const_int_seq(kw.value)
+                if nums is not None:
+                    names.update(
+                        pos[i].arg for i in nums if -len(pos) <= i < len(pos)
+                    )
+    return frozenset(names)
+
+
+def iter_jit_functions(tree: ast.Module):
+    """Yield (fn_node, static_argnames, qualname) for every jit-decorated
+    function in the module (any nesting level)."""
+
+    def rec(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                if isinstance(child, ast.FunctionDef):
+                    statics = jit_decoration(child)
+                    if statics is not None:
+                        yield JitInfo(child, statics, qn)
+                yield from rec(child, f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.")
+            else:
+                yield from rec(child, prefix)
+
+    yield from rec(tree, "")
+
+
+def collect_jit_registry(ctxs: list[ModuleCtx]) -> dict[str, JitInfo]:
+    """{function name: JitInfo} across the whole linted set. On a name
+    collision the entry with MORE static args wins (call-site checks
+    stay conservative either way).
+
+    A plain function whose body returns a single call to a registered
+    jit function is aliased to it (the canonicalizing entry-point
+    pattern — ops/ksp.py ksp_edge_disjoint_dense wraps the jitted
+    kernel to strong-type its scalars): call sites through the wrapper
+    keep their static-arg and shape-feed checks. The alias assumes the
+    wrapper preserves the wrapped signature's argument order, which is
+    the convention for these shims.
+    """
+    reg: dict[str, JitInfo] = {}
+    plain: list[tuple[str, str]] = []  # (fn name, returned callee name)
+    for ctx in ctxs:
+        for info in iter_jit_functions(ctx.tree):
+            prev = reg.get(info.name)
+            if prev is None or len(info.static_argnames) > len(
+                prev.static_argnames
+            ):
+                reg[info.name] = info
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and jit_decoration(node) is None
+            ):
+                returns = [
+                    n
+                    for n in ast.walk(node)
+                    if isinstance(n, ast.Return) and n.value is not None
+                ]
+                if len(returns) == 1 and isinstance(
+                    returns[0].value, ast.Call
+                ):
+                    callee = dotted_name(returns[0].value.func) or ""
+                    plain.append((node.name, callee.rsplit(".", 1)[-1]))
+    for wrapper, callee in plain:
+        if callee in reg and wrapper not in reg:
+            reg[wrapper] = reg[callee]
+    return reg
+
+
+def expr_has_bucket_token(node: ast.AST) -> bool:
+    """Whether any call/attribute name inside `node` carries one of the
+    known bucketing-helper tokens."""
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name and any(tok in name for tok in BUCKET_TOKENS):
+            return True
+    return False
+
+
+@dataclass
+class StaticEnv:
+    """Trace-time staticness classification for one jit function body.
+
+    ``traced`` holds names known to be tracers (non-static parameters
+    and anything derived from them except via .shape/.ndim/.dtype/len).
+    Unknown names (globals, imports, closure vars) are treated as
+    static — the rules only fire on provable tracer flow.
+    """
+
+    traced: set[str] = field(default_factory=set)
+
+    @classmethod
+    def for_function(cls, fn: ast.FunctionDef, statics: frozenset[str]):
+        env = cls()
+        args = fn.args
+        for a in (
+            *args.posonlyargs, *args.args, *args.kwonlyargs,
+            *( [args.vararg] if args.vararg else [] ),
+            *( [args.kwarg] if args.kwarg else [] ),
+        ):
+            if a.arg not in statics and a.arg != "self":
+                env.traced.add(a.arg)
+        env._scan(fn)
+        return env
+
+    # ---------------------------------------------------------- queries
+
+    def is_traced_expr(self, node: ast.AST) -> bool:
+        """Whether evaluating `node` yields a tracer: references a traced
+        name other than through a static attribute (.shape etc.) or
+        len()/isinstance()/is-None structure."""
+        return self._traced(node)
+
+    # ----------------------------------------------------------- internal
+
+    def _scan(self, fn: ast.FunctionDef) -> None:
+        """One ordered pass over the body, propagating tracedness through
+        simple assignments (including tuple unpacking and nested defs:
+        nested function params are traced — they are loop/branch bodies
+        called with tracers under lax control flow)."""
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                if node is fn:
+                    continue
+                a = node.args
+                for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                    self.traced.add(p.arg)
+            elif isinstance(node, ast.Assign):
+                val_traced = self._traced(node.value)
+                for tgt in node.targets:
+                    self._bind(tgt, val_traced)
+            elif isinstance(node, ast.AugAssign):
+                if self._traced(node.value):
+                    self._bind(node.target, True)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._bind(node.target, self._traced(node.value))
+
+    def _bind(self, tgt: ast.AST, val_traced: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            if val_traced:
+                self.traced.add(tgt.id)
+            else:
+                self.traced.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._bind(e, val_traced)
+        # attribute / subscript targets: no local name to track
+
+    def _traced(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False  # x.shape of a tracer is python data
+            return self._traced(node.value)
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] is static; tracer[i] is a tracer
+            return self._traced(node.value)
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            if dn == "len" or dn.endswith("range"):
+                return False  # len()/range() demand python ints
+            if dn in ("isinstance", "type"):
+                return False
+            # a call propagates tracedness of its arguments (jnp ops on
+            # tracers yield tracers; host helpers over static data stay
+            # static)
+            return any(
+                self._traced(a)
+                for a in (*node.args, *[k.value for k in node.keywords])
+            )
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is structural, not data
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return False
+            return self._traced(node.left) or any(
+                self._traced(c) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self._traced(v) for v in node.values)
+        if isinstance(node, (ast.BinOp,)):
+            return self._traced(node.left) or self._traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._traced(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self._traced(node.body) or self._traced(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._traced(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._traced(node.value)
+        return False  # constants, f-strings, comprehensions, unknowns

@@ -1,0 +1,222 @@
+"""OR008: jit-boundary hygiene.
+
+The kernel path's determinism and latency both assume every
+``@jax.jit`` body traces to ONE stable XLA program. Three classes of
+bugs silently break that (and only surface as ConcretizationTypeError,
+a wrong-dtype cache miss, or a per-call recompile storm on hardware):
+
+  * **Python control flow on a traced value** — an ``if``/``while``/
+    ``assert`` whose test reads a tracer forces concretization (errors
+    under jit) or, for scalars passed as python values, bakes the branch
+    into the trace so every new value recompiles. Structural tests
+    (``x is None``, shapes/dtypes, static_argnames members) are fine and
+    not flagged — the fix for a flagged parameter is usually adding it
+    to ``static_argnames`` (values must then be hashable and
+    low-cardinality) or moving the branch to ``lax.cond``/``jnp.where``.
+  * **``np.*`` calls on traced arrays** — numpy eagerly concretizes its
+    inputs; inside a jit body that is either an error or a silent
+    trace-time constant folding of data that was supposed to be runtime
+    data. Use ``jnp.*``.
+  * **weak-type / float64 literal leakage** — ``jnp.full(n, 0.0)`` (no
+    dtype) creates weak-typed (or, under x64, float64) values whose
+    dtype differs from the arrays they later meet, splitting the jit
+    cache per promotion path. Array constructors with a float literal
+    must pass ``dtype=``; ``float64`` spellings are banned outright in
+    kernel code (the solver contract is int32 — ops/spf.py).
+
+Non-hashable ``static_argnames`` defaults (list/dict/set) are also
+flagged: jit raises ``TypeError: unhashable`` only on the first call
+path that uses the default, which a partially-covered test suite misses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name
+from tools.orlint.jaxutil import (
+    StaticEnv,
+    iter_jit_functions,
+    jit_decoration,
+)
+
+#: jnp array constructors whose float-literal args must carry dtype=
+#: (the *_like family infers dtype from its operand and is exempt)
+_CTORS = frozenset(
+    {
+        "jnp.array",
+        "jnp.asarray",
+        "jnp.full",
+        "jnp.zeros",
+        "jnp.ones",
+        "jnp.arange",
+        "jnp.linspace",
+    }
+)
+
+_F64 = frozenset({"jnp.float64", "np.float64", "numpy.float64"})
+
+
+def _walk_own_body(fn: ast.FunctionDef):
+    """ast.walk, pruned at nested jit-decorated defs: those get their
+    own iter_jit_functions pass (with their OWN static_argnames), so
+    walking into them here would report each violation twice — once per
+    enclosing jit scope — splitting one defect across two baseline
+    fingerprints."""
+    stack: list[ast.AST] = [fn]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if (
+                isinstance(child, ast.FunctionDef)
+                and jit_decoration(child) is not None
+            ):
+                continue
+            stack.append(child)
+            yield child
+
+
+class JitHygieneRule(Rule):
+    code = "OR008"
+    name = "jit-hygiene"
+    description = (
+        "traced-value control flow / np.* call / weak-type literal "
+        "inside a jitted function"
+    )
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if "tools" in ctx.part_set():
+            return
+        for info in iter_jit_functions(ctx.tree):
+            env = StaticEnv.for_function(info.node, info.static_argnames)
+            yield from self._check_body(ctx, info, env)
+            yield from self._check_static_defaults(ctx, info)
+
+    # ------------------------------------------------------------ checks
+
+    def _check_body(self, ctx, info, env) -> Iterable[Finding]:
+        fn = info.node
+        for node in _walk_own_body(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if env.is_traced_expr(node.test):
+                    kind = (
+                        "while" if isinstance(node, ast.While) else "if"
+                    )
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"python `{kind}` on a traced value inside jitted "
+                        f"{fn.name}() — concretizes the tracer (or "
+                        f"recompiles per value); use lax.cond/jnp.where, "
+                        f"or add the argument to static_argnames",
+                        scope=info.qualname or fn.name,
+                        subject=f"{kind}:{node.lineno}",
+                    )
+            elif isinstance(node, ast.IfExp):
+                if env.is_traced_expr(node.test):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"python conditional expression on a traced value "
+                        f"inside jitted {fn.name}() — use jnp.where",
+                        scope=info.qualname or fn.name,
+                        subject=f"ifexp:{node.lineno}",
+                    )
+            elif isinstance(node, ast.Assert):
+                if env.is_traced_expr(node.test):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"assert on a traced value inside jitted "
+                        f"{fn.name}() — use checkify or a host-side "
+                        f"precondition",
+                        scope=info.qualname or fn.name,
+                        subject=f"assert:{node.lineno}",
+                    )
+            elif isinstance(node, ast.Call):
+                yield from self._check_call(ctx, info, env, node)
+            elif isinstance(node, ast.Attribute):
+                dn = dotted_name(node)
+                if dn in _F64:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{dn} inside jitted {fn.name}() — the kernel "
+                        f"contract is int32/float32; float64 splits the "
+                        f"jit cache and is x64-config-dependent",
+                        scope=info.qualname or fn.name,
+                        subject=dn,
+                    )
+
+    def _check_call(self, ctx, info, env, node: ast.Call):
+        fn = info.node
+        dn = dotted_name(node.func) or ""
+        root = dn.split(".", 1)[0]
+        if root in ("np", "numpy") and dn not in _F64:
+            if any(
+                env.is_traced_expr(a)
+                for a in (*node.args, *[k.value for k in node.keywords])
+            ):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}() on a traced array inside jitted {fn.name}() "
+                    f"— numpy concretizes at trace time (error on "
+                    f"hardware, silent constant-folding elsewhere); use "
+                    f"the jnp equivalent",
+                    scope=info.qualname or fn.name,
+                    subject=dn,
+                )
+            return
+        if dn in _CTORS:
+            has_float_lit = any(
+                isinstance(a, ast.Constant) and isinstance(a.value, float)
+                for a in node.args
+            )
+            has_dtype = any(k.arg == "dtype" for k in node.keywords) or (
+                # positional dtype: full(shape, fill, dtype) / the
+                # 2-arg zeros(shape, dtype) forms — any trailing
+                # non-literal positional is assumed to be the dtype
+                len(node.args) >= 2
+                and not isinstance(node.args[-1], ast.Constant)
+            )
+            if has_float_lit and not has_dtype:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}() with a float literal and no dtype= inside "
+                    f"jitted {fn.name}() — weak-typed (x64: float64) "
+                    f"output splits the jit cache per promotion path; "
+                    f"pass an explicit dtype",
+                    scope=info.qualname or fn.name,
+                    subject=f"{dn}:{node.lineno}",
+                )
+
+    def _check_static_defaults(self, ctx, info) -> Iterable[Finding]:
+        """static_argnames parameters with unhashable defaults."""
+        fn = info.node
+        args = fn.args
+        pos = [*args.posonlyargs, *args.args]
+        defaults = args.defaults
+        pairs = list(
+            zip(pos[len(pos) - len(defaults):], defaults)
+        ) + [
+            (a, d)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults)
+            if d is not None
+        ]
+        for a, d in pairs:
+            if a.arg in info.static_argnames and isinstance(
+                d, (ast.List, ast.Dict, ast.Set)
+            ):
+                yield self.finding(
+                    ctx,
+                    d,
+                    f"static_argnames parameter {a.arg!r} of jitted "
+                    f"{fn.name}() has an unhashable default — jit "
+                    f"raises TypeError on the first defaulted call",
+                    scope=info.qualname or fn.name,
+                    subject=f"static-default:{a.arg}",
+                )
