@@ -496,6 +496,361 @@ def measure_topo_churn(
     }
 
 
+def _grid_edges(side: int) -> list[tuple[str, str]]:
+    edges = []
+    for r in range(side):
+        for c in range(side):
+            if c < side - 1:
+                edges.append((f"n{r}x{c}", f"n{r}x{c + 1}"))
+            if r < side - 1:
+                edges.append((f"n{r}x{c}", f"n{r + 1}x{c}"))
+    return edges
+
+
+async def _new_traces(cluster, seen_before: dict[str, int], timeout_s: float):
+    """Wait for the first node to complete a new PerfEvents trace after
+    a link event, then keep collecting until the count is stable for a
+    full second (drain, not a fixed grace window: a fixed window
+    censors exactly the slow stragglers a slow codec produces, biasing
+    its p50 LOW — the straggler set must close before either codec's
+    distribution is read)."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+
+    def collect():
+        out = []
+        for name, node in cluster.nodes.items():
+            n_new = (
+                int(node.counters.get("monitor.perf_traces", 0))
+                - seen_before[name]
+            )
+            if n_new > 0:
+                out.extend(list(node.monitor.perf_traces)[-n_new:])
+        return out
+
+    while loop.time() < deadline:
+        if collect():
+            break
+        await asyncio.sleep(0.05)
+    stable_since = loop.time()
+    n_last = len(collect())
+    while loop.time() < deadline:
+        await asyncio.sleep(0.1)
+        n_now = len(collect())
+        if n_now != n_last:
+            n_last = n_now
+            stable_since = loop.time()
+        elif loop.time() - stable_since >= 1.0:
+            break
+    return collect()
+
+
+# counters the flood bench reports as deltas (all summed cluster-wide)
+_FLOOD_COUNTERS = (
+    "kvstore.floods_sent",
+    "kvstore.flood_bytes",
+    "kvstore.flood_encodes",
+    "kvstore.flood_keys_coalesced",
+    "kvstore.full_syncs",
+    "kvstore.full_syncs_served",
+    "kvstore.full_sync_keys_sent",
+    "kvstore.full_syncs_noop",
+    "kvstore.full_syncs_noop_served",
+    "kvstore.full_sync_probe_miss",
+)
+
+
+def measure_flood(
+    codec: str = "bin",
+    side: int = 8,
+    churn_events: int = 400,
+    churn_hz: float = 200.0,
+    pool: int = 48,
+    flap_rounds: int = 4,
+    seed: int = 11,
+    timeout_s: float = 180.0,
+) -> dict:
+    """Full-stack emulated-cluster flood benchmark for ONE wire codec
+    (`--flood-bench` runs it for both and prints the comparison).
+
+    A side×side grid of complete OpenrNodes (real Spark / LinkMonitor /
+    KvStore / Decision / Fib over mock I/O, CPU oracle solver — no jax)
+    runs three seeded stages:
+
+      1. sustained prefix churn through the PrefixManager seam at
+         `churn_hz`, then drain until every store is byte-identical —
+         floods/sec (deliveries per second of pure-CPU wire-seam
+         time: `kvstore.flood_encode_ms` + `flood_decode_ms`) and
+         bytes/flood
+         over that window, all counter-derived (`kvstore.flood_bytes`
+         is the wire frame size the transport reported, not an
+         estimate; wall-clock floods/sec is reported as
+         `floods_per_sec_wall` but is pipeline- and host-noise-
+         dominated);
+      2. `flap_rounds` link fail/heal events — `convergence_p50_ms`
+         from the PerfEvents traces (NEIGHBOR_EVENT → FIB_PROGRAMMED),
+         the same instrumentation bench.py's headline uses;
+      3. one forced anti-entropy sweep on the converged cluster — the
+         delta full_sync path's noop-probe counters (docs/Wire.md).
+
+    Ends with the emulator invariant checker (same classes the chaos
+    and soak suites gate on) so the measured path is also a verified
+    one. The serialize-once contract is visible in the row:
+    `encodes_per_flood` ≈ 1/fan-out on the binary path, exactly 1.0 on
+    the legacy per-peer JSON path.
+    """
+    import random
+    from dataclasses import replace
+
+    from openr_tpu.emulator import invariants
+    from openr_tpu.emulator.cluster import Cluster, scaled_spark
+    from openr_tpu.monitor import perf
+    from openr_tpu.prefixmgr.prefix_manager import (
+        PrefixEvent,
+        PrefixEventType,
+        PrefixSource,
+    )
+    from openr_tpu.types.network import IpPrefix
+    from openr_tpu.types.topology import PrefixEntry
+
+    n_nodes = side * side
+    # the bench CHURNS while the whole grid shares one host core:
+    # scale the Spark timers as if the cluster were 2x its size, or
+    # the 64-node JSON baseline bring-up wave hits the hold-expiry
+    # flap storm scaled_spark's docstring describes (the hold timer
+    # would be measuring codec cost, not liveness — exactly the
+    # congestion this PR's binary path relieves). The hold timer is
+    # then pinned well past the worst event-loop stall a churn-drain
+    # wave produces (the JSON baseline stalls keepalive RX for
+    # multiple seconds at 64 nodes; a hold inside that window turns
+    # the drain into a self-sustaining neighbor-down cascade) but
+    # below _new_traces' 30 s flap-detection window. Trace-derived
+    # convergence starts at NEIGHBOR_EVENT, so the longer hold never
+    # enters the reported latency — it only delays fail_link
+    # detection. Key TTL is pushed past the bench horizon: the
+    # default 300s TTL starts synchronized refresh waves ~225s in
+    # (client.py TTL_REFRESH_FRACTION), background noise that would
+    # pollute the seeded workload both codecs must share.
+    spark_hdr = scaled_spark(n_nodes * 2) if n_nodes > 16 else None
+    if spark_hdr is not None:
+        spark_hdr = replace(
+            spark_hdr,
+            hold_time_ms=12_000,
+            graceful_restart_time_ms=24_000,
+        )
+
+    def transform(ncfg):
+        if spark_hdr is not None:
+            ncfg = replace(
+                ncfg,
+                spark=replace(
+                    spark_hdr, wire_codec=ncfg.spark.wire_codec
+                ),
+            )
+        return replace(
+            ncfg, kvstore=replace(ncfg.kvstore, key_ttl_ms=3_600_000)
+        )
+
+    c = Cluster.from_edges(
+        _grid_edges(side), solver="cpu", wire_codec=codec,
+        node_config_transform=transform,
+    )
+
+    def csum(name: str) -> int:
+        return sum(
+            int(n.counters.get(name, 0)) for n in c.nodes.values()
+        )
+
+    def snap() -> dict[str, int]:
+        return {k: csum(k) for k in _FLOOD_COUNTERS}
+
+    def seam_ms_sum() -> float:
+        """Cluster-wide pure-CPU time inside the wire seam: every
+        flood encode (`kvstore.flood_encode_ms`) plus every receive
+        decode (`kvstore.flood_decode_ms`). Neither stat spans an
+        await, so event-loop queueing — which dominates the wall-clock
+        `kvstore.flood_fanout_ms` latency under a 64-node churn wave
+        and drowns the codec effect in scheduler noise — can't inflate
+        it (docs/Wire.md)."""
+        total = 0.0
+        for n in c.nodes.values():
+            for stat in ("kvstore.flood_encode_ms",
+                         "kvstore.flood_decode_ms"):
+                s = n.counters.stats.get(stat)
+                if s is not None:
+                    total += s.sum
+        return total
+
+    ids: dict[str, int] = {}
+
+    def push_prefix(node_name: str, idx: int, add: bool) -> None:
+        entry = PrefixEntry(
+            prefix=IpPrefix.make(
+                f"10.210.{ids[node_name] & 0xFF}.{idx}/32"
+            )
+        )
+        c.nodes[node_name].prefix_events.push(
+            PrefixEvent(
+                type=(
+                    PrefixEventType.ADD_PREFIXES
+                    if add
+                    else PrefixEventType.WITHDRAW_PREFIXES
+                ),
+                source=PrefixSource.API,
+                entries=(entry,),
+            )
+        )
+
+    t_wall = time.perf_counter()
+
+    def _stage(msg: str) -> None:
+        print(
+            f"[flood-bench {codec}] +{time.perf_counter() - t_wall:.1f}s "
+            f"{msg}",
+            file=sys.stderr,
+        )
+
+    async def run() -> dict:
+        rng = random.Random(seed)
+        await c.start()
+        try:
+            await c.wait_converged(timeout=timeout_s)
+            _stage("converged")
+            await asyncio.sleep(0.5)  # bring-up floods/syncs settle
+            names = sorted(c.nodes)
+            ids.update({n: i for i, n in enumerate(names)})
+            loop = asyncio.get_running_loop()
+
+            # stage 1: seeded prefix churn → counter-derived throughput
+            base = snap()
+            seam0 = seam_ms_sum()
+            advertised: set[tuple[str, int]] = set()
+            t0 = loop.time()
+            for _ in range(churn_events):
+                node_name = names[rng.randrange(len(names))]
+                idx = rng.randrange(pool)
+                key = (node_name, idx)
+                add = key not in advertised
+                push_prefix(node_name, idx, add)
+                (advertised.add if add else advertised.discard)(key)
+                await asyncio.sleep(1.0 / churn_hz)
+            _stage(f"churn pushed ({loop.time() - t0:.1f}s)")
+            while True:
+                # drained = routes converged AND every store identical
+                if c.converged() and not invariants.check_kvstore_consistency(c):
+                    break
+                if loop.time() - t0 > timeout_s:
+                    raise TimeoutError("flood churn never drained")
+                await asyncio.sleep(0.05)
+            elapsed = loop.time() - t0
+            churn = {k: csum(k) - base[k] for k in _FLOOD_COUNTERS}
+            seam_ms = seam_ms_sum() - seam0
+            _stage(f"churn drained ({elapsed:.1f}s)")
+
+            # stage 2: link flaps → trace-derived convergence latency
+            trace_ms: list[float] = []
+            for _ in range(flap_rounds):
+                ls = c.links[rng.randrange(len(c.links))]
+                seen = {
+                    name: int(
+                        node.counters.get("monitor.perf_traces", 0)
+                    )
+                    for name, node in c.nodes.items()
+                }
+                c.fail_link(ls.a, ls.b)
+                got = await _new_traces(c, seen, timeout_s=30.0)
+                trace_ms.extend(
+                    t.total_ms()
+                    for t in got
+                    if t.last_event() == perf.FIB_PROGRAMMED
+                    and len(t.events) >= 5
+                )
+                c.heal_link(ls.a, ls.b)
+                await c.wait_converged(timeout=timeout_s)
+                await asyncio.sleep(0.3)
+
+            _stage("flap stage done")
+            # stage 3: forced anti-entropy sweep on the converged
+            # cluster — the delta full_sync noop-probe fast path
+            base_ae = snap()
+            for node in c.nodes.values():
+                await node.kvstore._anti_entropy()
+            t_ae = loop.time()
+            while any(
+                p.sync_task is not None and not p.sync_task.done()
+                for node in c.nodes.values()
+                for p in node.kvstore.peers.values()
+            ):
+                if loop.time() - t_ae > timeout_s:
+                    raise TimeoutError("anti-entropy sweep stuck")
+                await asyncio.sleep(0.02)
+            ae = {k: csum(k) - base_ae[k] for k in _FLOOD_COUNTERS}
+            _stage("anti-entropy swept")
+
+            # the measured path must also be a correct one: same
+            # invariant classes + quiescence gate the chaos and soak
+            # suites end every round with
+            await invariants.wait_quiescent(
+                c,
+                timeout_s=timeout_s,
+                context=f"flood-bench codec={codec} seed={seed}",
+            )
+            _stage("quiesced")
+
+            floods = churn["kvstore.floods_sent"]
+            tarr = np.array(trace_ms) if trace_ms else np.array([0.0])
+            return {
+                "codec": codec,
+                "nodes": len(c.nodes),
+                "churn_events": churn_events,
+                "churn_elapsed_s": round(elapsed, 2),
+                "floods_sent": floods,
+                # the headline throughput: deliveries per second of
+                # wire-SEAM time (counter-derived from the pure-CPU
+                # kvstore.flood_encode_ms + flood_decode_ms stats —
+                # see seam_ms_sum). The wall-clock variant is
+                # kept for context but is dominated by the rest of
+                # the pipeline (decision rebuilds, fib programming)
+                # and by this host class's sustained-load throttling
+                # (±25% between adjacent identical runs) — it cannot
+                # resolve a wire-path change; the seam measure can
+                # (docs/Wire.md)
+                "floods_per_sec": round(
+                    floods / max(seam_ms / 1e3, 1e-9), 1
+                ),
+                "wire_seam_ms": round(seam_ms, 1),
+                "floods_per_sec_wall": round(floods / elapsed, 1),
+                "flood_bytes": churn["kvstore.flood_bytes"],
+                "bytes_per_flood": round(
+                    churn["kvstore.flood_bytes"] / max(floods, 1), 1
+                ),
+                "flood_encodes": churn["kvstore.flood_encodes"],
+                "encodes_per_flood": round(
+                    churn["kvstore.flood_encodes"] / max(floods, 1), 3
+                ),
+                "keys_coalesced": churn["kvstore.flood_keys_coalesced"],
+                "convergence_p50_ms": round(
+                    float(np.percentile(tarr, 50)), 3
+                ),
+                "convergence_p99_ms": round(
+                    float(np.percentile(tarr, 99)), 3
+                ),
+                "convergence_traces": len(trace_ms),
+                "anti_entropy": {
+                    "full_syncs": ae["kvstore.full_syncs"],
+                    "noop": ae["kvstore.full_syncs_noop"],
+                    "noop_served": ae["kvstore.full_syncs_noop_served"],
+                    "probe_miss": ae["kvstore.full_sync_probe_miss"],
+                    "keys_sent": ae["kvstore.full_sync_keys_sent"],
+                },
+                "invariants": "ok",
+            }
+        finally:
+            await c.stop()
+
+    return asyncio.run(run())
+
+
 def _smoke_gate(label: str, scoped: dict, checks: dict[str, bool]) -> None:
     """Shared CI-gate core for the churn smoke lanes: every named check
     must hold, plus the clause common to EVERY lane — zero post-warmup
@@ -557,6 +912,38 @@ def main() -> None:
     )
     ap.add_argument("--topo-rounds", type=int, default=60)
     ap.add_argument(
+        "--flood-bench", action="store_true",
+        help="run the full-stack emulated-cluster flood benchmark on "
+        "BOTH wire codecs (legacy per-peer JSON vs serialize-once "
+        "binary, docs/Wire.md): floods/sec, counter-derived "
+        "bytes/flood, trace-derived convergence_p50_ms, and the delta "
+        "full_sync noop-probe counters, with the emulator invariant "
+        "checker gating each run",
+    )
+    ap.add_argument(
+        "--flood-side", type=int, default=8,
+        help="grid side for --flood-bench (8 → the 64-node headline)",
+    )
+    ap.add_argument("--flood-events", type=int, default=400)
+    ap.add_argument("--flood-flaps", type=int, default=4)
+    ap.add_argument(
+        "--flood-codec", choices=("both", "bin", "json"), default="both",
+    )
+    ap.add_argument(
+        "--flood-timeout", type=float, default=180.0,
+        help="per-stage timeout (s) inside each flood-bench run; the "
+        "64-node JSON baseline on a throttled burstable host can need "
+        "several minutes to drain — raise this rather than letting "
+        "the slow BASELINE abort the comparison",
+    )
+    ap.add_argument(
+        "--flood-repeats", type=int, default=1,
+        help="interleaved json/bin measurement rounds; each reported "
+        "comparison scalar is the per-metric median across rounds "
+        "(counters the throttled-host drift that penalizes whichever "
+        "codec runs last, without coupling noisy metrics to one run)",
+    )
+    ap.add_argument(
         "--smoke", action="store_true",
         help="CI gate mode. With --topo-churn: byte-parity checked "
         "against from-scratch compute_rib every few rounds, and the "
@@ -572,6 +959,119 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.flood_bench:
+        kw = dict(
+            side=args.flood_side,
+            churn_events=args.flood_events,
+            flap_rounds=args.flood_flaps,
+            timeout_s=args.flood_timeout,
+        )
+        codecs = (
+            ["json", "bin"]
+            if args.flood_codec == "both"
+            else [args.flood_codec]
+        )
+        # interleave codecs across repeats: this host's sustained-load
+        # throttling (burstable CPU) makes LATER runs systematically
+        # slower, so back-to-back per-codec runs would charge the drift
+        # to whichever codec ran second — time-adjacent pairs + a
+        # median per codec neutralize it
+        samples: dict[str, list[dict]] = {c: [] for c in codecs}
+        for _ in range(max(1, args.flood_repeats)):
+            for codec_name in codecs:
+                samples[codec_name].append(
+                    measure_flood(codec_name, **kw)
+                )
+        def _median(vals: list[float]) -> float:
+            vs = sorted(vals)
+            n = len(vs)
+            mid = vs[n // 2] if n % 2 else (vs[n // 2 - 1] + vs[n // 2]) / 2
+            return round(mid, 3)
+
+        # each comparison scalar is the PER-METRIC median across runs:
+        # picking one "median row" (by any single metric) would couple
+        # every other metric to that run's noise — convergence p50
+        # especially swings ±50% round-to-round on this host class,
+        # independently of which run had the median throughput
+        _MEDIAN_KEYS = (
+            "floods_per_sec", "wire_seam_ms", "floods_per_sec_wall",
+            "bytes_per_flood", "encodes_per_flood", "churn_elapsed_s",
+            "convergence_p50_ms", "convergence_p99_ms",
+        )
+        rows: dict[str, dict] = {}
+        for codec_name, runs in samples.items():
+            ordered = sorted(runs, key=lambda r: r["floods_per_sec"])
+            med = dict(ordered[(len(ordered) - 1) // 2])
+            if len(runs) > 1:
+                for k in _MEDIAN_KEYS:
+                    med[k] = _median([r[k] for r in runs])
+                med["floods_per_sec_runs"] = [
+                    r["floods_per_sec"] for r in runs
+                ]
+                med["convergence_p50_ms_runs"] = [
+                    r["convergence_p50_ms"] for r in runs
+                ]
+            rows[codec_name] = med
+        detail: dict = dict(rows)
+        if len(rows) == 2:
+            j, b = rows["json"], rows["bin"]
+            detail["bytes_per_flood_ratio"] = round(
+                j["bytes_per_flood"] / max(b["bytes_per_flood"], 1e-9), 2
+            )
+            detail["floods_per_sec_ratio"] = round(
+                b["floods_per_sec"] / max(j["floods_per_sec"], 1e-9), 2
+            )
+            detail["convergence_p50_ratio"] = round(
+                j["convergence_p50_ms"]
+                / max(b["convergence_p50_ms"], 1e-9),
+                2,
+            )
+        head = rows.get("bin") or rows["json"]
+        print(
+            json.dumps(
+                {
+                    "metric": "flood_throughput_per_sec",
+                    "value": head["floods_per_sec"],
+                    "unit": "floods/s",
+                    "vs_baseline": None,
+                    "detail": detail,
+                }
+            )
+        )
+        if args.smoke and len(rows) == 2:
+            j, b = rows["json"], rows["bin"]
+            checks = {
+                # serialize-once actually engaged: strictly fewer
+                # encodes than flood deliveries on the binary path,
+                # while the legacy path pays one encode per delivery
+                "binary path active": b["flood_encodes"] > 0
+                and b["flood_encodes"] < b["floods_sent"],
+                "delta full_sync served (noop probes)": (
+                    b["anti_entropy"]["noop_served"] > 0
+                    and b["anti_entropy"]["keys_sent"] == 0
+                ),
+                "floods/sec >= JSON baseline": (
+                    b["floods_per_sec"] >= j["floods_per_sec"]
+                ),
+                "bytes/flood reduced >= 2x": (
+                    b["bytes_per_flood"] * 2 <= j["bytes_per_flood"]
+                ),
+                # invariants: assert_invariants inside measure_flood
+                # already raised on violation; this records the fact
+                "invariants clean": all(
+                    r["invariants"] == "ok" for r in rows.values()
+                ),
+            }
+            failed = [name for name, ok in checks.items() if not ok]
+            if failed:
+                print(
+                    f"flood-bench smoke FAILED: {'; '.join(failed)} — "
+                    f"rows: {json.dumps(rows)}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        return
 
     if args.topo_churn:
         full = measure_topo_churn(

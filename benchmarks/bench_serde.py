@@ -1,0 +1,142 @@
+"""Serde micro-bench: encode/decode ns per Publication, both codecs.
+
+Measures the two wire codecs from openr_tpu.types.serde on a
+representative KvStore flood Publication (one adjacency database + two
+prefix databases as Value payloads — the shape every link-flap flood
+carries): canonical JSON (`to_wire`/`from_wire`, the legacy framing)
+vs compact binary (`to_wire_bin`/`from_wire_bin`, docs/Wire.md), plus
+the wire sizes. The flood path encodes ONCE per publication
+(serialize-once fan-out) — this bench is the per-encode cost that
+amortization multiplies.
+
+Run: python benchmarks/bench_serde.py [--iters 2000] [--adjacencies 8]
+Prints one JSON line (same contract as bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def build_publication(n_adj: int):
+    from openr_tpu.types.kvstore import Publication, Value
+    from openr_tpu.types.network import IpPrefix
+    from openr_tpu.types.serde import to_wire
+    from openr_tpu.types.topology import (
+        Adjacency,
+        AdjacencyDatabase,
+        PrefixDatabase,
+        PrefixEntry,
+    )
+
+    adj = AdjacencyDatabase(
+        this_node_name="node-17",
+        adjacencies=tuple(
+            Adjacency(
+                other_node_name=f"node-{i}",
+                if_name=f"if-node-17-node-{i}",
+                other_if_name=f"if-node-{i}-node-17",
+                metric=10 + i,
+                adj_label=50000 + i,
+            )
+            for i in range(n_adj)
+        ),
+        node_label=117,
+        area="0",
+    )
+    key_vals = {
+        "adj:node-17": Value(
+            version=7, originator_id="node-17", value=to_wire(adj)
+        ).with_hash()
+    }
+    for i in range(2):
+        pdb = PrefixDatabase(
+            this_node_name="node-17",
+            prefix_entries=(
+                PrefixEntry(prefix=IpPrefix(prefix=f"10.0.{i}.1/32")),
+            ),
+            area="0",
+        )
+        key_vals[f"prefix:node-17:0:10.0.{i}.1/32"] = Value(
+            version=3, originator_id="node-17", value=to_wire(pdb)
+        ).with_hash()
+    return Publication(
+        area="0", key_vals=key_vals, node_ids=["node-17", "node-3"]
+    )
+
+
+def _time_ns(fn, iters: int) -> float:
+    # warmup: build codec closures / jit nothing — pure python here
+    for _ in range(max(10, iters // 20)):
+        fn()
+    t0 = time.perf_counter_ns()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter_ns() - t0) / iters
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=2000)
+    ap.add_argument("--adjacencies", type=int, default=8)
+    args = ap.parse_args()
+
+    from openr_tpu.types.kvstore import Publication
+    from openr_tpu.types.serde import (
+        from_wire,
+        from_wire_bin,
+        to_wire,
+        to_wire_bin,
+    )
+
+    pub = build_publication(args.adjacencies)
+    wire_json = to_wire(pub)
+    wire_bin = to_wire_bin(pub)
+    assert from_wire_bin(wire_bin, Publication) == from_wire(
+        wire_json, Publication
+    )
+
+    detail = {
+        "iters": args.iters,
+        "adjacencies": args.adjacencies,
+        "json_bytes": len(wire_json),
+        "bin_bytes": len(wire_bin),
+        "size_ratio": round(len(wire_json) / len(wire_bin), 2),
+        "json_encode_ns": round(_time_ns(lambda: to_wire(pub), args.iters)),
+        "json_decode_ns": round(
+            _time_ns(lambda: from_wire(wire_json, Publication), args.iters)
+        ),
+        "bin_encode_ns": round(
+            _time_ns(lambda: to_wire_bin(pub), args.iters)
+        ),
+        "bin_decode_ns": round(
+            _time_ns(lambda: from_wire_bin(wire_bin, Publication), args.iters)
+        ),
+    }
+    detail["encode_speedup"] = round(
+        detail["json_encode_ns"] / max(detail["bin_encode_ns"], 1), 2
+    )
+    detail["decode_speedup"] = round(
+        detail["json_decode_ns"] / max(detail["bin_decode_ns"], 1), 2
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serde_bin_encode_ns",
+                "value": detail["bin_encode_ns"],
+                "unit": "ns",
+                "vs_baseline": None,
+                "detail": detail,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,8 @@ if [ "$smoke_rc" -ne 1 ]; then
     echo "$smoke_out"
     exit 1
 fi
-for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 OR010; do
+for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
+            OR010 OR011; do
     if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
         echo "orlint smoke: rule $code produced no finding on the" \
              "known-bad fixture (rule deleted or broken?)"
@@ -47,7 +48,7 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 OR010; do
         exit 1
     fi
 done
-echo "ok: known-bad fixture trips all 10 rules"
+echo "ok: known-bad fixture trips all 11 rules"
 
 echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # the topology-delta acceptance gate (docs/Decision.md): single-link
@@ -67,6 +68,21 @@ echo "== prefix-churn smoke (scoped-path counters + compile ledger gate) =="
 # prefix_only with zero SPF solves and zero post-warmup compiles
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --prefix-churn --nodes 80 --prefix-rounds 40 --smoke --backend cpu
+
+echo "== flood-throughput smoke (binary wire vs JSON baseline) =="
+# the wire-format acceptance gate (docs/Wire.md): on a small emulated
+# grid, BOTH codecs run the same seeded churn + flap + anti-entropy
+# workload and bench_churn --smoke exits 1 unless the binary path is
+# active (serialize-once counter-asserted: flood_encodes < floods_sent),
+# delta full_sync noop probes were served with zero keys shipped,
+# floods/sec >= the JSON baseline, bytes/flood is reduced >= 2x, and
+# the emulator invariant checker stayed clean on both codecs
+JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
+    --flood-bench --flood-side 4 --flood-events 120 --flood-flaps 2 \
+    --smoke --backend cpu
+
+echo "== serde micro-bench (encode/decode ns per Publication) =="
+JAX_PLATFORMS=cpu python benchmarks/bench_serde.py --iters 500
 
 echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
 # the tier-1-safe slice of the long-horizon soak: storms + background

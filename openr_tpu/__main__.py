@@ -81,6 +81,10 @@ async def run_node(config: Config, dataplane: str, store_path: str | None):
         store_path=store_path,
     )
     node.kvstore.register_rpc(kv_rpc)
+    # wire-level byte accounting (rpc.bytes_tx/rx): the listener exists
+    # before the node's Counters do, so attach post-construction —
+    # connections only arrive after start()
+    kv_rpc.counters = node.counters
 
     iface_src = None
     if dataplane == "netlink":

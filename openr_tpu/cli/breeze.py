@@ -942,6 +942,38 @@ def monitor_queues(ctx):
     click.echo(_table(rows, ["queue", *fields]))
 
 
+@monitor.command("wire")
+@click.pass_context
+def monitor_wire(ctx):
+    """Wire-level byte accounting (docs/Wire.md): rpc tx/rx volume,
+    binary-upgraded connections, flood bytes + serialize-once encode
+    ratio, and the delta full_sync activity."""
+    rpc_c = _run(ctx, "get_counters", {"prefix": "rpc."})
+    kv = _run(ctx, "get_counters", {"prefix": "kvstore."})
+    floods = kv.get("kvstore.floods_sent", 0)
+    fbytes = kv.get("kvstore.flood_bytes", 0)
+    encodes = kv.get("kvstore.flood_encodes", 0)
+    rows = [
+        ["rpc.bytes_tx", f"{int(rpc_c.get('rpc.bytes_tx', 0))}"],
+        ["rpc.bytes_rx", f"{int(rpc_c.get('rpc.bytes_rx', 0))}"],
+        ["rpc.conns_binary", f"{int(rpc_c.get('rpc.conns_binary', 0))}"],
+        ["kvstore.flood_bytes", f"{int(fbytes)}"],
+        ["kvstore.floods_sent", f"{int(floods)}"],
+        ["bytes/flood", f"{fbytes / floods:.1f}" if floods else "-"],
+        ["kvstore.flood_encodes", f"{int(encodes)}"],
+        ["encodes/flood", f"{encodes / floods:.3f}" if floods else "-"],
+        [
+            "kvstore.full_sync_keys_sent",
+            f"{int(kv.get('kvstore.full_sync_keys_sent', 0))}",
+        ],
+        [
+            "kvstore.full_syncs_noop",
+            f"{int(kv.get('kvstore.full_syncs_noop', 0))}",
+        ],
+    ]
+    click.echo(_table(rows, ["wire counter", "value"]))
+
+
 @monitor.command("prometheus")
 @click.pass_context
 def monitor_prometheus(ctx):

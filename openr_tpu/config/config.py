@@ -30,6 +30,11 @@ class SparkConfig:
     keepalive_time_ms: int = C.SPARK_HEARTBEAT_INTERVAL_MS
     hold_time_ms: int = C.SPARK_HOLD_TIME_MS
     graceful_restart_time_ms: int = C.SPARK_GR_HOLD_TIME_MS
+    # tx packet framing (docs/Wire.md): "bin" = compact binary, "json"
+    # = legacy canonical JSON. RX always sniffs, so mixed-codec
+    # neighbors interoperate. (Appended field: binary wire schema
+    # evolution is append-only.)
+    wire_codec: str = "bin"
 
 
 @dataclass
@@ -344,9 +349,11 @@ class Config:
         return Config(replace(NodeConfig(node_name=node_name), **overrides))
 
     def to_json(self) -> str:
-        from openr_tpu.types.serde import to_wire
+        from openr_tpu.types.serde import to_jsonable
 
-        return json.dumps(json.loads(to_wire(self.node)), indent=2)
+        # straight through the jsonable tree — no encode-to-canonical-
+        # bytes-then-reparse round trip
+        return json.dumps(to_jsonable(self.node), indent=2)
 
     # ---- validation (reference: Config::populateInternalDb checks †) ------
 
@@ -377,6 +384,8 @@ class Config:
                 "spark: hold_time must be >= 3x keepalive "
                 "(reference: Config.cpp † hold/keepalive check)"
             )
+        if s.wire_codec not in ("bin", "json"):
+            raise ConfigError("spark: wire_codec must be bin|json")
         d = n.decision
         if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
             raise ConfigError("decision: debounce min must be <= max")

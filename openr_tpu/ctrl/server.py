@@ -35,7 +35,9 @@ class CtrlServer(OpenrModule):
         self.host = host
         self._requested_port = port
         self.port: int | None = None
-        self.server = RpcServer(name=self.name)
+        # counters: the ctrl plane shares the node's rpc.bytes_tx/rx
+        # byte accounting and answers the binary-codec negotiation
+        self.server = RpcServer(name=self.name, counters=node.counters)
         # readers must exist before any module starts pushing
         self._kv_reader = node.kvstore_pubs.get_reader(f"{self.name}.kvsub")
         self._fib_reader = node.fib_updates.get_reader(f"{self.name}.fibsub")

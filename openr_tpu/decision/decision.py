@@ -664,7 +664,10 @@ class Decision(OpenrModule):
         == input) — the soundness-critical validation shared by BOTH
         splice tiers; returns the Adjacency or None."""
         try:
-            adj = _ADJ_DEC(json.loads(b"{%s}" % body))
+            # Value PAYLOADS are canonical JSON by contract (docs/
+            # Wire.md): the splice proof below re-encodes and compares
+            # bytes, which only works against the canonical text form
+            adj = _ADJ_DEC(json.loads(b"{%s}" % body))  # orlint: disable=OR011
         except Exception:  # noqa: BLE001 — structural proof failed
             return None
         if to_wire(adj) != b"{%s}" % body:
@@ -765,7 +768,9 @@ class Decision(OpenrModule):
         with self._decode_stats_lock:
             self.decode_stats[tier] += 1
         if entry is None:
-            raw = json.loads(payload)
+            # full-parse tier of the same Value-payload decode cache:
+            # payloads are canonical JSON by contract (docs/Wire.md)
+            raw = json.loads(payload)  # orlint: disable=OR011
             raws = raw.pop("adjacencies", None) or []
             if prev is not None and prev["raws"] is not None:
                 prev_raws, prev_objs = prev["raws"], prev["adjs"]

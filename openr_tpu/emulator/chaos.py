@@ -310,17 +310,31 @@ class ChaosKvTransport:
     def unregister(self, node_name: str) -> None:
         self._inner.unregister(node_name)
 
-    async def connect(self, peer_id: str, endpoint):
+    async def connect(self, peer_id: str, endpoint, counters=None):
         if self.plan.kv_blocked(self.owner, peer_id):
             self.plan.note("kv.connect_blocked")
             raise ConnectionError(
                 f"chaos: kv partition {self.owner} | {peer_id}"
             )
-        session = await self._inner.connect(peer_id, endpoint)
+        session = await self._inner.connect(
+            peer_id, endpoint, counters=counters
+        )
         return _ChaosKvSession(session, self.plan, self.owner, peer_id)
+
+    @property
+    def codec(self) -> str | None:
+        """Expose the wrapped transport's wire codec so KvStore's
+        serialize-once fan-out stays active under chaos."""
+        return getattr(self._inner, "codec", None)
 
 
 class _ChaosKvSession:
+    @property
+    def codec(self):
+        """Delegate the per-session wire codec so KvStore's serialize-
+        once drain check sees through the chaos wrapper."""
+        return getattr(self._inner, "codec", None)
+
     def __init__(self, inner, plan: ChaosPlan, owner: str, peer_id: str):
         self._inner = inner
         self.plan = plan
@@ -350,13 +364,15 @@ class _ChaosKvSession:
                 f"{self.owner} -> {self.peer_id}"
             )
 
-    async def full_sync(self, area, sender_id, digest):
+    async def full_sync(self, area, sender_id, digest, store_hash=None):
         await self._gate("full_sync", self.plan.kv_faults.fail_full_sync)
-        return await self._inner.full_sync(area, sender_id, digest)
+        return await self._inner.full_sync(
+            area, sender_id, digest, store_hash=store_hash
+        )
 
     async def flood(self, pub):
         await self._gate("flood", self.plan.kv_faults.fail_flood)
-        await self._inner.flood(pub)
+        return await self._inner.flood(pub)
 
     async def dual_messages(self, area, sender, msgs):
         await self._gate("dual", 0.0)

@@ -114,8 +114,15 @@ class Cluster:
         enable_ctrl: bool = False,
         chaos=None,
         node_config_transform=None,
+        wire_codec: str = "bin",
     ) -> "Cluster":
         c = Cluster(solver=solver, enable_ctrl=enable_ctrl, chaos=chaos)
+        # wire codec for the whole emulated cluster (docs/Wire.md):
+        # "bin" = serialize-once compact binary floods + binary Spark
+        # packets (the production path chaos/soak validate); "json" =
+        # the legacy per-peer text framing (bench_churn --flood-bench's
+        # measured baseline)
+        c.transport = InProcKvTransport(codec=wire_codec)
         if chaos is not None:
             from openr_tpu.emulator.chaos import ChaosIoHub
 
@@ -169,6 +176,7 @@ class Cluster:
                     debounce_min_ms=debounce_ms[0],
                     debounce_max_ms=debounce_ms[1],
                 ),
+                spark=replace(ncfg.spark, wire_codec=wire_codec),
             )
             if node_config_transform is not None:
                 # last word on every node's config (e.g. the soak's
@@ -197,6 +205,7 @@ class Cluster:
         enable_ctrl: bool = False,
         chaos=None,
         node_config_transform=None,
+        wire_codec: str = "bin",
     ) -> "Cluster":
         links = [
             e if isinstance(e, LinkSpec) else LinkSpec(a=e[0], b=e[1])
@@ -210,6 +219,7 @@ class Cluster:
         return Cluster.build(
             specs, links, solver=solver, enable_ctrl=enable_ctrl, chaos=chaos,
             node_config_transform=node_config_transform,
+            wire_codec=wire_codec,
         )
 
     def _transport_for(self, name: str):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -19,9 +20,15 @@ from openr_tpu.common.constants import DEFAULT_AREA
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
 from openr_tpu.kvstore.store import KvStoreDb
-from openr_tpu.kvstore.transport import pub_from_json, pub_to_json
+from openr_tpu.kvstore.transport import (
+    decode_flood_params,
+    pub_from_json,
+    pub_to_json,
+    pub_wire_bin,
+)
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue
 from openr_tpu.monitor import perf
+from openr_tpu.rpc import RpcError
 from openr_tpu.types.kvstore import KeyDumpParams, Publication, Value
 
 log = logging.getLogger(__name__)
@@ -61,11 +68,30 @@ class _Peer:
         )
         self.flood_failures = 0
         self.sync_task: "asyncio.Task | None" = None
+        # a completed full sync unlocks the anti-entropy noop probe:
+        # later re-syncs open with a digestless store-hash compare and
+        # only ship the per-key digest on mismatch (docs/Wire.md)
+        self.probe_ok = False
+        # legacy-responder fallback (docs/Wire.md migration story): a
+        # pre-delta peer rejects the compact triple digest (its
+        # value_from_json chokes on a list), surfacing as a handler
+        # error — after one such rejection this peer's syncs use the
+        # old hash-only Value-dict digest, which BOTH builds accept.
+        # Reset on peer flap (the _Peer is rebuilt), so an upgraded
+        # neighbor is re-probed with the delta form.
+        self.legacy_sync = False
         # pending flood state (coalesced by key: versions only grow, so
         # replacing an unsent value with a newer one is always correct)
         self.pending_keys: dict[str, Value] = {}
         self.pending_expired: set[str] = set()
         self.pending_perf = None  # merged trace of the pending backlog
+        # serialize-once fast path: when the pending buffer holds
+        # exactly one unmerged Publication, this is THAT object — its
+        # cached wire frame (already encoded, and shared with every
+        # other peer that adopted it wholesale) goes out verbatim.
+        # Any coalescing on top voids it and the drain falls back to
+        # rebuilding a per-peer Publication from the merged buffer.
+        self.pending_src: "Publication | None" = None
         self.flood_wake = asyncio.Event()
         self.flood_task: "asyncio.Task | None" = None
 
@@ -232,14 +258,49 @@ class KvStore(OpenrModule):
             try:
                 if peer.session is None:
                     peer.session = await self.transport.connect(
-                        peer.spec.node_name, peer.spec.endpoint
+                        peer.spec.node_name, peer.spec.endpoint,
+                        counters=self.counters,
                     )
-                digest = {
-                    k: pub_to_json_value(v) for k, v in db.digest().items()
-                }
-                pub = await peer.session.full_sync(
-                    area, self.node_name, digest
+                own_hash = db.store_hash()
+                # delta sync (docs/Wire.md): after the first successful
+                # sync, open with a digestless store-hash probe — a
+                # converged pair answers "noop" for a handful of bytes
+                # instead of re-shipping the whole per-key digest every
+                # anti-entropy round. A peer flagged legacy_sync gets
+                # the pre-delta hash-only Value-dict digest instead
+                # (old responders reject the triple form).
+                if peer.legacy_sync:
+                    digest = {
+                        k: pub_to_json_value(v)
+                        for k, v in db.digest().items()
+                    }
+                    if self.counters is not None:
+                        self.counters.increment("kvstore.full_syncs_legacy")
+                else:
+                    digest = None if peer.probe_ok else db.digest_triples()
+                raw = await peer.session.full_sync(
+                    area, self.node_name, digest, store_hash=own_hash
                 )
+                if isinstance(raw, dict) and raw.get("need_digest"):
+                    # probe missed: peer's store differs — one more
+                    # round trip with the real digest (same attempt, no
+                    # backoff penalty)
+                    if self.counters is not None:
+                        self.counters.increment(
+                            "kvstore.full_sync_probe_miss"
+                        )
+                    # recompute the hash for the retry: a flood landing
+                    # during the probe await may have moved our store,
+                    # and a stale hash could spuriously match the
+                    # responder's post-convergence state
+                    raw = await peer.session.full_sync(
+                        area, self.node_name, db.digest_triples(),
+                        store_hash=db.store_hash(),
+                    )
+                if isinstance(raw, dict) and raw.get("noop"):
+                    if self.counters is not None:
+                        self.counters.increment("kvstore.full_syncs_noop")
+                pub = pub_from_json(raw)
                 self._apply(area, pub, from_peer=peer.spec.node_name)
                 # send back what the peer asked for (3-way sync)
                 if pub.to_be_updated_keys:
@@ -255,6 +316,10 @@ class KvStore(OpenrModule):
                             )
                         )
                 peer.synced = True
+                # legacy responders ignore a digestless probe's intent
+                # (None digest reads as empty → they dump their whole
+                # store), so only delta-capable pairs unlock it
+                peer.probe_ok = not peer.legacy_sync
                 peer.backoff.report_success()
                 # un-gate the flood pump: publications buffered while the
                 # peer was sessionless flush now, as one coalesced batch
@@ -270,6 +335,13 @@ class KvStore(OpenrModule):
                 raise
             except Exception as e:  # noqa: BLE001
                 log.debug("%s: sync with %s failed: %s", self.name, peer.spec.node_name, e)
+                # a handler-level rejection (RpcError, not a transport
+                # ConnectionError) from a peer we offered the delta
+                # digest most likely means a pre-delta build choked on
+                # the triple form — retry in the legacy format, which
+                # every build accepts (docs/Wire.md migration story)
+                if not peer.legacy_sync and isinstance(e, RpcError):
+                    peer.legacy_sync = True
                 peer.backoff.report_error()
                 if peer.session is not None:
                     peer.session = None
@@ -351,13 +423,27 @@ class KvStore(OpenrModule):
         one."""
         ft = self.flood_topos.get(area)
         spt: set[str] | None = ft.flood_peers() if ft is not None else None
-        for (parea, pname), peer in self.peers.items():
-            if parea != area or pname == exclude:
-                continue
-            if pname in pub.node_ids:
-                continue
-            if spt is not None and pname not in spt:
-                continue
+        targets = [
+            peer
+            for (parea, pname), peer in self.peers.items()
+            if parea == area
+            and pname != exclude
+            and pname not in pub.node_ids
+            and (spt is None or pname in spt)
+        ]
+        if any(
+            getattr(p.session, "codec", None) == "bin" for p in targets
+        ):
+            # serialize-once (docs/Wire.md): encode the publication NOW,
+            # synchronously — before Decision/Fib (draining the local
+            # queue) stamp their perf markers on the shared trace, and
+            # exactly once for all N fan-out targets. Every drain pump
+            # that adopts this publication wholesale ships these bytes.
+            # Gated on a NEGOTIATED binary session existing (not the
+            # transport's preference): an all-JSON peer set would pay
+            # this encode for a frame nobody ships
+            pub_wire_bin(pub, self.counters)
+        for peer in targets:
             # sessionless (backed-off / reconnecting) peers still get the
             # update QUEUED: it coalesces into the per-peer pending
             # buffer and flushes when the sync task re-establishes the
@@ -373,6 +459,15 @@ class KvStore(OpenrModule):
         store.merge_key_values): a queued value is only replaced by one
         that would win the merge, so out-of-order local enqueues can
         never regress what the peer eventually receives."""
+        # serialize-once eligibility: an EMPTY buffer adopting this
+        # publication wholesale can flood pub's pre-encoded frame
+        # verbatim; anything already buffered means the drain must
+        # rebuild a coalesced per-peer publication instead
+        fresh = (
+            not peer.pending_keys
+            and not peer.pending_expired
+            and peer.pending_perf is None
+        )
         coalesced = 0
         for k, v in pub.key_vals.items():
             cur = peer.pending_keys.get(k)
@@ -417,6 +512,9 @@ class KvStore(OpenrModule):
                 if peer.pending_perf is None
                 else peer.pending_perf.merge(pub.perf_events)
             )
+        peer.pending_src = (
+            pub if fresh and pub._wire_cache is not None else None
+        )
         if coalesced and self.counters is not None:
             self.counters.increment("kvstore.flood_keys_coalesced", coalesced)
         # backpressure: a peer that can't drain fast enough gets a bounded
@@ -430,6 +528,7 @@ class KvStore(OpenrModule):
                 )
             peer.pending_keys.clear()
             peer.pending_expired.clear()
+            peer.pending_src = None
             peer.synced = False
             self._spawn_sync(peer)
             return
@@ -479,17 +578,33 @@ class KvStore(OpenrModule):
             kv, peer.pending_keys = peer.pending_keys, {}
             exp, peer.pending_expired = peer.pending_expired, set()
             pe, peer.pending_perf = peer.pending_perf, None
-            # node_ids carries only us: per-key provenance is lost when
-            # coalescing across publications, and understating node_ids is
-            # safe — a duplicate delivery is rejected by merge() and never
-            # re-flooded, so loops still terminate
-            pub = Publication(
-                area=peer.spec.area,
-                key_vals=kv,
-                expired_keys=sorted(exp),
-                node_ids=[self.node_name],
-                perf_events=pe,
-            )
+            src, peer.pending_src = peer.pending_src, None
+            if src is not None and (
+                getattr(peer.session, "codec", None) == "bin"
+            ):
+                # serialize-once fast path: the buffer holds exactly one
+                # unmerged publication whose wire frame was encoded at
+                # fan-out time — every peer in this state ships the SAME
+                # immutable bytes (pe is the PR4 defensive trace copy of
+                # src.perf_events; the frozen frame supersedes it).
+                # Gated on the SESSION's negotiated codec, not the
+                # transport's preference: a JSON-negotiated old peer
+                # would re-serialize src freshly — leaking the live
+                # shared trace the rebuild path's pe copy exists to
+                # protect — so it takes the rebuild branch instead
+                pub = src
+            else:
+                # node_ids carries only us: per-key provenance is lost
+                # when coalescing across publications, and understating
+                # node_ids is safe — a duplicate delivery is rejected by
+                # merge() and never re-flooded, so loops still terminate
+                pub = Publication(
+                    area=peer.spec.area,
+                    key_vals=kv,
+                    expired_keys=sorted(exp),
+                    node_ids=[self.node_name],
+                    perf_events=pe,
+                )
             session = peer.session
             if session is None:
                 # session died during the rate-limit wait: fold the batch
@@ -508,9 +623,16 @@ class KvStore(OpenrModule):
                 continue
             try:
                 t0 = asyncio.get_running_loop().time()
-                await session.flood(pub)
+                nbytes = await session.flood(pub)
                 if self.counters is not None:
                     self.counters.increment("kvstore.floods_sent")
+                    if nbytes:
+                        # wire-derived (the session reports the actual
+                        # frame size), so bench bytes/flood is counter
+                        # math, not an estimate
+                        self.counters.increment(
+                            "kvstore.flood_bytes", nbytes
+                        )
                     self.counters.add_value(
                         "kvstore.flood_fanout_ms",
                         (asyncio.get_running_loop().time() - t0) * 1e3,
@@ -538,20 +660,55 @@ class KvStore(OpenrModule):
                 # re-sync repairs whatever the failed flood carried
                 peer.pending_keys.clear()
                 peer.pending_expired.clear()
+                peer.pending_src = None
                 self._spawn_sync(peer)
 
     # ---------------------------------------------------- transport handlers
 
     async def handle_full_sync(self, params: dict) -> dict:
         """Respond to a peer's FULL_SYNC request (reference: KvStoreDb
-        processThriftRequest KEY_DUMP w/ keyValHashes †)."""
+        processThriftRequest KEY_DUMP w/ keyValHashes †).
+
+        Delta protocol (docs/Wire.md): the requester ships a
+        (key → [version, originator, hash]) digest and gets back ONLY
+        missing/newer entries plus a ``store_hash`` trailer. A
+        digestless request whose ``store_hash`` matches ours short-
+        circuits to a noop reply (the anti-entropy fast path); on
+        mismatch the responder asks for the digest (``need_digest``).
+        Legacy peers that send hash-only Value dicts — or no
+        store_hash at all — take the same compare path unchanged."""
         area = params["area"]
-        digest_raw = params.get("digest") or {}
+        digest_raw = params.get("digest")
         db = self.dbs.get(area)
         if db is None:
             return pub_to_json(Publication(area=area))
+        own_hash = db.store_hash()
+        their_hash = params.get("store_hash")
+        # the noop short-circuit serves DIGESTLESS probes only: a
+        # request that carries a digest gets the full compare even on
+        # hash match — the requester may have moved since it computed
+        # the hash, and discarding its fresh digest would strand the
+        # 3-way exchange until the next anti-entropy round
+        if digest_raw is None and their_hash is not None and their_hash == own_hash:
+            if self.counters is not None:
+                self.counters.increment("kvstore.full_syncs_served")
+                self.counters.increment("kvstore.full_syncs_noop_served")
+            out = pub_to_json(
+                Publication(area=area, node_ids=[self.node_name])
+            )
+            out["store_hash"] = own_hash
+            out["noop"] = True
+            return out
+        if digest_raw is None:
+            # probe miss from a delta-capable peer: ask for the digest
+            out = pub_to_json(
+                Publication(area=area, node_ids=[self.node_name])
+            )
+            out["store_hash"] = own_hash
+            out["need_digest"] = True
+            return out
         theirs = {
-            k: value_from_json(v) for k, v in digest_raw.items()
+            k: _digest_entry(v) for k, v in digest_raw.items()
         }
         to_send: dict[str, Value] = {}
         they_need: list[str] = []
@@ -562,8 +719,7 @@ class KvStore(OpenrModule):
                 to_send[k] = v
                 continue
             have = (ours[k].version, ours[k].originator_id, ours[k].with_hash().hash)
-            their = (t.version, t.originator_id, t.hash)
-            if have > their:
+            if have > t:
                 to_send[k] = v
         for k, t in theirs.items():
             cur = ours.get(k)
@@ -571,7 +727,7 @@ class KvStore(OpenrModule):
                 they_need.append(k)
             else:
                 have = (cur.version, cur.originator_id, cur.with_hash().hash)
-                if (t.version, t.originator_id, t.hash) > have:
+                if t > have:
                     they_need.append(k)
         pub = Publication(
             area=area,
@@ -581,13 +737,28 @@ class KvStore(OpenrModule):
         )
         if self.counters is not None:
             self.counters.increment("kvstore.full_syncs_served")
-        return pub_to_json(pub)
+            self.counters.increment(
+                "kvstore.full_sync_keys_sent", len(to_send)
+            )
+        out = pub_to_json(pub)
+        out["store_hash"] = own_hash
+        return out
 
     async def handle_flood(self, params: dict) -> None:
-        pub = pub_from_json(params["pub"])
+        t0 = time.perf_counter()
+        pub = decode_flood_params(params)
         sender = pub.node_ids[-1] if pub.node_ids else None
         if self.counters is not None:
             self.counters.increment("kvstore.floods_received")
+            # pure-CPU decode cost of the wire seam (no awaits inside:
+            # not inflated by event-loop queueing the way the wall-
+            # clock kvstore.flood_fanout_ms latency stat is) — the
+            # flood bench derives its seam floods/sec from this plus
+            # kvstore.flood_encode_ms (docs/Wire.md)
+            self.counters.add_value(
+                "kvstore.flood_decode_ms",
+                (time.perf_counter() - t0) * 1e3,
+            )
         self._apply(pub.area, pub, from_peer=sender)
 
     async def handle_dual_messages(self, params: dict) -> None:
@@ -697,6 +868,16 @@ class KvStore(OpenrModule):
                 self._publish(pub)
                 # expiry is local-clock-driven on every store; no flood
                 # (reference: ttl countdown is per-store †)
+
+
+def _digest_entry(raw) -> tuple:
+    """One full-sync digest entry → (version, originator, hash).
+    Accepts both the compact triple form this build sends and the
+    legacy hash-only Value dict an old peer ships (docs/Wire.md)."""
+    if isinstance(raw, (list, tuple)) and len(raw) == 3:
+        return (raw[0], raw[1], raw[2])
+    v = value_from_json(raw)
+    return (v.version, v.originator_id, v.hash)
 
 
 def pub_to_json_value(v: Value) -> dict:

@@ -8,6 +8,7 @@ ttlVersion) is what makes flooding converge to one winner everywhere.
 
 from __future__ import annotations
 
+import hashlib
 import time
 
 from openr_tpu.types.kvstore import TTL_INFINITY, KeyDumpParams, Value
@@ -86,11 +87,17 @@ class KvStoreDb:
         self.counters = counters
         self.kv: dict[str, Value] = {}
         self._expiry: dict[str, float] = {}  # key -> monotonic deadline
+        # store-hash cache: _rev bumps on every mutation (merge accept /
+        # expiry), so the O(n) hash only recomputes when the store moved
+        self._rev = 0
+        self._hash_at_rev: tuple[int, int] | None = None  # (rev, hash)
 
     # ---- merge/apply ------------------------------------------------------
 
     def merge(self, key_vals: dict[str, Value]) -> tuple[dict[str, Value], list[str]]:
         accepted, stale = merge_key_values(self.kv, key_vals)
+        if accepted:
+            self._rev += 1
         now = time.monotonic()
         for key, v in accepted.items():
             cur = self.kv.get(key)
@@ -117,8 +124,10 @@ class KvStoreDb:
         for k in dead:
             self._expiry.pop(k, None)
             self.kv.pop(k, None)
-        if dead and self.counters is not None:
-            self.counters.increment("kvstore.expired_keys", len(dead))
+        if dead:
+            self._rev += 1
+            if self.counters is not None:
+                self.counters.increment("kvstore.expired_keys", len(dead))
         return dead
 
     def remaining_ttl_ms(self, key: str) -> int:
@@ -171,3 +180,36 @@ class KvStoreDb:
             )
             for k, v in self.kv.items()
         }
+
+    def digest_triples(self) -> dict[str, list]:
+        """Compact full-sync digest: key → [version, originator, hash]
+        (exactly the tuple the responder's delta compare uses —
+        docs/Wire.md). ~4x smaller on the wire than hash-only Values."""
+        return {
+            k: [v.version, v.originator_id, v.with_hash().hash]
+            for k, v in self.kv.items()
+        }
+
+    def store_hash(self) -> int:
+        """Order-independent 63-bit hash of the whole store over the
+        delta-sync identity tuples (key, version, originator,
+        value-hash) — equal stores hash equal on every node. Used as
+        the full-sync trailer and the anti-entropy noop probe
+        (docs/Wire.md): matching hashes skip the digest exchange
+        entirely. Cached per store revision; TTL countdown state is
+        deliberately excluded (it is local-clock-relative)."""
+        cached = self._hash_at_rev
+        if cached is not None and cached[0] == self._rev:
+            return cached[1]
+        acc = 0
+        for k, v in self.kv.items():
+            e = hashlib.blake2b(digest_size=8)
+            e.update(k.encode())
+            e.update(v.with_hash().hash.to_bytes(8, "big"))
+            acc ^= int.from_bytes(e.digest(), "big") >> 1
+        # never 0 for a non-empty store (0 is the "empty" sentinel a
+        # fresh peer naturally reports)
+        if self.kv and acc == 0:
+            acc = 1
+        self._hash_at_rev = (self._rev, acc)
+        return acc

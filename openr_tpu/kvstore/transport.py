@@ -3,24 +3,42 @@
 reference: the KvStore peering is thrift-client sessions in the reference
 (KvStorePeer with FBThrift client †); tests wire N stores in one process
 (KvStoreWrapper †). The seam here makes both cases one interface.
+
+Wire discipline (docs/Wire.md): both transports default to the compact
+binary codec with a **serialize-once** flood path — a Publication fanned
+out to N peers is encoded exactly one time (the frame is cached on the
+Publication itself) and every session ships the same immutable bytes.
+``codec="json"`` keeps the legacy per-peer canonical-JSON encode for
+mixed-version interop and as the measured baseline (bench_churn
+--flood-bench). ``flood`` returns the frame size so KvStore's
+``kvstore.flood_bytes`` accounting is wire-derived, not estimated.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Protocol
 
-from openr_tpu.rpc import RpcClient, RpcError
+from openr_tpu.rpc import RpcClient, RpcError, bin_frame
 from openr_tpu.types.kvstore import Publication
-from openr_tpu.types.serde import from_jsonable, to_jsonable
+from openr_tpu.types.serde import (
+    from_jsonable,
+    from_wire,
+    from_wire_bin,
+    to_jsonable,
+    to_wire,
+    to_wire_bin,
+)
 
 
 class KvPeerSession(Protocol):
     async def full_sync(
-        self, area: str, sender_id: str, digest: dict
-    ) -> Publication: ...
+        self, area: str, sender_id: str, digest: dict | None,
+        store_hash: int | None = None,
+    ) -> dict: ...
 
-    async def flood(self, pub: Publication) -> None: ...
+    async def flood(self, pub: Publication) -> int: ...
 
     async def dual_messages(
         self, area: str, sender: str, msgs: list[dict]
@@ -41,11 +59,79 @@ def pub_from_json(raw: dict) -> Publication:
     return from_jsonable(raw, Publication)
 
 
+# ------------------------------------------------------- serialize-once
+
+
+def pub_wire_bin(pub: Publication, counters=None) -> bytes:
+    """The Publication's compact-binary serde blob, encoded AT MOST once
+    per Publication object (the flood fan-out's zero-copy contract:
+    every peer session reuses these bytes). ``kvstore.flood_encodes``
+    counts actual encodes — the bench asserts encodes ≪ deliveries."""
+    cache = pub._wire_cache
+    if cache is None:
+        cache = pub._wire_cache = {}
+    blob = cache.get("bin")
+    if blob is None:
+        t0 = time.perf_counter()
+        blob = cache["bin"] = to_wire_bin(pub)
+        if counters is not None:
+            counters.increment("kvstore.flood_encodes")
+            # pure-CPU encode cost; with kvstore.flood_decode_ms it is
+            # the wire-seam time the flood bench derives floods/sec
+            # from (docs/Wire.md) — no awaits, so event-loop queueing
+            # can't inflate it the way kvstore.flood_fanout_ms is
+            counters.add_value(
+                "kvstore.flood_encode_ms",
+                (time.perf_counter() - t0) * 1e3,
+            )
+    return blob
+
+
+def pub_flood_frame(pub: Publication, counters=None) -> bytes:
+    """The complete, immutable ``kv.flood`` RPC notification frame for
+    a binary connection. Identical for every peer (notifications carry
+    no request id), so the whole frame is cached alongside the blob and
+    TCP fan-out is a pure ``writer.write(frame)`` per peer."""
+    cache = pub._wire_cache
+    if cache is None:
+        cache = pub._wire_cache = {}
+    frame = cache.get("rpc_bin")
+    if frame is None:
+        frame = cache["rpc_bin"] = bin_frame(
+            {
+                "method": "kv.flood",
+                "params": {"pub_bin": pub_wire_bin(pub, counters)},
+            }
+        )
+    return frame
+
+
+def decode_flood_params(params: dict) -> Publication:
+    """Decode one ``kv.flood`` params dict, whichever codec it used:
+    ``pub_bin`` (serde binary blob), ``pub_wire`` (canonical JSON
+    bytes), or legacy ``pub`` (jsonable tree)."""
+    blob = params.get("pub_bin")
+    if blob is not None:
+        return from_wire_bin(blob, Publication)
+    wire = params.get("pub_wire")
+    if wire is not None:
+        return from_wire(wire, Publication)
+    return pub_from_json(params["pub"])
+
+
 class InProcKvTransport:
     """Registry-based direct delivery for multi-store-per-process tests
-    (reference pattern: KvStoreWrapper wiring N stores in one binary †)."""
+    (reference pattern: KvStoreWrapper wiring N stores in one binary †).
 
-    def __init__(self):
+    Floods still cross a real encode/decode boundary (bytes in, bytes
+    out) so the emulated cluster measures the codec honestly:
+    ``codec="bin"`` is the serialize-once binary path, ``codec="json"``
+    reproduces the legacy per-peer canonical-JSON cost model.
+    """
+
+    def __init__(self, codec: str = "bin"):
+        assert codec in ("bin", "json"), codec
+        self.codec = codec
         self._stores: dict[str, Any] = {}  # node_name -> KvStore
 
     def register(self, node_name: str, store: Any) -> None:
@@ -54,16 +140,28 @@ class InProcKvTransport:
     def unregister(self, node_name: str) -> None:
         self._stores.pop(node_name, None)
 
-    async def connect(self, peer_id: str, endpoint: Any) -> "_InProcSession":
+    async def connect(
+        self, peer_id: str, endpoint: Any, counters=None
+    ) -> "_InProcSession":
         if peer_id not in self._stores:
             raise ConnectionError(f"no in-proc store {peer_id!r}")
-        return _InProcSession(self, peer_id)
+        return _InProcSession(self, peer_id, counters=counters)
 
 
 class _InProcSession:
-    def __init__(self, transport: InProcKvTransport, peer_id: str):
+    def __init__(
+        self, transport: InProcKvTransport, peer_id: str, counters=None
+    ):
         self._t = transport
         self.peer_id = peer_id
+        self.counters = counters  # the CONNECTING node's registry
+
+    @property
+    def codec(self) -> str:
+        """This session's wire codec (the transport-wide knob: in-proc
+        has no per-connection negotiation). KvStore's flood drain ships
+        a pre-encoded frame only when this is "bin"."""
+        return self._t.codec
 
     def _peer(self):
         store = self._t._stores.get(self.peer_id)
@@ -72,18 +170,39 @@ class _InProcSession:
         return store
 
     async def full_sync(
-        self, area: str, sender_id: str, digest: dict
-    ) -> Publication:
-        raw = await self._peer().handle_full_sync(
-            {"area": area, "sender": sender_id, "digest": digest}
+        self, area: str, sender_id: str, digest: dict | None,
+        store_hash: int | None = None,
+    ) -> dict:
+        return await self._peer().handle_full_sync(
+            {
+                "area": area,
+                "sender": sender_id,
+                "digest": digest,
+                "store_hash": store_hash,
+            }
         )
-        return pub_from_json(raw)
 
-    async def flood(self, pub: Publication) -> None:
+    async def flood(self, pub: Publication) -> int:
         # yield to the loop: keeps the async network boundary observable
         # in tests even without real sockets
         await asyncio.sleep(0)
-        await self._peer().handle_flood({"pub": pub_to_json(pub)})
+        if self._t.codec == "bin":
+            # serialize-once: the same immutable blob serves every peer
+            blob = pub_wire_bin(pub, self.counters)
+            await self._peer().handle_flood({"pub_bin": blob})
+        else:
+            # legacy cost model: one fresh canonical-JSON encode per
+            # peer (what the pre-binary wire actually paid)
+            t0 = time.perf_counter()
+            blob = to_wire(pub)
+            if self.counters is not None:
+                self.counters.increment("kvstore.flood_encodes")
+                self.counters.add_value(
+                    "kvstore.flood_encode_ms",
+                    (time.perf_counter() - t0) * 1e3,
+                )
+            await self._peer().handle_flood({"pub_wire": blob})
+        return len(blob)
 
     async def dual_messages(
         self, area: str, sender: str, msgs: list[dict]
@@ -107,34 +226,72 @@ class _InProcSession:
 
 class TcpKvTransport:
     """RPC-over-TCP sessions to peers' KvStore servers. Pass a client
-    `ssl.SSLContext` (rpc.tls.client_ssl_context) for a TLS mesh."""
+    `ssl.SSLContext` (rpc.tls) for a TLS mesh. Each session negotiates
+    the binary framing on connect (rpc ``_wire.hello``) and falls back
+    to JSON lines against an old peer — per-connection, so mixed
+    versions interoperate during a rolling migration (docs/Wire.md)."""
+
+    codec = "bin"  # preferred; per-session actual comes from negotiation
 
     def __init__(self, ssl=None):
         self.ssl = ssl
 
-    async def connect(self, peer_id: str, endpoint: tuple[str, int]):
+    async def connect(
+        self, peer_id: str, endpoint: tuple[str, int], counters=None
+    ):
         host, port = endpoint
-        client = RpcClient(host, port, ssl=self.ssl)
+        client = RpcClient(host, port, ssl=self.ssl, counters=counters)
         await client.connect()
-        return _TcpSession(client, peer_id)
+        return _TcpSession(client, peer_id, counters=counters)
 
 
 class _TcpSession:
-    def __init__(self, client: RpcClient, peer_id: str):
+    def __init__(self, client: RpcClient, peer_id: str, counters=None):
         self._c = client
         self.peer_id = peer_id
+        self.counters = counters
+
+    @property
+    def codec(self) -> str:
+        """The NEGOTIATED per-connection codec ("bin" | "json") — an
+        old JSON-only peer must get a freshly built publication (with
+        the PR4 defensive perf-trace copy), never the cached binary-
+        path source object."""
+        return self._c.codec
 
     async def full_sync(
-        self, area: str, sender_id: str, digest: dict
-    ) -> Publication:
-        raw = await self._c.call(
-            "kv.fullSync", {"area": area, "sender": sender_id, "digest": digest}
+        self, area: str, sender_id: str, digest: dict | None,
+        store_hash: int | None = None,
+    ) -> dict:
+        return await self._c.call(
+            "kv.fullSync",
+            {
+                "area": area,
+                "sender": sender_id,
+                "digest": digest,
+                "store_hash": store_hash,
+            },
         )
-        return pub_from_json(raw)
 
-    async def flood(self, pub: Publication) -> None:
+    async def flood(self, pub: Publication) -> int:
         try:
-            await self._c.notify("kv.flood", {"pub": pub_to_json(pub)})
+            if self._c.codec == "bin":
+                # serialize-once: the complete notification frame is
+                # cached on the Publication; N peers, one encode, N
+                # writes of the same bytes
+                frame = pub_flood_frame(pub, self.counters)
+                await self._c.send_frame(frame)
+                return len(frame)
+            # JSON-negotiated peer (old build): legacy per-peer encode
+            t0 = time.perf_counter()
+            tree = pub_to_json(pub)
+            if self.counters is not None:
+                self.counters.increment("kvstore.flood_encodes")
+                self.counters.add_value(
+                    "kvstore.flood_encode_ms",
+                    (time.perf_counter() - t0) * 1e3,
+                )
+            return await self._c.notify("kv.flood", {"pub": tree})
         except (ConnectionError, RpcError) as e:
             raise ConnectionError(str(e)) from e
 

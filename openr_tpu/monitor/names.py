@@ -68,6 +68,10 @@ COUNTERS: frozenset[str] = frozenset(
         # kvstore
         "kvstore.expired_keys",
         "kvstore.flood_backpressure_drops",
+        "kvstore.flood_bytes",
+        "kvstore.flood_decode_ms",
+        "kvstore.flood_encode_ms",
+        "kvstore.flood_encodes",
         "kvstore.flood_failures",
         "kvstore.flood_fanout_ms",
         "kvstore.flood_keys_coalesced",
@@ -77,7 +81,12 @@ COUNTERS: frozenset[str] = frozenset(
         "kvstore.floods_received",
         "kvstore.floods_sent",
         "kvstore.full_sync_failures",
+        "kvstore.full_sync_keys_sent",
+        "kvstore.full_sync_probe_miss",
         "kvstore.full_syncs",
+        "kvstore.full_syncs_legacy",
+        "kvstore.full_syncs_noop",
+        "kvstore.full_syncs_noop_served",
         "kvstore.full_syncs_served",
         "kvstore.merged_updates",
         "kvstore.peer_disconnects",
@@ -85,6 +94,11 @@ COUNTERS: frozenset[str] = frozenset(
         "kvstore.peers_rejected_bad_area",
         "kvstore.peers_removed",
         "kvclient.advertisements",
+        # rpc wire accounting (rpc/core.py; every RpcServer/RpcClient
+        # with a Counters registry stamps these)
+        "rpc.bytes_rx",
+        "rpc.bytes_tx",
+        "rpc.conns_binary",
         # spark / linkmonitor
         "spark.bad_packets",
         "spark.handshake_recv",
@@ -171,6 +185,8 @@ DOCUMENTED: frozenset[str] = frozenset(
     {n for n in COUNTERS if n.startswith("decision.rebuild.")}
     | {n for n in COUNTERS if n.startswith("decision.spf.warm_")}
     | {n for n in COUNTERS if n.startswith("kvstore.flood")}
+    | {n for n in COUNTERS if n.startswith("kvstore.full_sync")}
+    | {n for n in COUNTERS if n.startswith("rpc.")}
     | {n for n in COUNTERS if n.startswith("fib.program")}
     | {n for n in COUNTERS if n.startswith("ctrl.sub_")}
     | {n for n in COUNTERS if n.startswith("watchdog.")}

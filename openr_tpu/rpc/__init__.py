@@ -1,24 +1,33 @@
-"""Async RPC over newline-delimited JSON — the thrift-RPC equivalent.
+"""Async RPC — the thrift-RPC equivalent, JSON lines + binary frames.
 
 reference: the control plane of openr is fbthrift services everywhere
 (OpenrCtrl.thrift †, Platform.thrift † FibService, KvStore thrift peering
 †). This rebuild uses one small asyncio RPC core with the same roles:
 request/response calls, fire-and-forget notifications, and server-push
 streams (≙ thrift server-streaming used by subscribeKvStoreFilter /
-subscribeFib †). Payloads are the canonical-JSON wire codec from
-openr_tpu.types.serde, so every schema dataclass travels as-is.
+subscribeFib †). Payloads are the wire codecs from openr_tpu.types.serde,
+so every schema dataclass travels as-is.
 
-Wire format (one JSON object per line):
+Envelope shape (one object per frame):
   request:      {"id": 1, "method": "m", "params": {...}}
   response:     {"id": 1, "result": {...}} | {"id": 1, "error": "..."}
   notification: {"method": "m", "params": {...}}            (no id)
   stream item:  {"id": 1, "item": {...}}                    (until "end")
   stream end:   {"id": 1, "end": true}
+
+Framing (docs/Wire.md): every connection starts as newline-delimited
+canonical JSON; a ``_wire.hello`` negotiation upgrades both directions
+to length-prefixed binary frames (``[0xB1][uvarint len][serde blob]``,
+compact TLV with varint ints and raw bytes). The receive path sniffs
+each frame's first byte, so mixed-version peers interoperate.
 """
 
 from openr_tpu.rpc.core import (  # noqa: F401
+    WIRE_CODEC_BIN,
     RpcClient,
     RpcError,
     RpcServer,
     StreamWriter,
+    WireFrameError,
+    bin_frame,
 )

@@ -1,4 +1,22 @@
-"""RPC core: see package docstring for the wire format."""
+"""RPC core: see package docstring for the wire format.
+
+Two framings coexist per connection (docs/Wire.md):
+
+  * JSON lines (legacy / negotiation): one JSON object per ``\\n``-
+    terminated line. Every connection STARTS here.
+  * Binary frames: ``[0xB1][uvarint length][payload]`` where payload is
+    a complete ``serde.to_wire_bin`` blob (its own magic + version
+    byte) of the same envelope dict.
+
+The receive path never needs mode state: a JSON text can't begin with
+0xB1, so every frame is sniffed by its first byte. Only the TRANSMIT
+codec is negotiated — a client that wants binary sends a
+``_wire.hello`` call as its first request; a server that agrees replies
+``{"codec": "bin1"}`` and both sides switch their writers. An old peer
+either never sends the hello (server stays on JSON for that conn) or
+answers it with a no-such-method error (client stays on JSON) — mixed
+versions interoperate frame-by-frame.
+"""
 
 from __future__ import annotations
 
@@ -9,10 +27,21 @@ from typing import Any, AsyncIterator, Awaitable, Callable
 
 from openr_tpu.common.tasks import guard_task, reap
 from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.types.serde import (
+    WIRE_BIN_MAGIC,
+    WireDecodeError,
+    from_wire_bin,
+    to_wire_bin,
+    write_uvarint,
+)
 
 log = logging.getLogger(__name__)
 
 MAX_LINE = 64 * 1024 * 1024  # LSDB dumps can be large
+
+# the codec name the hello negotiates; bumping the serde wire version
+# would introduce "bin2" here and old peers would keep matching "bin1"
+WIRE_CODEC_BIN = "bin1"
 
 # per-subscription client-side buffer: a slow stream consumer
 # backpressures the rx loop (and so, via TCP, the server's per-sub
@@ -26,16 +55,79 @@ STREAM_BUF = 1024
 # the client forever
 STREAM_STALL_S = 30.0
 
+_MAGIC = bytes((WIRE_BIN_MAGIC,))
+
 
 class RpcError(Exception):
     """Remote handler raised / transport failed."""
 
 
+class WireFrameError(ValueError):
+    """Framing is unrecoverable on this connection (bad varint,
+    oversized length prefix): the byte stream can no longer be resynced,
+    so the CONNECTION is dropped — never the node."""
+
+
+def _dumps(obj: dict) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode() + b"\n"
+
+
+def bin_frame(obj: dict) -> bytes:
+    """One binary wire frame: magic + uvarint length + serde blob."""
+    blob = to_wire_bin(obj)
+    head = bytearray(_MAGIC)
+    write_uvarint(head, len(blob))
+    return bytes(head) + blob
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[str, bytes]:
+    """Sniff + read one wire message: ("bin", blob) | ("json", line).
+
+    Raises IncompleteReadError at EOF / mid-frame truncation,
+    WireFrameError when the binary framing itself is corrupt, and
+    LimitOverrunError for an overlong JSON line.
+    """
+    first = await reader.readexactly(1)
+    if first == _MAGIC:
+        n = 0
+        shift = 0
+        while True:
+            b = (await reader.readexactly(1))[0]
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 35:
+                raise WireFrameError("unterminated length varint")
+        if n > MAX_LINE:
+            raise WireFrameError(f"oversized frame ({n} bytes)")
+        return "bin", await reader.readexactly(n)
+    return "json", first + await reader.readuntil(b"\n")
+
+
+class _ConnState:
+    """Per-connection transmit state: negotiated codec + accounting.
+    The receive path sniffs every frame and needs no state."""
+
+    __slots__ = ("writer", "codec", "counters")
+
+    def __init__(self, writer: asyncio.StreamWriter, counters=None):
+        self.writer = writer
+        self.codec = "json"
+        self.counters = counters
+
+    def write_msg(self, msg: dict) -> None:
+        data = bin_frame(msg) if self.codec == "bin" else _dumps(msg)
+        self.writer.write(data)
+        if self.counters is not None:
+            self.counters.increment("rpc.bytes_tx", len(data))
+
+
 class StreamWriter:
     """Handed to streaming handlers to push items to the subscriber."""
 
-    def __init__(self, writer: asyncio.StreamWriter, req_id: int):
-        self._writer = writer
+    def __init__(self, conn: _ConnState, req_id: int):
+        self._conn = conn
         self._id = req_id
         self.closed = False
 
@@ -43,8 +135,8 @@ class StreamWriter:
         if self.closed:
             raise RpcError("stream closed")
         try:
-            self._writer.write(_dumps({"id": self._id, "item": item}))
-            await self._writer.drain()
+            self._conn.write_msg({"id": self._id, "item": item})
+            await self._conn.writer.drain()
         except (ConnectionError, RuntimeError) as e:
             self.closed = True
             raise RpcError(f"stream write failed: {e}") from e
@@ -53,14 +145,10 @@ class StreamWriter:
         if not self.closed:
             self.closed = True
             try:
-                self._writer.write(_dumps({"id": self._id, "end": True}))
-                await self._writer.drain()
+                self._conn.write_msg({"id": self._id, "end": True})
+                await self._conn.writer.drain()
             except (ConnectionError, RuntimeError):
                 pass
-
-
-def _dumps(obj: dict) -> bytes:
-    return json.dumps(obj, separators=(",", ":")).encode() + b"\n"
 
 
 Handler = Callable[..., Awaitable[Any]]
@@ -72,10 +160,18 @@ class RpcServer:
     register(name, fn): async fn(params_dict) -> jsonable result.
     register_stream(name, fn): async fn(params_dict, stream: StreamWriter);
     the stream stays open until fn returns or the client disconnects.
+
+    `binary=True` (default) agrees to binary in ``_wire.hello``
+    negotiations; False declines (replies ``{"codec": "json"}``) so the
+    connection stays on JSON — the interop tests' "old peer". A truly
+    pre-binary server answers the hello with a no-method error, which
+    the client treats the same way.
     """
 
-    def __init__(self, name: str = "rpc"):
+    def __init__(self, name: str = "rpc", counters=None, binary: bool = True):
         self.name = name
+        self.counters = counters
+        self.binary = binary
         self._methods: dict[str, Handler] = {}
         self._streams: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
@@ -121,27 +217,67 @@ class RpcServer:
         task = asyncio.current_task()
         if task:
             self._conn_tasks.add(task)
+        conn = _ConnState(writer, counters=self.counters)
         stream_tasks: list[asyncio.Task] = []
         try:
             while True:
-                line = await reader.readline()
-                if not line:
-                    break
                 try:
-                    msg = json.loads(line)
+                    kind, payload = await _read_frame(reader)
+                except asyncio.IncompleteReadError:
+                    break  # peer closed (or died mid-frame)
+                except (WireFrameError, asyncio.LimitOverrunError,
+                        ValueError):
+                    # unrecoverable framing: the stream can't be
+                    # resynced — drop THIS connection, keep serving
+                    log.warning(
+                        "%s: unrecoverable framing from peer", self.name
+                    )
+                    break
+                if self.counters is not None:
+                    self.counters.increment("rpc.bytes_rx", len(payload))
+                try:
+                    if kind == "bin":
+                        msg = from_wire_bin(payload)
+                    else:
+                        msg = json.loads(payload)
                 except ValueError:
-                    # JSONDecodeError *or* UnicodeDecodeError: a garbage
-                    # frame that isn't valid UTF-8 raises the latter,
-                    # which json.JSONDecodeError does NOT cover — the
-                    # asyncio sanitizer caught the conn task dying on it
+                    # JSONDecodeError *or* UnicodeDecodeError *or*
+                    # WireDecodeError: a corrupt payload inside intact
+                    # framing — skip the frame, keep the connection
                     # (test_fuzz_wire::test_rpc_server_survives_garbage)
-                    log.warning("%s: bad json from peer", self.name)
+                    log.warning("%s: bad frame from peer", self.name)
+                    continue
+                if not isinstance(msg, dict):
+                    log.warning("%s: non-object frame from peer", self.name)
                     continue
                 method = msg.get("method")
                 req_id = msg.get("id")
                 params = msg.get("params") or {}
+                if method == "_wire.hello":
+                    # codec negotiation (docs/Wire.md): agree to binary
+                    # when both sides support it, then switch OUR
+                    # transmit codec; the client switches on seeing the
+                    # reply. Reply goes out in the OLD codec.
+                    codecs = (
+                        params.get("codecs") if isinstance(params, dict)
+                        else None
+                    ) or []
+                    agree = (
+                        WIRE_CODEC_BIN
+                        if self.binary and WIRE_CODEC_BIN in codecs
+                        else "json"
+                    )
+                    if req_id is not None:
+                        conn.write_msg({"id": req_id,
+                                        "result": {"codec": agree}})
+                        await writer.drain()
+                    if agree == WIRE_CODEC_BIN:
+                        conn.codec = "bin"
+                        if self.counters is not None:
+                            self.counters.increment("rpc.conns_binary")
+                    continue
                 if method in self._streams and req_id is not None:
-                    sw = StreamWriter(writer, req_id)
+                    sw = StreamWriter(conn, req_id)
 
                     async def run_stream(fn=self._streams[method], p=params, s=sw):
                         try:
@@ -166,11 +302,11 @@ class RpcServer:
                         log.exception("%s: handler %s failed", self.name, method)
                         reply = {"id": req_id, "error": f"{type(e).__name__}: {e}"}
                     if req_id is not None:
-                        writer.write(_dumps(reply))
+                        conn.write_msg(reply)
                         await writer.drain()
                 elif req_id is not None:
-                    writer.write(
-                        _dumps({"id": req_id, "error": f"no method {method!r}"})
+                    conn.write_msg(
+                        {"id": req_id, "error": f"no method {method!r}"}
                     )
                     await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -186,12 +322,28 @@ class RpcServer:
 
 
 class RpcClient:
-    """One connection; concurrent calls multiplexed by request id."""
+    """One connection; concurrent calls multiplexed by request id.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, ssl=None):
+    `negotiate=True` (default) sends a ``_wire.hello`` on connect and
+    upgrades the connection to binary frames when the server agrees;
+    against an old (JSON-only) server the hello fails cleanly and the
+    connection stays on JSON.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl=None,
+        counters=None,
+        negotiate: bool = True,
+    ):
         self.host = host
         self.port = port
         self.ssl = ssl  # ssl.SSLContext (rpc.tls) or None for plaintext
+        self.counters = counters
+        self.negotiate = negotiate
+        self._codec = "json"
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._next_id = 1
@@ -203,6 +355,11 @@ class RpcClient:
     def connected(self) -> bool:
         return self._writer is not None
 
+    @property
+    def codec(self) -> str:
+        """Negotiated transmit codec: "json" or "bin"."""
+        return self._codec
+
     async def connect(self, timeout: float = 5.0) -> None:
         self._reader, self._writer = await asyncio.wait_for(
             asyncio.open_connection(
@@ -210,9 +367,22 @@ class RpcClient:
             ),
             timeout,
         )
+        self._codec = "json"
         self._rx_task = guard_task(
             asyncio.ensure_future(self._rx_loop()), owner="rpc.client.rx"
         )
+        if self.negotiate:
+            try:
+                res = await self.call(
+                    "_wire.hello", {"codecs": [WIRE_CODEC_BIN]},
+                    timeout=timeout,
+                )
+                if isinstance(res, dict) and res.get("codec") == WIRE_CODEC_BIN:
+                    self._codec = "bin"
+            except RpcError:
+                # old server: no such method (or conn-level failure the
+                # next real call will surface) — stay on JSON frames
+                pass
 
     async def close(self) -> None:
         if self._rx_task:
@@ -234,14 +404,41 @@ class RpcClient:
             q.put_nowait(_STREAM_ERR, force=True)
         self._streams.clear()
 
+    def _write_msg(self, msg: dict) -> int:
+        data = bin_frame(msg) if self._codec == "bin" else _dumps(msg)
+        self._writer.write(data)
+        if self.counters is not None:
+            self.counters.increment("rpc.bytes_tx", len(data))
+        return len(data)
+
+    async def send_frame(self, frame: bytes) -> None:
+        """Write one pre-encoded wire frame (the serialize-once flood
+        path: the SAME immutable frame is handed to every peer client).
+        The frame must match this connection's negotiated codec."""
+        if self._writer is None:
+            raise RpcError("not connected")
+        self._writer.write(frame)
+        if self.counters is not None:
+            self.counters.increment("rpc.bytes_tx", len(frame))
+        await self._writer.drain()
+
     async def _rx_loop(self) -> None:
         assert self._reader is not None
         try:
             while True:
-                line = await self._reader.readline()
-                if not line:
+                try:
+                    kind, payload = await _read_frame(self._reader)
+                except asyncio.IncompleteReadError:
                     break
-                msg = json.loads(line)
+                if self.counters is not None:
+                    self.counters.increment("rpc.bytes_rx", len(payload))
+                msg = (
+                    from_wire_bin(payload)
+                    if kind == "bin"
+                    else json.loads(payload)
+                )
+                if not isinstance(msg, dict):
+                    continue
                 req_id = msg.get("id")
                 if "item" in msg and req_id in self._streams:
                     try:
@@ -285,10 +482,15 @@ class RpcClient:
                             fut.set_exception(RpcError(msg["error"]))
                         else:
                             fut.set_result(msg.get("result"))
-        except (ConnectionError, ValueError, asyncio.IncompleteReadError):
-            # ValueError covers JSONDecodeError AND UnicodeDecodeError —
-            # a non-UTF-8 frame from a corrupt/hostile server must take
-            # the clean connection-lost path, same as the server side
+        except (ConnectionError, ValueError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError):
+            # ValueError covers JSONDecodeError, UnicodeDecodeError AND
+            # WireDecodeError/WireFrameError — a corrupt frame from a
+            # hostile/broken server takes the clean connection-lost
+            # path, same as the server side. LimitOverrunError (NOT a
+            # ValueError) is readuntil's overlong-JSON-line signal: the
+            # old readline() converted it to ValueError, _read_frame's
+            # readuntil raises it directly
             pass
         except asyncio.CancelledError:
             raise
@@ -304,21 +506,42 @@ class RpcClient:
         self._next_id += 1
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
-        self._writer.write(
-            _dumps({"id": req_id, "method": method, "params": params or {}})
-        )
-        await self._writer.drain()
+        try:
+            self._write_msg(
+                {"id": req_id, "method": method, "params": params or {}}
+            )
+            await self._writer.drain()
+        except BaseException as e:
+            # transport failure mid-send (e.g. a TLS reject surfacing at
+            # drain): deregister the slot AND settle the future — a
+            # racing _fail_all may already have parked an exception on
+            # it, which would otherwise never be retrieved
+            self._pending.pop(req_id, None)
+            if fut.done():
+                fut.exception()
+            else:
+                fut.cancel()
+            if isinstance(e, ConnectionError):
+                # callers see one exception type for "call failed",
+                # whether the transport died before, during or after
+                # the send (RpcError docstring contract)
+                raise RpcError(f"transport failed: {e}") from e
+            raise
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError as e:
             self._pending.pop(req_id, None)  # don't leak the slot
             raise RpcError(f"call {method!r} timed out after {timeout}s") from e
 
-    async def notify(self, method: str, params: Any = None) -> None:
+    async def notify(self, method: str, params: Any = None) -> int:
+        """Fire-and-forget. Returns the frame size written, so callers
+        doing byte accounting (KvStore flood_bytes) get the real wire
+        cost on either codec."""
         if self._writer is None:
             raise RpcError("not connected")
-        self._writer.write(_dumps({"method": method, "params": params or {}}))
+        n = self._write_msg({"method": method, "params": params or {}})
         await self._writer.drain()
+        return n
 
     async def subscribe(
         self, method: str, params: Any = None
@@ -334,9 +557,7 @@ class RpcClient:
             name=f"rpc.stream.{req_id}", maxsize=STREAM_BUF, policy="block"
         )
         self._streams[req_id] = q
-        self._writer.write(
-            _dumps({"id": req_id, "method": method, "params": params or {}})
-        )
+        self._write_msg({"id": req_id, "method": method, "params": params or {}})
         await self._writer.drain()
 
         async def gen():

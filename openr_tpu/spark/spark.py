@@ -33,7 +33,7 @@ from openr_tpu.types.events import (
     NeighborEventType,
     NeighborInfo,
 )
-from openr_tpu.types.serde import from_wire, to_wire
+from openr_tpu.types.serde import from_wire_auto, to_wire, to_wire_bin
 
 log = logging.getLogger(__name__)
 
@@ -143,6 +143,16 @@ class Spark(OpenrModule):
         self.ctrl_port = ctrl_port
         self.endpoint_host = endpoint_host
         self.interfaces: set[str] = set()
+        # tx wire codec (docs/Wire.md): compact binary frames by
+        # default; "json" keeps legacy canonical-JSON packets for
+        # mixed-version interop. The RX path sniffs every packet's
+        # first byte (from_wire_auto), so either codec is always
+        # accepted regardless of this knob.
+        self._encode = (
+            to_wire_bin
+            if config.node.spark.wire_codec == "bin"
+            else to_wire
+        )
         # inbox-shed visibility: every IoProvider that bounds its rx
         # queue exports drops through this node's counters
         attach = getattr(io, "attach_counters", None)
@@ -231,7 +241,7 @@ class Spark(OpenrModule):
                     fastinit=fast,
                 )
             )
-            await self.io.send(if_name, to_wire(pkt))
+            await self.io.send(if_name, self._encode(pkt))
             if self.counters is not None:
                 self.counters.increment("spark.hello_sent")
 
@@ -259,7 +269,7 @@ class Spark(OpenrModule):
                     restarting=True,
                 )
             )
-            await self.io.send(if_name, to_wire(pkt))
+            await self.io.send(if_name, self._encode(pkt))
             if self.counters is not None:
                 self.counters.increment("spark.restart_announced")
 
@@ -281,7 +291,7 @@ class Spark(OpenrModule):
                     hold_time_ms=cfg.hold_time_ms,
                 )
             )
-            await self.io.send(if_name, to_wire(pkt))
+            await self.io.send(if_name, self._encode(pkt))
             if self.counters is not None:
                 self.counters.increment("spark.heartbeat_sent")
 
@@ -301,7 +311,7 @@ class Spark(OpenrModule):
                 is_ack=is_ack,
             )
         )
-        await self.io.send(nb.local_if, to_wire(pkt))
+        await self.io.send(nb.local_if, self._encode(pkt))
         if self.counters is not None:
             self.counters.increment("spark.handshake_sent")
 
@@ -324,7 +334,7 @@ class Spark(OpenrModule):
             if if_name not in self.interfaces:
                 continue
             try:
-                pkt = from_wire(payload, SparkPacket)
+                pkt = from_wire_auto(payload, SparkPacket)
             except Exception:  # noqa: BLE001
                 if self.counters is not None:
                     self.counters.increment("spark.bad_packets")

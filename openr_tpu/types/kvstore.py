@@ -75,6 +75,16 @@ class Publication:
     # publication-level here so Decision needn't decode to trace).
     # compare=False: a trace annotates the update, it doesn't identify it
     perf_events: PerfEvents | None = field(default=None, compare=False)
+    # serialize-once flood fan-out: encoded wire frames, keyed by codec
+    # ("bin" = serde blob, "rpc_bin" = complete kv.flood RPC frame).
+    # Leading underscore = transient (serde never puts it on the wire);
+    # compare/repr excluded — a cache annotates, it doesn't identify.
+    # Safe to share across N peers because the coalescing paths
+    # (messaging/policies.py, KvStore._enqueue_flood) always build NEW
+    # Publications, so a cached frame can never go stale in place.
+    _wire_cache: dict | None = field(
+        default=None, compare=False, repr=False
+    )
 
 
 @dataclass

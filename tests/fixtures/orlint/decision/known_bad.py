@@ -7,12 +7,13 @@ OR006 determinism) apply; the engine's directory walker skips
 explicit argument (``python -m tools.orlint
 tests/fixtures/orlint/decision/known_bad.py``).
 
-EXPECTED: exactly one finding per rule, OR001..OR010 (asserted by
+EXPECTED: exactly one finding per rule, OR001..OR011 (asserted by
 tests/test_orlint.py::test_known_bad_fixture_covers_every_rule and the
 ci.sh smoke lane).
 """
 
 import asyncio
+import json
 import random
 import time
 
@@ -31,6 +32,7 @@ class Bad:
         await asyncio.sleep(jitter)
         self._pending = pending + [1]  # OR003: stale read across await
         self.counters.increment("bogus.counter.name")  # OR007: unregistered
+        return json.dumps({"pub": 1})  # OR011: text frame on a wire seam
 
     async def helper(self):
         try:

@@ -17,7 +17,15 @@ import numpy as np
 import pytest
 
 from openr_tpu.types.kvstore import Publication, Value
-from openr_tpu.types.serde import from_wire, to_wire
+from openr_tpu.types.serde import (
+    WIRE_BIN_MAGIC,
+    WireDecodeError,
+    from_wire,
+    from_wire_auto,
+    from_wire_bin,
+    to_wire,
+    to_wire_bin,
+)
 from openr_tpu.spark.spark import SparkPacket
 from openr_tpu.types.topology import AdjacencyDatabase
 
@@ -81,6 +89,121 @@ def test_decoders_never_crash(cls):
             failed += 1  # controlled failure is the contract
     # the corpus must exercise BOTH outcomes or the fuzz is vacuous
     assert failed > 0 and decoded > 0, (decoded, failed)
+
+
+def _valid_bin(cls) -> bytes:
+    if cls is Value:
+        return to_wire_bin(Value(version=1, originator_id="a", value=b"x"))
+    if cls is Publication:
+        return to_wire_bin(Publication(area="0", key_vals={
+            "k": Value(version=3, originator_id="ab", value=b"\x00\xffpayload")
+        }, node_ids=["n1", "n2"]))
+    if cls is AdjacencyDatabase:
+        return to_wire_bin(AdjacencyDatabase(this_node_name="n"))
+    # a populated hello: an all-None SparkPacket is so small that every
+    # byte is structurally load-bearing and NO mutation survives — a
+    # real packet has payload bytes (names, seqs) a flip can land in
+    from openr_tpu.spark.spark import HelloMsg
+
+    return to_wire_bin(SparkPacket(hello=HelloMsg(
+        node_name="node-17", if_name="eth0", seq=42,
+        heard={"node-3": (7, 123456, 99)}, sent_ts_us=1_000_000,
+    )))
+
+
+@pytest.mark.parametrize("cls", [SparkPacket, Publication, Value,
+                                 AdjacencyDatabase])
+def test_bin_decoder_never_crashes(cls):
+    """The binary decoder under the same contract as the JSON one: any
+    byte string either decodes to a valid object or raises a controlled
+    WireDecodeError (a ValueError) — never an uncontrolled crash."""
+    rng = np.random.default_rng(SEED)
+    valid = _valid_bin(cls)
+    corpus = _random_blobs(rng)
+    # random payloads behind a valid header: exercises the TLV walker,
+    # not just the magic check
+    corpus += [bytes([WIRE_BIN_MAGIC, 0x01]) + b for b in corpus[:80]]
+    corpus += _mutations(rng, valid)
+    # targeted malformations
+    corpus += [
+        valid[:1],                                     # short frame
+        valid[:5],                                     # truncated value
+        b"",                                           # empty
+        bytes([WIRE_BIN_MAGIC]),                       # header only
+        bytes([WIRE_BIN_MAGIC, 0x7F]) + valid[2:],     # future version
+        valid + b"\x00",                               # trailing bytes
+        # unterminated varint (all continuation bits)
+        bytes([WIRE_BIN_MAGIC, 0x01, 0x03]) + b"\xff" * 16,
+        # oversized container count: claims 2^40 elements
+        bytes([WIRE_BIN_MAGIC, 0x01, 0x07])
+        + b"\x80\x80\x80\x80\x80\x40",
+        # oversized str length prefix pointing past the buffer
+        bytes([WIRE_BIN_MAGIC, 0x01, 0x05, 0xFF, 0x7F]) + b"ab",
+        # unknown tag byte
+        bytes([WIRE_BIN_MAGIC, 0x01, 0x7E]),
+    ]
+    decoded = failed = 0
+    for blob in corpus:
+        try:
+            obj = from_wire_bin(blob, cls)
+            assert isinstance(obj, cls)
+            decoded += 1
+        except WireDecodeError:
+            failed += 1  # the ONLY permitted failure mode
+    assert failed > 0 and decoded > 0, (decoded, failed)
+
+
+@pytest.mark.parametrize("cls", [SparkPacket, Publication, Value,
+                                 AdjacencyDatabase])
+def test_bin_generic_decode_never_crashes(cls):
+    """Schema-less decode (the RPC envelope path) under the same fuzz:
+    controlled failure or a value tree, nothing else."""
+    rng = np.random.default_rng(SEED + 1)
+    corpus = _mutations(rng, _valid_bin(cls))
+    decoded = failed = 0
+    for blob in corpus:
+        try:
+            from_wire_bin(blob)
+            decoded += 1
+        except WireDecodeError:
+            failed += 1
+    assert failed > 0, (decoded, failed)
+
+
+@pytest.mark.parametrize("cls", [SparkPacket, Publication, Value,
+                                 AdjacencyDatabase])
+def test_auto_sniff_round_trips_both_codecs(cls):
+    """from_wire_auto (the Spark rx path) accepts both framings of the
+    same object and decodes them to equal values — the mixed-version
+    interop contract."""
+    objs = {
+        SparkPacket: SparkPacket(),
+        Value: Value(version=2, originator_id="o", value=b"\x00bin\xff",
+                     ttl=1000, ttl_version=3).with_hash(),
+        Publication: Publication(area="A", key_vals={
+            "adj:x": Value(version=1, originator_id="x", value=b"{}")
+        }, expired_keys=["gone"], node_ids=["x", "y"]),
+        AdjacencyDatabase: AdjacencyDatabase(this_node_name="n"),
+    }
+    obj = objs[cls]
+    via_json = from_wire_auto(to_wire(obj), cls)
+    via_bin = from_wire_auto(to_wire_bin(obj), cls)
+    assert via_json == via_bin == obj
+
+
+def test_bin_int_range_symmetry():
+    """Every int the binary encoder accepts must round-trip: oversized
+    ints (past the decoder's 11-byte corrupt-varint guard) are rejected
+    at the SENDER with TypeError, never emitted as a frame the receiver
+    silently drops."""
+    for n in (0, 1, -1, 2**63 - 1, -(2**63), 2**76 - 1, -(2**76) + 1):
+        assert from_wire_bin(to_wire_bin(n)) == n
+    for n in (2**77, -(2**77), 2**200):
+        with pytest.raises(TypeError):
+            to_wire_bin(n)
+    # a hand-built overlong varint still fails CONTROLLED on decode
+    with pytest.raises(WireDecodeError):
+        from_wire_bin(bytes([WIRE_BIN_MAGIC, 0x01, 0x03]) + b"\x80" * 11 + b"\x01")
 
 
 def test_spark_survives_garbage_packets():
@@ -174,5 +297,89 @@ def test_rpc_server_survives_garbage_frames():
     asyncio.run(body())
 
 
+def test_rpc_server_survives_garbage_binary_frames():
+    """Binary-framed garbage on the RPC socket: corrupt payloads inside
+    intact framing are skipped; unrecoverable framing (bad varint,
+    oversized length prefix) drops THAT connection — the server node
+    keeps answering fresh binary-negotiated calls."""
+    from openr_tpu.rpc import RpcClient
+    from openr_tpu.rpc.core import MAX_LINE, RpcServer, bin_frame
+
+    rng = np.random.default_rng(SEED)
+
+    async def body():
+        srv = RpcServer(name="binfuzz")
+        srv.register("ping", lambda params: _async_ret({"pong": True}))
+        await srv.start(host="127.0.0.1", port=0)
+        port = srv.port
+        valid_frame = bin_frame({"id": 1, "method": "nope", "params": {}})
+        blobs = _mutations(rng, valid_frame)
+        # framing-level attacks
+        blobs += [
+            bytes([WIRE_BIN_MAGIC]) + b"\xff" * 8,          # endless varint
+            bytes([WIRE_BIN_MAGIC])                          # oversized len
+            + (MAX_LINE * 2).to_bytes(5, "little"),          # (raw, not varint
+            bytes([WIRE_BIN_MAGIC, 0x05]) + b"ab",           # truncated frame
+        ]
+        try:
+            for blob in blobs[:60]:
+                try:
+                    r, w = await asyncio.open_connection("127.0.0.1", port)
+                    w.write(blob)
+                    await w.drain()
+                    w.close()
+                except OSError:
+                    pass
+            cli = RpcClient(port=port)
+            await cli.connect(timeout=5.0)
+            try:
+                assert cli.codec == "bin"  # negotiation still works
+                res = await cli.call("ping", {}, timeout=5.0)
+                assert res == {"pong": True}
+            finally:
+                await cli.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(body())
+
+
+def test_rpc_mixed_version_interop():
+    """Every old/new pairing interoperates: a non-negotiating client on
+    a binary server stays JSON, a negotiating client on a JSON-only
+    server falls back to JSON, and new↔new upgrades — same results on
+    all three wires."""
+    from openr_tpu.rpc import RpcClient
+    from openr_tpu.rpc.core import RpcServer
+
+    async def body():
+        for srv_bin, cli_neg, want_codec in (
+            (True, True, "bin"),
+            (True, False, "json"),
+            (False, True, "json"),
+        ):
+            srv = RpcServer(name="interop", binary=srv_bin)
+            srv.register("echo", _async_echo)
+            await srv.start(host="127.0.0.1", port=0)
+            cli = RpcClient(port=srv.port, negotiate=cli_neg)
+            await cli.connect(timeout=5.0)
+            try:
+                assert cli.codec == want_codec, (srv_bin, cli_neg)
+                # payload with binary-hostile content round-trips on
+                # every wire (raw-bytes values ride inside Value blobs)
+                params = {"s": "ünïcode", "n": -(2**40), "f": 1.5,
+                          "nested": {"deep": [1, None, True]}}
+                assert await cli.call("echo", params, timeout=5.0) == params
+            finally:
+                await cli.close()
+                await srv.stop()
+
+    asyncio.run(body())
+
+
 async def _async_ret(value):
     return value
+
+
+async def _async_echo(params):
+    return params

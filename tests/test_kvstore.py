@@ -192,6 +192,67 @@ def test_full_sync_on_peer_add():
     run(main())
 
 
+def test_full_sync_legacy_responder_fallback():
+    """A pre-delta responder rejects the compact triple digest (its
+    value_from_json chokes on a list) — the requester must flip that
+    peer to the legacy dict-digest form and still converge
+    (docs/Wire.md migration story), counting the fallback."""
+    from openr_tpu.rpc import RpcError
+
+    class LegacyResponderTransport(InProcKvTransport):
+        """Emulates an old-build peer: triple digests and digestless
+        probes come back as handler errors (what an RPC error reply
+        surfaces as); legacy dict digests are served, with the delta
+        trailer fields stripped from the reply."""
+
+        async def connect(self, peer_id, endpoint, counters=None):
+            session = await super().connect(
+                peer_id, endpoint, counters=counters
+            )
+            orig = session.full_sync
+
+            async def legacy_full_sync(area, sender_id, digest,
+                                       store_hash=None):
+                if digest is None or any(
+                    isinstance(v, (list, tuple)) for v in digest.values()
+                ):
+                    raise RpcError(
+                        "ValueError: cannot decode digest entry"
+                    )
+                raw = await orig(area, sender_id, digest, store_hash=None)
+                for k in ("store_hash", "noop", "need_digest"):
+                    raw.pop(k, None)
+                return raw
+
+            session.full_sync = legacy_full_sync
+            return session
+
+    async def main():
+        t = LegacyResponderTransport()
+        ws = await _mk_stores(t, ["new", "old"])
+        ws["new"].store.set_key("0", "kn", V(1, "new", b"from-new"))
+        ws["old"].store.set_key("0", "ko", V(1, "old", b"from-old"))
+        ws["new"].store.add_peer_sync(PeerSpec(node_name="old"))
+        # settle on the COUNTER, not just the key: the key lands at
+        # _apply but kvstore.full_syncs increments after the awaited
+        # 3-way flood-back — asserting between the two is a race
+        ok = await _settle(
+            lambda: ws["new"].store.get_key("0", "ko") is not None
+            and ws["new"].counters.get("kvstore.full_syncs", 0) >= 1,
+            timeout=8.0,  # attempt 1 fails, backoff (~100ms), retry
+        )
+        assert ok, "never converged against the legacy responder"
+        assert ws["new"].counters.get("kvstore.full_syncs_legacy", 0) >= 1
+        # the probe stays locked out: a legacy peer would answer a
+        # digestless round with a full store dump, not a noop
+        peer = ws["new"].store.peers[("0", "old")]
+        assert peer.legacy_sync and not peer.probe_ok
+        for w in ws.values():
+            await w.stop()
+
+    run(main())
+
+
 def test_ttl_expiry_publishes():
     async def main():
         t = InProcKvTransport()

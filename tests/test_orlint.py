@@ -24,7 +24,7 @@ KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
 
 ALL_CODES = {
     "OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007",
-    "OR008", "OR009", "OR010",
+    "OR008", "OR009", "OR010", "OR011",
 }
 
 
@@ -557,6 +557,29 @@ def test_or010_recompile_hazard_variants(tmp_path):
     )
     subjects = sorted(f.fingerprint.split(":", 3)[3] for f in res.findings)
     assert subjects == ["shape:kern:raw", "static:kern:k"]
+
+
+def test_or011_text_wire_scope(tmp_path):
+    """json text framing flagged on wire seams, exempt in the codec
+    homes (types/serde.py, rpc/core.py) and out-of-scope dirs (cli)."""
+    snippet = """
+    import json
+    frame = json.dumps({"id": 1}).encode() + b"\\n"
+    msg = json.loads(frame)
+    """
+    hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/kvstore/m.py", select={"OR011"}
+    )
+    assert codes_of(hit) == ["OR011", "OR011"]
+    for exempt_rel in (
+        "openr_tpu/types/serde.py",
+        "openr_tpu/rpc/core.py",
+        "openr_tpu/cli/m.py",  # human-facing output: out of scope
+    ):
+        res = lint_snippet(
+            tmp_path, snippet, rel=exempt_rel, select={"OR011"}
+        )
+        assert codes_of(res) == [], exempt_rel
 
 
 # ------------------------------------------- suppression + baseline plumbing

@@ -1,0 +1,60 @@
+"""OR011: ``json.dumps``/``json.loads`` on a wire seam outside the
+codec homes.
+
+The transport framing is the compact binary codec plus the canonical-
+JSON fallback, both owned by ``types/serde.py`` and framed by
+``rpc/core.py`` (docs/Wire.md). Any other ``json.dumps``/``json.loads``
+inside a wire subsystem (kvstore / spark / ctrl / messaging / rpc /
+decision / types) is a text frame sneaking back onto the wire — the
+exact per-peer re-encode cost and UnicodeDecodeError surface the binary
+migration removed. Legitimate non-wire uses (CLI output, config files,
+the persistent store's on-disk format) live outside these directories
+and are not flagged; in-scope uses that operate on Value PAYLOADS
+(canonical JSON by contract — e.g. Decision's byte-splice decode cache)
+carry an inline ``# orlint: disable=OR011`` with the contract named.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name
+
+TEXT_CODECS = frozenset({"json.dumps", "json.loads"})
+
+# subsystems whose modules touch wire frames; everything else (cli,
+# config, configstore, monitor, nl, emulator harness) is out of scope
+WIRE_DIRS = frozenset(
+    {"kvstore", "spark", "ctrl", "messaging", "rpc", "decision", "types"}
+)
+
+# the two codec homes: the ONLY places allowed to spell text framing
+EXEMPT_SUFFIXES = ("types/serde.py", "rpc/core.py")
+
+
+class TextWireRule(Rule):
+    code = "OR011"
+    name = "text-wire-frame"
+    description = "json text framing on a wire seam outside serde/rpc core"
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if not (ctx.part_set() & WIRE_DIRS):
+            return
+        if ctx.path.endswith(EXEMPT_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn in TEXT_CODECS:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}() on a wire seam — wire framing lives in "
+                    f"types/serde.py + rpc/core.py (docs/Wire.md); go "
+                    f"through to_wire/to_wire_bin, or justify a Value-"
+                    f"payload use inline",
+                    subject=dn,
+                )
