@@ -885,7 +885,18 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     n_routes = len(rdb.unicast_routes) + len(rdb.mpls_routes)
     detail["full_rib_ms"] = round(full_p50, 3)
     detail["full_rib_p99_ms"] = round(full_p99, 3)
-    detail["rib_assembly_ms"] = round(max(full_p50 - solve_p50, 0.0), 3)
+    # measured phase split from the solver's own timers (r05 reported
+    # rib_assembly_ms: 0.0 because it was derived by SUBTRACTING the
+    # headline solve p50 from the full-rib p50 — two different-loop
+    # medians whose difference collapses to the clamp; the solver now
+    # times its election / assembly / MPLS phases directly)
+    detail["rib_election_ms"] = round(
+        tpu.last_phase_ms.get("election", 0.0), 3
+    )
+    detail["rib_assembly_ms"] = round(
+        tpu.last_phase_ms.get("assembly", 0.0), 3
+    )
+    detail["rib_mpls_ms"] = round(tpu.last_phase_ms.get("mpls", 0.0), 3)
     detail["routes"] = n_routes
     detail["routes_per_sec"] = round(n_routes / (full_p50 / 1e3), 1)
 
@@ -1017,6 +1028,31 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
                 f"{type(e).__name__}: {e}"
             )
 
+    # million-prefix data plane: the prefix ramp through solve →
+    # vectorized election → RIB → group-aware diff → delta FIB
+    # programming (benchmarks/bench_prefix_scale.py). Host-dominated —
+    # the solve graph is small — so the CPU fallback runs a reduced
+    # ramp instead of skipping it; the 1M rung rides the TPU slot.
+    part["stage"] = "prefix-scale"
+    _sidecar_flush(part)
+    try:
+        from benchmarks.bench_prefix_scale import measure_prefix_ramp
+
+        counts_env = os.environ.get("OPENR_BENCH_PREFIX_COUNTS")
+        if counts_env:
+            counts = tuple(int(x) for x in counts_env.split(","))
+        else:
+            counts = (
+                (10_000, 100_000, 1_000_000)
+                if tpu_ok
+                else (10_000, 100_000)
+            )
+        detail["prefix_scale"] = measure_prefix_ramp(
+            prefix_counts=counts, nodes=2048, iters=3
+        )
+    except Exception as e:  # noqa: BLE001 — never null the headline
+        detail["prefix_scale"] = {"error": f"{type(e).__name__}: {e}"}
+
     detail["iters"] = iters  # device/platform recorded at graph-build
     # truthful degraded-mode output (round-3/4 verdict): a CPU fallback
     # run is a DIFFERENT experiment (reduced nodes, cpu backend) —
@@ -1040,6 +1076,10 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
         "convergence_p50_ms": conv.get("convergence_p50_ms"),
         "prefix_churn_p50_ms": pchurn.get("prefix_churn_p50_ms"),
         "topo_churn_p50_ms": tchurn.get("topo_churn_p50_ms"),
+        # largest completed prefix-ramp rung's end-to-end throughput
+        "prefix_routes_per_sec": (
+            detail.get("prefix_scale", {}).get("rungs") or [{}]
+        )[-1].get("routes_per_sec"),
     }
     if degraded:
         out["degraded"] = True

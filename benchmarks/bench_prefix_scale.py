@@ -1,0 +1,350 @@
+"""Million-prefix data-plane bench: the prefix ramp (10k → 100k → 1M).
+
+Measures the full production pipeline per rung — solve → vectorized
+election → RIB assembly → diff → delta-native FIB programming — and the
+phase split the ROADMAP's million-prefix item asks for:
+
+  routes_per_sec   total routes / p50 of a steady-state full rebuild
+                   cycle (compute_routes + diff + Fib fold/program) —
+                   the same methodology as BENCH_r0x's `routes_per_sec`
+                   (warm caches; the cold build is reported separately)
+  election_ms      the solver's measured election phase (view fetch +
+                   reachability/class masks + multi-advertiser matrix
+                   election) — per-phase timers, NOT a subtraction
+  assembly_ms      entry construction + class-dict reuse
+  diff_ms          group-aware RouteDatabase diff of the warm rebuild
+  fib_*            delta program pass + the O(1)-idle assertion
+  churn            scoped advertiser-flip churn rounds over a fixed
+                   pool: per-round latency, routes/sec through the
+                   scoped path, and an RSS watermark across rounds
+  scalar baseline  the per-prefix scalar oracle loop (vectorize=False)
+                   on the same host — the speedup denominator AND the
+                   byte-parity gate (unicast + MPLS equality)
+
+--smoke runs one CI-sized rung and exits 1 unless parity holds, the
+vectorized pipeline beats the scalar baseline ≥ 5x, zero steady-state
+XLA compiles landed (PR 7 ledger), and the idle FIB pass stayed O(1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import resource
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+def _rss_mb() -> float:
+    from openr_tpu.watchdog.watchdog import _current_rss_mb
+
+    got = _current_rss_mb()
+    return float(got) if got is not None else 0.0
+
+
+def _peak_rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def measure_prefix_ramp(
+    prefix_counts=(10_000, 100_000, 1_000_000),
+    nodes: int = 2048,
+    avg_degree: int = 8,
+    anycast_every: int = 200,
+    iters: int = 4,
+    churn_rounds: int = 3,
+    churn_pool: int = 256,
+    parity_max: int | None = None,
+    scalar_max: int | None = None,
+    seed: int = 0,
+) -> dict:
+    """Run the ramp; returns the JSON row. Heavy host work only — the
+    solve itself is the configured jax backend (cpu in CI).
+
+    ``parity_max`` / ``scalar_max`` cap the rung size for the scalar
+    oracle comparison (None = always run; the scalar loop is the very
+    baseline this pipeline replaces, so at 1M it costs ~tens of
+    seconds — affordable once per committed row, skippable in CI)."""
+    from openr_tpu.config import Config, NodeConfig
+    from openr_tpu.decision import oracle
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.fib import Fib, MockFibHandler
+    from openr_tpu.messaging import ReplicateQueue
+    from openr_tpu.monitor import Counters, compile_ledger
+    from openr_tpu.types.routes import (
+        RouteUpdate,
+        RouteUpdateType,
+        diff_route_dbs,
+    )
+    from openr_tpu.types.topology import PrefixDatabase
+    from openr_tpu.utils.topogen import erdos_renyi_lsdb, ramp_prefix_state
+
+    led = compile_ledger.install()
+    ls, _ps0, csr = erdos_renyi_lsdb(
+        nodes, avg_degree=avg_degree, seed=seed, max_metric=16
+    )
+    names = list(csr.node_names)
+    me = names[0]
+    solver = TpuSpfSolver(native_rib="off")
+    row: dict = {
+        "metric": "prefix_dataplane_ramp",
+        "nodes": csr.num_nodes,
+        "directed_edges": csr.num_edges,
+        "anycast_every": anycast_every,
+        "rungs": [],
+    }
+
+    async def _fib_cycle(fib, upd):
+        fib._fold_update(upd)
+        fib._have_rib = True
+        t0 = time.perf_counter()
+        await fib._program_once()
+        return (time.perf_counter() - t0) * 1e3
+
+    for n_prefixes in prefix_counts:
+        r: dict = {"prefixes": n_prefixes}
+        t0 = time.perf_counter()
+        ps = ramp_prefix_state(names, n_prefixes, anycast_every=anycast_every)
+        r["prefix_build_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+
+        # ---- cold build (includes view construction + jit warmup) ----
+        t0 = time.perf_counter()
+        rdb, art = solver.compute_routes(ls, ps, me, return_artifact=True)
+        r["cold_build_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        n_routes = len(rdb.unicast_routes) + len(rdb.mpls_routes)
+        r["routes"] = n_routes
+
+        cfg = Config(NodeConfig(node_name=me))
+        routes_q = ReplicateQueue(name="routes")
+        handler = MockFibHandler()
+        fib = Fib(
+            cfg, routes_q.get_reader(), handler, counters=Counters()
+        )
+
+        async def rung_body():
+            # first RIB: FULL_SYNC program (the O(table) path, once)
+            t0 = time.perf_counter()
+            await _fib_cycle(
+                fib,
+                RouteUpdate(
+                    type=RouteUpdateType.FULL_SYNC,
+                    unicast_to_update=rdb.unicast_routes,
+                    mpls_to_update=rdb.mpls_routes,
+                ),
+            )
+            r["fib_full_sync_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+
+            # one warm rebuild to settle every cache, then mark the
+            # ledger: steady-state cycles must be pure jit-cache hits
+            solver.compute_routes(ls, ps, me)
+            led.mark_warm()
+
+            # ---- steady-state full rebuild cycles --------------------
+            cycles = []
+            diffs = []
+            fibs = []
+            prev = rdb
+            for _ in range(iters):
+                c0 = time.perf_counter()
+                new = solver.compute_routes(ls, ps, me)
+                c1 = time.perf_counter()
+                upd = diff_route_dbs(prev, new)
+                c2 = time.perf_counter()
+                fib_ms = await _fib_cycle(fib, upd)
+                cycles.append((time.perf_counter() - c0) * 1e3)
+                diffs.append((c2 - c1) * 1e3)
+                fibs.append(fib_ms)
+                prev = new
+            cycles.sort()
+            p50 = cycles[len(cycles) // 2]
+            r["rebuild_p50_ms"] = round(p50, 1)
+            r["diff_ms"] = round(sorted(diffs)[len(diffs) // 2], 2)
+            r["fib_idle_pass_ms"] = round(sorted(fibs)[len(fibs) // 2], 3)
+            r["routes_per_sec"] = round(n_routes / (p50 / 1e3), 1)
+            r["election_ms"] = round(
+                solver.last_phase_ms.get("election", 0.0), 2
+            )
+            r["assembly_ms"] = round(
+                solver.last_phase_ms.get("assembly", 0.0), 2
+            )
+            r["mpls_ms"] = round(solver.last_phase_ms.get("mpls", 0.0), 2)
+            r["nexthop_groups"] = len(solver._nh_intern)
+            # idle FIB pass O(1) witness: the steady cycles above had
+            # EMPTY deltas, so the delta book never grew
+            r["fib_scan_routes"] = (
+                fib.counters.get("fib.program_scan_routes") or 0
+            )
+
+            # ---- scoped churn rounds ---------------------------------
+            pool = list(rdb.unicast_routes)[:churn_pool]
+            name_idx = {n: i for i, n in enumerate(names)}
+            churn = {"rounds": [], "pool": len(pool)}
+            rss0 = None
+            cur = prev
+            art_now = art
+            for rnd in range(churn_rounds):
+                c0 = time.perf_counter()
+                touched = set()
+                for k, p in enumerate(pool):
+                    per = ps.prefixes.get(p)
+                    if not per:
+                        continue
+                    old_node = next(iter(per))
+                    entry = per[old_node]
+                    new_node = names[
+                        (name_idx[old_node] + 1) % len(names)
+                    ]
+                    if new_node == me:
+                        new_node = names[1]
+                    ps.withdraw(old_node, p)
+                    ps.update_prefix_db(
+                        PrefixDatabase(
+                            this_node_name=new_node,
+                            prefix_entries=(entry,),
+                        )
+                    )
+                    touched.add(p)
+                entries = solver.assemble_prefix_routes(
+                    art_now, ps, touched
+                )
+                new = type(cur)(this_node_name=me)
+                new.unicast_routes = dict(cur.unicast_routes)
+                new.mpls_routes = cur.mpls_routes
+                for p in touched:
+                    e = entries.get(p)
+                    if e is None:
+                        new.unicast_routes.pop(p, None)
+                    else:
+                        new.unicast_routes[p] = e
+                upd = diff_route_dbs(
+                    cur, new, prefix_scope=touched, label_scope=()
+                )
+                await _fib_cycle(fib, upd)
+                ms = (time.perf_counter() - c0) * 1e3
+                cur = new
+                rss = _rss_mb()
+                churn["rounds"].append(
+                    {
+                        "ms": round(ms, 2),
+                        "touched": len(touched),
+                        "programmed": len(upd.unicast_to_update)
+                        + len(upd.unicast_to_delete),
+                        "rss_mb": round(rss, 1),
+                    }
+                )
+                if rnd == 0:
+                    rss0 = rss
+            churn["rss_growth_mb"] = round(
+                (churn["rounds"][-1]["rss_mb"] - rss0) if rss0 else 0.0, 1
+            )
+            churn["routes_per_sec"] = round(
+                sum(x["touched"] for x in churn["rounds"])
+                / max(
+                    sum(x["ms"] for x in churn["rounds"]) / 1e3, 1e-9
+                ),
+                1,
+            )
+            r["churn"] = churn
+
+            steady = led.compiles_since_warm()
+            r["steady_state_compiles"] = sum(steady.values())
+            if steady:
+                r["steady_state_fns"] = sorted(steady)
+            led.reset_warm()
+            r["fib_routes_programmed"] = (
+                fib.counters.get("fib.routes_programmed") or 0
+            )
+            r["fib_program_batches"] = (
+                fib.counters.get("fib.program_batches") or 0
+            )
+
+        asyncio.run(rung_body())
+
+        # ---- scalar oracle baseline + byte-parity gate ---------------
+        if scalar_max is None or n_prefixes <= scalar_max:
+            t0 = time.perf_counter()
+            sc = oracle.compute_routes(ls, ps, me, vectorize=False)
+            scalar_ms = (time.perf_counter() - t0) * 1e3
+            r["scalar_oracle_ms"] = round(scalar_ms, 1)
+            r["scalar_routes_per_sec"] = round(
+                n_routes / (scalar_ms / 1e3), 1
+            )
+            r["speedup_vs_scalar"] = round(
+                scalar_ms / max(r["rebuild_p50_ms"], 1e-9), 1
+            )
+            if parity_max is None or n_prefixes <= parity_max:
+                # NOTE: churn above moved advertisers, so compare a
+                # fresh vectorized build against the scalar one — both
+                # see the same post-churn PrefixState
+                fresh = solver.compute_routes(ls, ps, me)
+                ok = (
+                    fresh.unicast_routes == sc.unicast_routes
+                    and fresh.mpls_routes == sc.mpls_routes
+                )
+                r["parity"] = "ok" if ok else "MISMATCH"
+        r["peak_rss_mb"] = round(_peak_rss_mb(), 1)
+        row["rungs"].append(r)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefixes", type=int, nargs="*", default=None)
+    ap.add_argument("--nodes", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--anycast-every", type=int, default=200)
+    ap.add_argument(
+        "--scalar-max", type=int, default=None,
+        help="skip the scalar baseline above this rung size",
+    )
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    counts = tuple(args.prefixes) if args.prefixes else (
+        (100_000,) if args.smoke else (10_000, 100_000, 1_000_000)
+    )
+    row = measure_prefix_ramp(
+        prefix_counts=counts,
+        nodes=args.nodes,
+        iters=args.iters,
+        anycast_every=args.anycast_every,
+        scalar_max=args.scalar_max,
+    )
+    print(json.dumps(row))
+    if not args.smoke:
+        return 0
+    rc = 0
+    for r in row["rungs"]:
+        if r.get("parity") != "ok":
+            print(f"# SMOKE FAIL: parity {r.get('parity')!r} at "
+                  f"{r['prefixes']} prefixes", file=sys.stderr)
+            rc = 1
+        if r.get("speedup_vs_scalar", 0) < 5.0:
+            print(
+                f"# SMOKE FAIL: speedup_vs_scalar "
+                f"{r.get('speedup_vs_scalar')} < 5x at {r['prefixes']}",
+                file=sys.stderr,
+            )
+            rc = 1
+        if r.get("steady_state_compiles", 0) != 0:
+            print(
+                f"# SMOKE FAIL: {r['steady_state_compiles']} steady-state "
+                f"compiles ({r.get('steady_state_fns')})", file=sys.stderr,
+            )
+            rc = 1
+        if r.get("fib_scan_routes", 0) != 0:
+            print(
+                f"# SMOKE FAIL: idle FIB passes scanned "
+                f"{r['fib_scan_routes']} routes (delta book not O(1))",
+                file=sys.stderr,
+            )
+            rc = 1
+    if rc == 0:
+        print("# prefix-scale smoke ok", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

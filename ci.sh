@@ -40,7 +40,7 @@ if [ "$smoke_rc" -ne 1 ]; then
     exit 1
 fi
 for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
-            OR010 OR011; do
+            OR010 OR011 OR012; do
     if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
         echo "orlint smoke: rule $code produced no finding on the" \
              "known-bad fixture (rule deleted or broken?)"
@@ -48,7 +48,7 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
         exit 1
     fi
 done
-echo "ok: known-bad fixture trips all 11 rules"
+echo "ok: known-bad fixture trips all 12 rules"
 
 echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # the topology-delta acceptance gate (docs/Decision.md): single-link
@@ -68,6 +68,16 @@ echo "== prefix-churn smoke (scoped-path counters + compile ledger gate) =="
 # prefix_only with zero SPF solves and zero post-warmup compiles
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --prefix-churn --nodes 80 --prefix-rounds 40 --smoke --backend cpu
+
+echo "== 100k-prefix data-plane smoke (vectorized election + delta FIB) =="
+# the million-prefix pipeline at CI scale: one 100k-prefix rung through
+# solve → batched election → RIB → group-aware diff → delta FIB
+# programming; exits 1 unless byte-parity vs the scalar oracle holds,
+# routes/sec beats the per-prefix scalar loop >= 5x on this host, zero
+# post-warmup XLA compiles landed (PR 7 ledger), and the idle FIB
+# program pass scanned zero routes (the O(1) delta-book contract)
+JAX_PLATFORMS=cpu python benchmarks/bench_prefix_scale.py --smoke \
+    --prefixes 100000 --nodes 512
 
 echo "== flood-throughput smoke (binary wire vs JSON baseline) =="
 # the wire-format acceptance gate (docs/Wire.md): on a small emulated

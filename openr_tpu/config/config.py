@@ -157,6 +157,11 @@ class FibConfig:
     # the delta against the first computed RIB — never flush (reference:
     # Fib warm-boot sync †, SURVEY §5.3/5.4)
     enable_warm_boot: bool = True
+    # max routes per FibService add/delete call on the delta program
+    # path (docs/Fib.md): a million-route convergence ships bounded
+    # chunks instead of one giant frame. Appended field (wire evolution:
+    # older peers default it).
+    program_batch_size: int = 4096
 
 
 @dataclass

@@ -136,7 +136,7 @@ def merge_area_ribs(
     out = RouteDatabase(this_node_name=my_node)
     for area in areas:
         rdb = per_area[area]
-        for prefix, entry in rdb.unicast_routes.items():
+        for prefix, entry in rdb.unicast_routes.items():  # orlint: disable=OR012 — multi-area fold; the single-area fast path above bypasses it, and multi-area deployments fold per-area RIBs that the scoped merge keeps small
             cur = out.unicast_routes.get(prefix)
             out.unicast_routes[prefix] = (
                 entry if cur is None else _fold_unicast(cur, entry)
@@ -1257,14 +1257,36 @@ class Decision(OpenrModule):
                     self.counters.set(f"decision.dev_cache.{k}", n)
                 for k, n in self._tpu.spf_kernel_stats.items():
                     self.counters.set(f"decision.spf.{k}", n)
+                for k, n in self._tpu.elect_stats.items():
+                    self.counters.set(f"decision.elect.{k}", n)
+                for k, v in self._tpu.last_phase_ms.items():
+                    stat = f"{k}_ms"
+                    self.counters.add_value(f"decision.elect.{stat}", v)
+                self.counters.set(
+                    "decision.nexthop_groups", len(self._tpu._nh_intern)
+                )
                 self.counters.set(
                     "decision.spf.solves", self._tpu.solve_count
                 )
                 # process-wide jax compile/transfer ledger (zeroes
                 # until monitor.compile_ledger.install() hooks
                 # jax_log_compiles — tests/conftest and the bench/churn
-                # lanes install it; see docs/Monitor.md)
+                # lanes install it; see docs/Monitor.md). Must stay in
+                # the TPU branch — the engine that actually jits
+                # (review finding: the oracle else-branch briefly
+                # captured it, flatlining the metrics where compiles
+                # can occur)
                 compile_ledger.export_to(self.counters)
+            else:
+                self.counters.set(
+                    "decision.nexthop_groups",
+                    sum(
+                        len(c["art"].nh_intern)
+                        for c in self._area_cache.values()
+                        if c.get("art") is not None
+                        and c["art"].nh_intern is not None
+                    ),
+                )
         first = not self.rib_computed.is_set()
         self.rib = new_rib
         self._last_completed_snapshot_t0 = t0
@@ -1298,6 +1320,29 @@ class Decision(OpenrModule):
             art = cache.get("art")
             if art is not None:
                 total += art.warm_state_bytes()
+        return total
+
+    def prefix_table_bytes(self) -> int:
+        """Rough footprint of the prefix table (PrefixState entry maps)
+        plus the nexthop-group intern tables — the soak memory
+        watermark samples this per node per round, so a churn horizon
+        that leaks withdrawn prefixes or grows the intern table without
+        bound trips the invariant instead of hiding inside total RSS."""
+        import sys
+
+        total = 0
+        for ps in self._prefix_states.values():
+            total += sys.getsizeof(ps.prefixes)
+            for per in ps.prefixes.values():  # orlint: disable=OR012 — soak sampler, once per round, never on a rebuild/program path
+                # per-advertiser dict + a rough constant per frozen
+                # PrefixEntry (slots=True: no instance dict)
+                total += sys.getsizeof(per) + 96 * len(per)
+        if self._tpu is not None:
+            total += 120 * len(self._tpu._nh_intern)
+        for c in self._area_cache.values():
+            art = c.get("art")
+            if art is not None and getattr(art, "nh_intern", None) is not None:
+                total += 120 * len(art.nh_intern)
         return total
 
     def trim_warm_state(self) -> None:
@@ -1402,7 +1447,7 @@ class Decision(OpenrModule):
 
     def get_received_routes(self) -> dict[str, dict]:
         return {
-            area: {
+            area: {  # orlint: disable=OR012 — operator accessor (breeze received-routes dump), not a rebuild path
                 str(p.prefix): sorted(per_node)
                 for p, per_node in ps.prefixes.items()
             }

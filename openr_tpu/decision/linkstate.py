@@ -615,67 +615,49 @@ class PrefixState:
         snap._lineage = self._lineage  # same lineage: gen stays stable
         return snap
 
-    def solver_view(self, name_to_id: dict, base_version: int):
-        """Cached columnar classification for RIB assembly.
+    def election_view(self, name_to_id: dict, base_version: int):
+        """Cached columnar election classification for RIB assembly
+        (:class:`openr_tpu.decision.election.ElectView`).
 
-        Splits prefixes into the overwhelmingly common "plain" shape —
-        exactly one advertiser known to the topology, SP_ECMP
-        forwarding, no min_nexthop/weight constraints — and everything
-        else. Plain prefixes get numpy originator-id arrays so the
-        solver assembles their routes vectorized (unique first-hop-
-        column classes) instead of a per-prefix python loop; the rest
-        keep the general path. Cached on (prefix rev, topology base):
-        under metric-only churn neither changes, so steady-state
-        rebuilds skip the O(P) classification entirely.
+        Splits prefixes into the vectorized-electable shapes — "plain"
+        (one known advertiser, SP_ECMP, no constraints) with numpy
+        originator-id arrays, and "multi" (anycast ECMP: 2+ advertisers,
+        all plain-shaped) as the prefix→advertiser matrix the batched
+        election consumes — and everything else, which keeps the scalar
+        general path. Cached on (prefix rev, topology base): under
+        metric-only churn neither changes, so steady-state rebuilds
+        skip the O(P) classification entirely.
 
-        Returns (plain_prefixes, plain_nodes, plain_entries,
-        orig_ids [P] int64, complex_items, gen) — `gen` is a generation
-        token unique to (instance lineage, prefix rev, topology base):
-        within one PrefixState lineage it changes iff the view could,
-        and it can never collide across independent instances (the
-        lineage id), so cross-rebuild caches may key row indices into
-        the plain arrays on it.
+        ``gen`` is a generation token unique to (instance lineage,
+        prefix rev, topology base): within one PrefixState lineage it
+        changes iff the view could, and it can never collide across
+        independent instances (the lineage id), so cross-rebuild caches
+        may key row indices into the view arrays on it.
         """
         key = (self._lineage, self._rev, base_version)
         cached = self._view_cell[0]
         if cached is not None and cached[0] == key:
             return cached[1]
-        from openr_tpu.types.topology import ForwardingAlgorithm
+        from openr_tpu.decision.election import build_elect_view
 
-        plain_p: list = []
-        plain_n: list = []
-        plain_e: list = []
-        orig: list = []
-        complex_items: list = []
-        for prefix, per_node in sorted(self._entries.items()):
-            if len(per_node) == 1:
-                (node, entry), = per_node.items()
-                nid = name_to_id.get(node)
-                if (
-                    nid is not None
-                    and entry.forwarding_algorithm
-                    == ForwardingAlgorithm.SP_ECMP
-                    and not entry.min_nexthop
-                    and not entry.weight
-                ):
-                    plain_p.append(prefix)
-                    plain_n.append(node)
-                    plain_e.append(entry)
-                    orig.append(nid)
-                    continue
-            # copy: the live object mutates per_node dicts in place, and
-            # this view may outlive this instance via the shared cell
-            complex_items.append((prefix, dict(per_node)))
-        data = (
-            plain_p,
-            plain_n,
-            plain_e,
-            np.asarray(orig, dtype=np.int64),
-            complex_items,
-            key,
-        )
-        self._view_cell[0] = (key, data)
-        return data
+        view = build_elect_view(self._entries, name_to_id, key)
+        self._view_cell[0] = (key, view)
+        return view
+
+    def solver_view(self, name_to_id: dict, base_version: int):
+        """Legacy tuple facade over :meth:`election_view`: returns
+        (plain_prefixes, plain_nodes, plain_entries, orig_ids [P]
+        int64, complex_items, gen) with the multi-advertiser electable
+        prefixes folded back into complex_items — the pre-election
+        contract, kept for callers that only understand the plain/
+        complex split."""
+        v = self.election_view(name_to_id, base_version)
+        complex_items = v.complex_items
+        if v.multi is not None:
+            from openr_tpu.decision.election import multi_items
+
+            complex_items = sorted(complex_items + multi_items(v.multi))
+        return (v.plain_p, v.plain_n, v.plain_e, v.orig, complex_items, v.gen)
 
     def withdraw(self, node: str, prefix: IpPrefix) -> bool:
         per_node = self._entries.get(prefix)

@@ -26,7 +26,13 @@ from __future__ import annotations
 import heapq
 from dataclasses import dataclass
 
+import numpy as np
+
 from openr_tpu.common.constants import DIST_INF, METRIC_MAX, MPLS_LABEL_MIN
+from openr_tpu.decision.election import (
+    elect_multi_np,
+    iter_multi_winners,
+)
 from openr_tpu.decision.ksp import (
     ksp2_route,
     normalize_weights,
@@ -39,7 +45,12 @@ from openr_tpu.types.network import (
     NextHop,
     sorted_nexthops,
 )
-from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
+from openr_tpu.types.routes import (
+    NexthopIntern,
+    RibEntry,
+    RibMplsEntry,
+    RouteDatabase,
+)
 from openr_tpu.types.topology import ForwardingAlgorithm, PrefixEntry
 
 INF = float("inf")
@@ -89,6 +100,12 @@ class SolveArtifact:
     # is the safe direction for the >= 1 guard warm_spf needs for its
     # strict pred-DAG distance ordering)
     min_metric: int | None = None
+    # nexthop-group intern table (types/routes.NexthopIntern): the
+    # vectorized election paths share one group object per distinct
+    # ECMP set for the artifact's lifetime; None on the scalar
+    # reference path (vectorize=False), which stays pure tuples so the
+    # parity gates compare two genuinely different constructions
+    nh_intern: NexthopIntern | None = None
 
     def warm_state_bytes(self) -> int:
         """Rough footprint of the warm-start-only state (what
@@ -370,6 +387,8 @@ def _unicast_route(art: SolveArtifact, prefix, per_node) -> RibEntry | None:
     nexthops = _nexthops_to_nodes(ls, my_node, spf, chosen, weights)
     if not nexthops:
         return None
+    if art.nh_intern is not None:
+        nexthops = art.nh_intern.intern(nexthops)
     best_entry = reachable[chosen[0]]
     if best_entry.min_nexthop and len(nexthops) < best_entry.min_nexthop:
         return None  # reference: drop route below min_nexthop †
@@ -435,6 +454,83 @@ def assemble_prefix_routes(
     return out
 
 
+def _elect_assemble(art: SolveArtifact, csr, view, out: dict) -> None:
+    """Vectorized unicast assembly of the electable prefixes against a
+    completed SPF: the oracle's NumPy twin of the TPU backend's batched
+    election. Distances/reachability are materialized ONCE as node-id
+    vectors; plain prefixes reduce to a reachability mask + distance
+    gather, multi-advertiser prefixes run the segmented election
+    (election.elect_multi_np); NextHop construction is memoized per
+    distinct chosen set and interned into shared NexthopGroups. The
+    outcome is byte-equal to running `_unicast_route` per prefix (the
+    parity gates in tests/test_prefix_scale.py prove it)."""
+    ls, my_node, spf = art.ls, art.my_node, art.spf
+    n2i = csr.name_to_id
+    vp = csr.padded_nodes
+    d_vec = np.full(vp, int(DIST_INF), np.int64)
+    reach = np.zeros(vp, dtype=bool)
+    for n, dd in spf.dist.items():
+        i = n2i.get(n)
+        if i is not None:
+            d_vec[i] = dd
+    for n, fhs in spf.first_hops.items():
+        if fhs:
+            i = n2i.get(n)
+            if i is not None:
+                reach[i] = True
+    my_id = n2i[my_node]
+    intern = art.nh_intern
+    nhs_memo: dict[tuple, tuple] = {}
+
+    def mk(chosen_names: tuple):
+        got = nhs_memo.get(chosen_names)
+        if got is None:
+            got = _nexthops_to_nodes(ls, my_node, spf, list(chosen_names))
+            if intern is not None and got:
+                got = intern.intern(got)
+            nhs_memo[chosen_names] = got
+        return got
+
+    # ---- plain: one advertiser — election degenerates to the mask ----
+    orig = view.orig
+    if len(orig):
+        ok = np.nonzero(reach[orig] & (orig != my_id))[0]
+        igp = d_vec[orig]
+        plain_p, plain_n, plain_e = view.plain_p, view.plain_n, view.plain_e
+        for i in ok.tolist():
+            node = plain_n[i]
+            nhs = mk((node,))
+            if not nhs:
+                continue
+            p = plain_p[i]
+            out[p] = RibEntry(
+                prefix=p,
+                nexthops=nhs,
+                best_node=node,
+                best_nodes=(node,),
+                best_entry=plain_e[i],
+                igp_cost=int(igp[i]),
+            )
+
+    # ---- multi: segmented election over the advertiser matrix --------
+    if view.multi is not None:
+        res = elect_multi_np(view.multi, d_vec, reach, my_id)
+        for p, best_names, _ids, chosen_names, igp_c, best_e in (
+            iter_multi_winners(view.multi, res)
+        ):
+            nhs = mk(tuple(chosen_names))
+            if not nhs:
+                continue
+            out[p] = RibEntry(
+                prefix=p,
+                nexthops=nhs,
+                best_node=chosen_names[0],
+                best_nodes=best_names,
+                best_entry=best_e,
+                igp_cost=igp_c,
+            )
+
+
 def compute_routes(
     ls: LinkState,
     ps: PrefixState,
@@ -442,12 +538,18 @@ def compute_routes(
     enable_lfa: bool = False,
     ksp_k: int = 2,
     return_artifact: bool = False,
+    vectorize: bool = True,
 ):
     """Full RIB for `my_node` (reference: SpfSolver::buildRouteDb †).
 
     With `return_artifact=True`, returns (rdb, SolveArtifact | None) —
     the artifact feeds `assemble_prefix_routes` for dirty-scoped
-    rebuilds (None when my_node is not in the topology)."""
+    rebuilds (None when my_node is not in the topology).
+
+    ``vectorize=False`` forces the per-prefix scalar election loop —
+    the reference path the vectorized election is byte-parity-gated
+    against (and what the LFA configuration always uses: backups are
+    per-target, outside the election classes)."""
     rdb = RouteDatabase(this_node_name=my_node)
     if my_node not in set(ls.nodes):
         return (rdb, None) if return_artifact else rdb
@@ -460,16 +562,29 @@ def compute_routes(
         lfa_spfs = {
             n: run_spf(ls, n, adj) for n in sorted(adj.get(my_node, {}))
         }
+    use_elect = vectorize and lfa_spfs is None
     art = SolveArtifact(
         my_node=my_node, ls=ls, adj=adj, spf=spf, lfa_spfs=lfa_spfs,
         ksp_k=ksp_k,
+        nh_intern=NexthopIntern() if use_elect else None,
     )
 
     # ---- unicast ----------------------------------------------------------
-    for prefix, per_node in sorted(ps.prefixes.items()):
-        entry = _unicast_route(art, prefix, per_node)
-        if entry is not None:
-            rdb.unicast_routes[prefix] = entry
+    if use_elect:
+        csr = ls.to_csr()
+        view = ps.election_view(csr.name_to_id, csr.base_version)
+        _elect_assemble(art, csr, view, rdb.unicast_routes)
+        for prefix, per_node in view.complex_items:
+            entry = _unicast_route(art, prefix, per_node)
+            if entry is not None:
+                rdb.unicast_routes[prefix] = entry
+    else:
+        # scalar reference seam: the loop the batched election is
+        # parity-gated against (and the LFA path)
+        for prefix, per_node in sorted(ps.prefixes.items()):  # orlint: disable=OR012 — scalar reference/fallback seam (LFA + parity gates)
+            entry = _unicast_route(art, prefix, per_node)
+            if entry is not None:
+                rdb.unicast_routes[prefix] = entry
 
     # ---- MPLS node-segment routes ----------------------------------------
     # reference: SpfSolver::createMplsRoutes † — for every remote node with a
@@ -824,14 +939,37 @@ def warm_compute_routes(
         ksp_k=art.ksp_k,
         radj=radj2,
         min_metric=min_metric,
+        nh_intern=art.nh_intern,  # keep group identity across warm rounds
     )
 
     # ---- touched unicast prefixes ------------------------------------
     # a route can change only if an advertiser's (dist, first-hop) class
     # changed, or the prefix itself is dirty, or it is KSP (k-disjoint
-    # paths depend on the whole graph, not just advertiser distances)
+    # paths depend on the whole graph, not just advertiser distances).
+    # The advertiser→prefix resolution runs over the cached election
+    # view's id arrays (np.isin) instead of a per-prefix python walk —
+    # at a million prefixes the walk would cost more than the warm
+    # solve it scopes.
     touched: set = set(prefix_dirt)
-    for prefix, per_node in ps.prefixes.items():
+    csr = ls.to_csr()
+    view = ps.election_view(csr.name_to_id, csr.base_version)
+    changed_ids = np.fromiter(
+        (
+            csr.name_to_id[n]
+            for n in changed_nodes
+            if n in csr.name_to_id
+        ),
+        np.int64,
+    )
+    if len(view.orig) and len(changed_ids):
+        for i in np.nonzero(np.isin(view.orig, changed_ids))[0].tolist():
+            touched.add(view.plain_p[i])
+    if view.multi is not None and len(changed_ids):
+        t = view.multi
+        hit = t.known & np.isin(t.adv, changed_ids)
+        for i in np.unique(t.seg[hit]).tolist():
+            touched.add(t.prefixes[i])
+    for prefix, per_node in view.complex_items:
         if prefix in touched:
             continue
         for n, e in per_node.items():

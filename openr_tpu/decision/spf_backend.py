@@ -19,11 +19,17 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
 import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.common.constants import MPLS_LABEL_MIN
+from openr_tpu.decision.election import (
+    elect_multi_np,
+    iter_multi_winners,
+    multi_items,
+)
 from openr_tpu.decision.ksp import (
     normalize_weights,
     ucmp_weights,
@@ -56,7 +62,12 @@ from openr_tpu.types.network import (
     NextHop,
     sorted_nexthops,
 )
-from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
+from openr_tpu.types.routes import (
+    NexthopIntern,
+    RibEntry,
+    RibMplsEntry,
+    RouteDatabase,
+)
 
 log = logging.getLogger(__name__)
 
@@ -289,6 +300,26 @@ class TpuSpfSolver:
         # class-level {label: RibMplsEntry} sub-dicts (MPLS section)
         self._mpls_cls_cache: dict = {}
         self._mpls_fingerprint_cap = 8
+        # nexthop-group intern table (types/routes.NexthopIntern): one
+        # shared NexthopGroup object per distinct ECMP set across every
+        # route this solver assembles — the million-prefix RIB carries
+        # a few thousand of these, and diff/FIB equality collapses to
+        # pointer compares on them
+        self._nh_intern = NexthopIntern()
+        # multi-advertiser election: run the segmented reductions on
+        # device (ops/election.py) once the advertiser matrix has at
+        # least this many slots; below it the NumPy path wins on
+        # dispatch overhead. Byte-equal either way (integer algebra).
+        self.elect_device_min = 1 << 15
+        # device-resident advertiser matrix per election-view gen
+        # (small LRU — one live gen per PrefixState lineage)
+        self._elect_dev: dict = {}
+        # observability: last assembly's phase split (the bench's
+        # rib_election_ms / rib_assembly_ms) and election shape counts
+        self.last_phase_ms: dict[str, float] = {}
+        self.elect_stats = {
+            "plain": 0, "multi": 0, "complex": 0, "device_elections": 0,
+        }
 
     def _device_arrays(self, csr, want: str):
         """Cached (and incrementally patched) device copies of the LSDB.
@@ -450,6 +481,8 @@ class TpuSpfSolver:
         # warm-start host index: cheap to rebuild (one argsort per
         # topology base), so a trim drops it entirely
         self._warm_out.clear()
+        # device-resident advertiser matrices: re-uploaded on demand
+        self._elect_dev.clear()
 
     def _pick_table(self, csr) -> str:
         """Which table set the batched solve uses for this topology.
@@ -1066,16 +1099,22 @@ class TpuSpfSolver:
         n_live = len(csr.node_names)
         changed_mask = np.zeros(csr.padded_nodes, bool)
         changed_mask[changed_ids] = True
-        plain_p, _plain_n, _plain_e, orig, complex_items, _gen = (
-            ps.solver_view(csr.name_to_id, csr.base_version)
-        )
+        view = ps.election_view(csr.name_to_id, csr.base_version)
         touched = set(prefix_dirt)
-        if len(plain_p):
-            for i in np.nonzero(changed_mask[orig])[0]:
-                touched.add(plain_p[int(i)])
-        for p, _per in complex_items:
-            # anycast/UCMP/KSP prefixes: KSP depends on the whole graph
-            # and the rest are cheap — always re-assemble (still exact)
+        if len(view.plain_p):
+            for i in np.nonzero(changed_mask[view.orig])[0]:
+                touched.add(view.plain_p[int(i)])
+        if view.multi is not None:
+            # anycast ECMP: the election outcome depends only on its
+            # advertisers' (dist, first-hop) classes — scope by the
+            # advertiser matrix instead of re-assembling all of them
+            t = view.multi
+            hit = t.known & changed_mask[t.adv]
+            for i in np.unique(t.seg[hit]).tolist():
+                touched.add(t.prefixes[i])
+        for p, _per in view.complex_items:
+            # UCMP/KSP/constrained prefixes: KSP depends on the whole
+            # graph and the rest are cheap — always re-assemble (exact)
             touched.add(p)
         entries = self.assemble_prefix_routes(art2, ps, touched)
         rdb = RouteDatabase(this_node_name=my_node)
@@ -1113,6 +1152,7 @@ class TpuSpfSolver:
         return rdb, art2, touched, touched_labels, region
 
     def _assemble_routes(self, rdb, ls, ps, my_node, solved):
+        t_elect0 = time.perf_counter()
         csr, dist, fh, nbr_ids, lfa = solved
         my_id = csr.name_to_id[my_node]
         d_root = dist[:, 0]  # [Vp]
@@ -1142,14 +1182,41 @@ class TpuSpfSolver:
         # igp) classes — in a fat-tree thousands of prefixes collapse to
         # a handful of classes. The general per-prefix loop below keeps
         # every other case (anycast, UCMP, KSP, min_nexthop, LFA).
-        plain_p, plain_n, plain_e, orig, complex_items, view_gen = (
-            ps.solver_view(csr.name_to_id, csr.base_version)
+        view = ps.election_view(csr.name_to_id, csr.base_version)
+        plain_p, plain_n, plain_e = view.plain_p, view.plain_n, view.plain_e
+        orig, complex_items, view_gen = view.orig, view.complex_items, view.gen
+        multi = view.multi
+        if lfa is not None:
+            # LFA backups are per-target, not per-class — every prefix
+            # takes the general scalar loop when LFA is enabled (the
+            # fallback matrix in docs/Decision.md)
+            merged = list(complex_items)
+            if len(plain_p):
+                merged += [
+                    (p, {plain_n[i]: plain_e[i]})
+                    for i, p in enumerate(plain_p)
+                ]
+            if multi is not None:
+                merged += multi_items(multi)
+            complex_items = sorted(merged)
+            multi = None
+            plain_p = []
+        self.elect_stats["plain"] = len(plain_p)
+        self.elect_stats["multi"] = (
+            len(multi.prefixes) if multi is not None else 0
         )
+        self.elect_stats["complex"] = len(complex_items)
+        # multi-advertiser election: the masked argmax/argmin over the
+        # prefix→advertiser matrix (device-side segmented reductions
+        # past elect_device_min slots, NumPy below — byte-equal)
+        mel = None
+        if multi is not None and len(multi.prefixes):
+            mel = self._elect_multi(multi, d_root, fh_any, my_id, view_gen)
         # fingerprint for every cross-rebuild assembly cache: my own
         # adjacency slot details (interface names, min-metric parallel
         # links), which the fh column alone can't see
         slot_gen = (ls.area, tuple(tuple(s) for s in slot_cache))
-        if len(plain_p) and lfa is None:
+        if len(plain_p):
             reach = (
                 (d_root[orig] < INF_DIST) & fh_any[orig] & (orig != my_id)
             )
@@ -1163,80 +1230,136 @@ class TpuSpfSolver:
                 class_nhs[c] = self._mk_nexthops_union(
                     slot_cache, fh[:, orig[i]], int(igp[i]), ls.area
                 )
+        t_asm0 = time.perf_counter()
+        self.last_phase_ms = {"election": (t_asm0 - t_elect0) * 1e3}
+        cell = None
+        if len(plain_p) or mel is not None:
             # cross-rebuild RibEntry caches (same shape as the MPLS
             # entry cache below): under churn most plain prefixes keep
             # the same (first-hop set, igp) class, and the frozen
             # RibEntry can be reused as-is — which also lets the
             # Decision/Fib diffs skip field-by-field equality via
-            # identity. Two levels, both scoped to the slot fingerprint
+            # identity. Three levels, all scoped to the slot fingerprint
             # and the solver_view generation:
             #   entries:    (view row, class token) → RibEntry
             #   classdicts: (token, membership fp) → {prefix: RibEntry}
-            # The class-level dict makes an unchanged class ONE C-speed
-            # dict.update instead of a per-prefix python loop — a warm
-            # 100k-prefix rebuild collapses to a handful of updates.
+            #   plain/multi: content signature → the WHOLE assembled
+            #                dict of the section — a steady-state
+            #                rebuild whose election outcome is
+            #                byte-identical re-lands the section as one
+            #                C-speed dict.update, no per-class loop
             cell = self._uni_cache.pop(slot_gen, None)
             if cell is None or cell.get("gen") != view_gen:
                 cell = {"gen": view_gen, "entries": {}, "classdicts": {}}
             self._uni_cache[slot_gen] = cell
             while len(self._uni_cache) > self._mpls_fingerprint_cap:
                 self._uni_cache.pop(next(iter(self._uni_cache)))
+        if len(plain_p):
             entries = cell["entries"]
             classdicts = cell["classdicts"]
             if len(entries) > max(8192, 4 * len(plain_p)):
                 entries.clear()
                 classdicts.clear()
+                cell.pop("plain", None)
                 cell["cd_total"] = 0
-            unicast = rdb.unicast_routes
-            for g in _class_groups(cls):
-                c = int(cls[g[0]])
-                nhs = class_nhs[c]
-                if not nhs:
-                    continue
-                rows = idxs[g]
-                token = dest_tokens[c]
-                # membership keyed by the BYTES (not their hash): a
-                # 64-bit hash collision would silently install another
-                # class's routes — unacceptable for a RIB
-                gkey = (token, rows.tobytes())
-                sub = classdicts.get(gkey)
-                if sub is None:
-                    sub = {}
-                    igp_c = int(igp[rows[0]])
-                    for i in rows.tolist():
-                        key = (i, token)
-                        e = entries.get(key)
-                        if e is None:
-                            p = plain_p[i]
-                            e = RibEntry(
-                                prefix=p,
-                                nexthops=nhs,
-                                best_node=plain_n[i],
-                                best_nodes=(plain_n[i],),
-                                best_entry=plain_e[i],
-                                igp_cost=igp_c,
-                            )
-                            entries[key] = e
-                        sub[e.prefix] = e
-                    # bound by TOTAL cached route objects, not key
-                    # count: under churn every rebuild mints new tokens
-                    # and each stale key pins a whole sub-dict
-                    cell["cd_total"] = cell.get("cd_total", 0) + len(sub)
-                    if cell["cd_total"] > 4 * max(len(plain_p), 4096):
-                        classdicts.clear()
-                        cell["cd_total"] = len(sub)
-                    classdicts[gkey] = sub
-                unicast.update(sub)
-        elif len(plain_p):
-            # LFA backups are per-target, not per-class — use the
-            # general loop for everything when LFA is enabled
-            complex_items = sorted(
-                complex_items
-                + [
-                    (p, {plain_n[i]: plain_e[i]})
-                    for i, p in enumerate(plain_p)
-                ]
+            # content signature of this rebuild's entire plain section:
+            # membership rows + their class ids + the CONTENT tokens of
+            # every used class (tokens encode first-hop bits + igp, and
+            # the gen guard pins the view arrays the rows index)
+            sig = (
+                idxs.tobytes(),
+                cls.tobytes(),
+                tuple(dest_tokens[int(c)] for c in ucls),
             )
+            cached_plain = cell.get("plain")
+            unicast = rdb.unicast_routes
+            if cached_plain is not None and cached_plain[0] == sig:
+                unicast.update(cached_plain[1])
+            else:
+                plain_dict: dict = {}
+                for g in _class_groups(cls):
+                    c = int(cls[g[0]])
+                    nhs = class_nhs[c]
+                    if not nhs:
+                        continue
+                    rows = idxs[g]
+                    token = dest_tokens[c]
+                    # membership keyed by the BYTES (not their hash): a
+                    # 64-bit hash collision would silently install
+                    # another class's routes — unacceptable for a RIB
+                    gkey = (token, rows.tobytes())
+                    sub = classdicts.get(gkey)
+                    if sub is None:
+                        sub = {}
+                        igp_c = int(igp[rows[0]])
+                        for i in rows.tolist():
+                            key = (i, token)
+                            e = entries.get(key)
+                            if e is None:
+                                p = plain_p[i]
+                                e = RibEntry(
+                                    prefix=p,
+                                    nexthops=nhs,
+                                    best_node=plain_n[i],
+                                    best_nodes=(plain_n[i],),
+                                    best_entry=plain_e[i],
+                                    igp_cost=igp_c,
+                                )
+                                entries[key] = e
+                            sub[e.prefix] = e
+                        # bound by TOTAL cached route objects, not key
+                        # count: under churn every rebuild mints new
+                        # tokens and each stale key pins a whole sub-dict
+                        cell["cd_total"] = cell.get("cd_total", 0) + len(sub)
+                        if cell["cd_total"] > 4 * max(len(plain_p), 4096):
+                            classdicts.clear()
+                            cell["cd_total"] = len(sub)
+                        classdicts[gkey] = sub
+                    plain_dict.update(sub)
+                cell["plain"] = (sig, plain_dict)
+                unicast.update(plain_dict)
+
+        # ---- unicast: elected multi-advertiser (anycast ECMP) ------------
+        # entry construction per surviving prefix; the nexthop union is
+        # per chosen SET via the memoized factory, so thousands of
+        # anycast prefixes to the same originator set share one group —
+        # and an unchanged election outcome (signature over the
+        # chosen/best masks + igp vector) re-lands last rebuild's
+        # entry dict wholesale, preserving identity for the diff
+        if mel is not None:
+            # the signature must cover the NEXTHOP inputs too, not just
+            # the election outcome: a remote metric change can drop one
+            # of two equal-cost paths without moving d_root or the
+            # chosen set (review finding) — the advertisers' first-hop
+            # columns are gathered into the signature so stale groups
+            # can never be re-landed
+            sig_m = (
+                mel.is_best.tobytes(),
+                mel.chosen.tobytes(),
+                mel.min_igp.tobytes(),
+                fh[:, multi.adv].tobytes(),
+            )
+            cached_m = cell.get("multi")
+            if cached_m is not None and cached_m[0] == sig_m:
+                rdb.unicast_routes.update(cached_m[1])
+            else:
+                mdict: dict = {}
+                for p, best_names, chosen_ids, chosen_names, igp_c, best_e in (
+                    iter_multi_winners(multi, mel)
+                ):
+                    nhs = mk_nexthops_cached(chosen_ids, igp_c)
+                    if not nhs:
+                        continue
+                    mdict[p] = RibEntry(
+                        prefix=p,
+                        nexthops=nhs,
+                        best_node=chosen_names[0],
+                        best_nodes=best_names,
+                        best_entry=best_e,
+                        igp_cost=igp_c,
+                    )
+                cell["multi"] = (sig_m, mdict)
+                rdb.unicast_routes.update(mdict)
 
         # ---- unicast: general path ---------------------------------------
         ksp_jobs = self._unicast_general(
@@ -1249,6 +1372,9 @@ class TpuSpfSolver:
                 csr, ls, my_node, my_id, d_root, ksp_jobs,
                 rdb.unicast_routes,
             )
+
+        t_mpls0 = time.perf_counter()
+        self.last_phase_ms["assembly"] = (t_mpls0 - t_asm0) * 1e3
 
         # ---- MPLS node segments ------------------------------------------
         # cross-rebuild cache: under churn most nodes keep the same
@@ -1350,7 +1476,30 @@ class TpuSpfSolver:
                         ),
                     ),
                 )
+        self.last_phase_ms["mpls"] = (time.perf_counter() - t_mpls0) * 1e3
         return rdb
+
+    def _elect_multi(self, multi, d_root, fh_any, my_id, view_gen):
+        """Multi-advertiser election dispatch: device-side segmented
+        reductions (ops/election.py) once the advertiser matrix is big
+        enough to amortize a dispatch, NumPy below. Integer algebra —
+        the two produce identical results (tested)."""
+        reach = (np.asarray(d_root) < INF_DIST) & fh_any
+        if len(multi.adv) >= self.elect_device_min:
+            from openr_tpu.ops.election import elect_multi_device
+
+            self.elect_stats["device_elections"] += 1
+            self._elect_dev.pop(view_gen, None)  # refresh LRU position
+            out = elect_multi_device(
+                multi, np.asarray(d_root), reach, my_id,
+                dev_cache=self._elect_dev, gen=view_gen,
+            )
+            while len(self._elect_dev) > self._dev_lru_cap:
+                self._elect_dev.pop(next(iter(self._elect_dev)))
+            return out
+        return elect_multi_np(
+            multi, np.asarray(d_root).astype(np.int64), reach, my_id
+        )
 
     @staticmethod
     def _mpls_wrap(base, node: str, label: int) -> tuple[NextHop, ...]:
@@ -1724,8 +1873,8 @@ class TpuSpfSolver:
             )
         return cache
 
-    @staticmethod
     def _mk_nexthops_union(
+        self,
         slot_cache: list[list[tuple[str, str]]],
         valid_rows: np.ndarray,  # [N] bool: union first-hop column
         igp: int,
@@ -1733,7 +1882,9 @@ class TpuSpfSolver:
     ) -> tuple[NextHop, ...]:
         """Unweighted nexthop construction from a precomputed union
         first-hop column (the fast path; the weighted/UCMP path keeps
-        the per-target accumulation in _mk_nexthops)."""
+        the per-target accumulation in _mk_nexthops). The result is
+        interned into the solver's shared NexthopGroup table, so every
+        route class binding the same ECMP set holds the same object."""
         nhs = [
             NextHop(
                 address=fh_name,
@@ -1745,7 +1896,7 @@ class TpuSpfSolver:
             for n_idx in np.nonzero(valid_rows)[0]
             for (fh_name, if_name) in slot_cache[int(n_idx)]
         ]
-        return sorted_nexthops(nhs)
+        return self._nh_intern.intern(sorted_nexthops(nhs))
 
     @staticmethod
     def _mk_nexthops(

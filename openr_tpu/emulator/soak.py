@@ -87,6 +87,13 @@ class SoakConfig:
     # retains is exactly the leak class a storm-heavy soak would grow
     # if the idle-trim eviction policy regressed
     warm_cache_slack_mb: float = 32.0
+    # prefix-table + nexthop-group-intern watermark: the summed
+    # Decision.prefix_table_bytes() across nodes must stay within this
+    # slack of the post-round-1 baseline — a churn horizon that leaks
+    # withdrawn prefixes into PrefixState, or grows the intern tables
+    # without bound, trips here instead of hiding inside total RSS
+    # (the million-prefix data plane's leak class; docs/Decision.md)
+    prefix_table_slack_mb: float = 24.0
     # control knob: build the cluster with messaging bounds DISABLED
     # (caps stay configured, queues unbounded) to prove the watermark
     # checks catch unbounded growth
@@ -101,6 +108,7 @@ class RoundSample:
     churn_events: int
     schedule_hash: str
     warm_mb: float = 0.0  # summed Decision warm-start cache footprint
+    prefix_mb: float = 0.0  # summed prefix-table + intern-table footprint
 
 
 @dataclass
@@ -115,7 +123,7 @@ class SoakReport:
             lines.append(
                 f"  round {s.round}: rss={rss} objects={s.objects} "
                 f"churn={s.churn_events} warm={s.warm_mb}MB "
-                f"schedule={s.schedule_hash[:12]}"
+                f"prefix={s.prefix_mb}MB schedule={s.schedule_hash[:12]}"
             )
         return "\n".join(lines)
 
@@ -252,7 +260,7 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
         await cluster.wait_converged(timeout=cfg.quiesce_timeout_s)
         report = SoakReport(seed=cfg.seed)
         churn_rng = plan.rng("soak/churn")
-        baseline: tuple[float | None, int, float] | None = None
+        baseline: tuple[float | None, int, float, float] | None = None
         for rnd in range(cfg.rounds):
             plan.active = True
             cluster.make_storm(
@@ -292,6 +300,13 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                 )
                 / 1e6
             )
+            prefix_mb = (
+                sum(
+                    n.decision.prefix_table_bytes()
+                    for n in cluster.nodes.values()
+                )
+                / 1e6
+            )
             report.rounds.append(
                 RoundSample(
                     round=rnd,
@@ -300,18 +315,20 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                     churn_events=churner.events,
                     schedule_hash=plan.schedule_hash(),
                     warm_mb=round(warm_mb, 2),
+                    prefix_mb=round(prefix_mb, 2),
                 )
             )
             log.info(
-                "soak round %d clean: rss=%s objects=%d churn=%d warm=%.1fMB",
-                rnd, rss_mb, objects, churner.events, warm_mb,
+                "soak round %d clean: rss=%s objects=%d churn=%d "
+                "warm=%.1fMB prefix=%.1fMB",
+                rnd, rss_mb, objects, churner.events, warm_mb, prefix_mb,
             )
             if rnd == 0:
                 # round 1 is the warmup baseline (JIT caches, interned
                 # bytes); monotone growth is judged from here on
-                baseline = (rss_mb, objects, warm_mb)
+                baseline = (rss_mb, objects, warm_mb, prefix_mb)
                 continue
-            base_rss, base_obj, base_warm = baseline
+            base_rss, base_obj, base_warm, base_prefix = baseline
             if warm_mb > base_warm + cfg.warm_cache_slack_mb:
                 raise SoakError(
                     f"warm-cache watermark breach ({context}): "
@@ -319,6 +336,14 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                     f"baseline {base_warm:.1f}MB + "
                     f"{cfg.warm_cache_slack_mb:.0f}MB slack "
                     "(SolveArtifact eviction policy regressed?)"
+                )
+            if prefix_mb > base_prefix + cfg.prefix_table_slack_mb:
+                raise SoakError(
+                    f"prefix-table watermark breach ({context}): "
+                    f"{prefix_mb:.1f}MB of prefix-table + intern-table "
+                    f"state > baseline {base_prefix:.1f}MB + "
+                    f"{cfg.prefix_table_slack_mb:.0f}MB slack "
+                    "(withdrawn prefixes or nexthop groups leaking?)"
                 )
             if (
                 rss_mb is not None

@@ -205,6 +205,19 @@ class Fib(OpenrModule):
         # the RIB as Decision last gave it to us (desired state)
         self.desired_unicast: dict[IpPrefix, RibEntry] = {}
         self.desired_mpls: dict[int, RibMplsEntry] = {}
+        # delta book: the bindings that changed since the last
+        # successful program pass. The SYNCED-state program cycle is
+        # driven entirely from this book — it never snapshots or
+        # re-derives the full desired table, so an idle cycle at a
+        # million prefixes is O(1) and a k-route delta is O(k)
+        # (invariant: desired == programmed ⊕ pending book; the
+        # full-sync/warm-boot paths snapshot desired and clear it)
+        self._pend_u_upd: dict[IpPrefix, RibEntry] = {}
+        self._pend_u_del: set[IpPrefix] = set()
+        self._pend_m_upd: dict[int, RibMplsEntry] = {}
+        self._pend_m_del: set[int] = set()
+        # handler-call chunking for the batched add/delete path
+        self.batch_size = max(1, config.node.fib.program_batch_size)
         # what we have successfully programmed (actual state)
         self.programmed_unicast: dict[IpPrefix, UnicastRoute] = {}
         self.programmed_mpls: dict[int, MplsRoute] = {}
@@ -293,6 +306,9 @@ class Fib(OpenrModule):
         if upd.type == RouteUpdateType.FULL_SYNC:
             self.desired_unicast = dict(upd.unicast_to_update)
             self.desired_mpls = dict(upd.mpls_to_update)
+            # the full-table program paths snapshot `desired` wholesale,
+            # so the delta book is superseded
+            self._clear_pending()
             # after a warm boot the incremental diff against the adopted
             # kernel state IS the full sync (it deletes stale routes
             # too) — sync_fib here would defeat dataplane continuity
@@ -301,12 +317,24 @@ class Fib(OpenrModule):
             return
         for prefix, entry in upd.unicast_to_update.items():
             self.desired_unicast[prefix] = entry
+            self._pend_u_upd[prefix] = entry
+            self._pend_u_del.discard(prefix)
         for prefix in upd.unicast_to_delete:
             self.desired_unicast.pop(prefix, None)
+            self._pend_u_upd.pop(prefix, None)
+            self._pend_u_del.add(prefix)
         for label, mentry in upd.mpls_to_update.items():
             self.desired_mpls[label] = mentry
+            self._pend_m_upd[label] = mentry
+            self._pend_m_del.discard(label)
         for label in upd.mpls_to_delete:
             self.desired_mpls.pop(label, None)
+            self._pend_m_upd.pop(label, None)
+            self._pend_m_del.add(label)
+
+    def _clear_pending(self) -> None:
+        self._pend_u_upd, self._pend_u_del = {}, set()
+        self._pend_m_upd, self._pend_m_del = {}, set()
 
     # ------------------------------------------------------------- program
 
@@ -377,12 +405,103 @@ class Fib(OpenrModule):
         # spuriously pass the FIB_SYNCED gate
         if not self._have_rib:
             return
-        # snapshot the desired state NOW: _update_loop may fold new updates
-        # in while we await the handler, and those must not be reported as
-        # programmed (they re-trigger via _dirty)
+        if self.dry_run or self._need_full_sync or self._warm_booted:
+            await self._program_full_table()
+            return
+        # ---- delta-native SYNCED path -----------------------------------
+        # The cycle is driven by the pending delta book alone: no
+        # full-table snapshot, no per-cycle to_unicast_route() of every
+        # entry — an idle pass is O(1) and a k-route delta is O(k).
+        # Pop the book NOW: folds arriving while we await the handler
+        # land in a fresh book and re-trigger via _dirty.
+        u_upd, u_del_set = self._pend_u_upd, self._pend_u_del
+        m_upd, m_del_set = self._pend_m_upd, self._pend_m_del
+        self._clear_pending()
+        scanned = len(u_upd) + len(u_del_set) + len(m_upd) + len(m_del_set)
+        if self.counters and scanned:
+            self.counters.increment("fib.program_scan_routes", scanned)
+        u_add = []
+        for p, e in u_upd.items():
+            r = e.to_unicast_route()
+            prev = self.programmed_unicast.get(p)
+            if prev is not None and prev == r:
+                continue  # no-op rebinding (NexthopGroup identity compare)
+            u_add.append((p, r))
+        u_del = [p for p in u_del_set if p in self.programmed_unicast]
+        m_add = []
+        for label, me in m_upd.items():
+            r = me.to_mpls_route()
+            prev = self.programmed_mpls.get(label)
+            if prev is not None and prev == r:
+                continue
+            m_add.append((label, r))
+        m_del = [
+            label for label in m_del_set if label in self.programmed_mpls
+        ]
+        if not (u_add or u_del or m_add or m_del):
+            return  # idle cycle: no handler traffic, no table walks
+        # batched add/delete chunks — one bounded handler call per chunk
+        # so a million-route convergence never ships one giant frame
+        for lo in range(0, len(u_add), self.batch_size):
+            chunk = u_add[lo : lo + self.batch_size]
+            await self.handler.add_unicast_routes(
+                CLIENT_ID_OPENR, [r for _p, r in chunk]
+            )
+            self._count_batch(len(chunk))
+        for lo in range(0, len(u_del), self.batch_size):
+            chunk = u_del[lo : lo + self.batch_size]
+            await self.handler.delete_unicast_routes(CLIENT_ID_OPENR, chunk)
+            self._count_batch(len(chunk))
+        for lo in range(0, len(m_add), self.batch_size):
+            chunk = m_add[lo : lo + self.batch_size]
+            await self.handler.add_mpls_routes(
+                CLIENT_ID_OPENR, [r for _l, r in chunk]
+            )
+            self._count_batch(len(chunk))
+        for lo in range(0, len(m_del), self.batch_size):
+            chunk = m_del[lo : lo + self.batch_size]
+            await self.handler.delete_mpls_routes(CLIENT_ID_OPENR, chunk)
+            self._count_batch(len(chunk))
+        for p, r in u_add:
+            self.programmed_unicast[p] = r
+        for p in u_del:
+            self.programmed_unicast.pop(p, None)
+        for label, r in m_add:
+            self.programmed_mpls[label] = r
+        for label in m_del:
+            self.programmed_mpls.pop(label, None)
+        if self.counters:
+            self.counters.increment(
+                "fib.routes_programmed",
+                len(u_add) + len(u_del) + len(m_add) + len(m_del),
+            )
+        self._publish_programmed(
+            {p: u_upd[p] for p, _r in u_add},
+            {label: m_upd[label] for label, _r in m_add},
+            u_del=u_del,
+            m_del=m_del,
+        )
+
+    def _count_batch(self, n: int) -> None:
+        if self.counters:
+            self.counters.increment("fib.program_batches")
+            self.counters.add_value("fib.program_batch_size", n)
+
+    async def _program_full_table(self) -> None:
+        """The O(table) program paths: dry-run projection, full resync
+        (first RIB / periodic anti-entropy / post-failure recovery), and
+        the one-shot warm-boot dataplane-key delta. Each snapshots the
+        whole desired table — by design; the SYNCED steady state never
+        comes here."""
+        # snapshot NOW: _update_loop may fold new updates in while we
+        # await the handler, and those must not be reported as
+        # programmed (they re-trigger via _dirty). The snapshot covers
+        # everything folded so far, so the delta book is superseded —
+        # no await sits between the snapshot and the clear.
         snap_u = dict(self.desired_unicast)
         snap_m = dict(self.desired_mpls)
-        desired_u = {p: e.to_unicast_route() for p, e in snap_u.items()}
+        self._clear_pending()
+        desired_u = {p: e.to_unicast_route() for p, e in snap_u.items()}  # orlint: disable=OR012 — full-table resync seam (O(P) by design)
         desired_m = {l: e.to_mpls_route() for l, e in snap_m.items()}
         if self.dry_run:
             self.programmed_unicast = desired_u
@@ -395,42 +514,36 @@ class Fib(OpenrModule):
             self._need_full_sync = False
             self.programmed_unicast = desired_u
             self.programmed_mpls = desired_m
+            if self.counters:
+                self.counters.increment(
+                    "fib.routes_programmed", len(desired_u) + len(desired_m)
+                )
             self._publish_programmed(snap_u, snap_m, full=True)
             return
-        # incremental: diff desired vs programmed. After a warm boot the
-        # programmed side came from a kernel dump, which can't carry
-        # control-plane-only fields (metric, area, neighbor name) — the
-        # first delta compares the dataplane projection instead, so
-        # surviving routes aren't pointlessly reprogrammed.
-        warm = self._warm_booted
-        if warm:
-            def same_u(a: UnicastRoute | None, b: UnicastRoute) -> bool:
-                return a is not None and (
-                    _dataplane_key_unicast(a) == _dataplane_key_unicast(b)
-                )
+        # warm boot: the programmed side came from a kernel dump, which
+        # can't carry control-plane-only fields (metric, area, neighbor
+        # name) — this one-shot delta compares the dataplane projection
+        # instead, so surviving routes aren't pointlessly reprogrammed.
+        def same_u(a: UnicastRoute | None, b: UnicastRoute) -> bool:
+            return a is not None and (
+                _dataplane_key_unicast(a) == _dataplane_key_unicast(b)
+            )
 
-            def same_m(a: MplsRoute | None, b: MplsRoute) -> bool:
-                return a is not None and (
-                    _dataplane_key_mpls(a) == _dataplane_key_mpls(b)
-                )
-
-        else:
-            def same_u(a, b):
-                return a == b
-
-            def same_m(a, b):
-                return a == b
+        def same_m(a: MplsRoute | None, b: MplsRoute) -> bool:
+            return a is not None and (
+                _dataplane_key_mpls(a) == _dataplane_key_mpls(b)
+            )
 
         u_add = [
             r for p, r in desired_u.items()
             if not same_u(self.programmed_unicast.get(p), r)
         ]
-        u_del = [p for p in self.programmed_unicast if p not in desired_u]
+        u_del = [p for p in self.programmed_unicast if p not in desired_u]  # orlint: disable=OR012 — one-shot warm-boot table diff (O(P) by design)
         m_add = [
             r for l, r in desired_m.items()
             if not same_m(self.programmed_mpls.get(l), r)
         ]
-        m_del = [l for l in self.programmed_mpls if l not in desired_m]
+        m_del = [l for l in self.programmed_mpls if l not in desired_m]  # orlint: disable=OR012 — one-shot warm-boot table diff
         if u_add:
             await self.handler.add_unicast_routes(CLIENT_ID_OPENR, u_add)
         if u_del:
@@ -439,24 +552,16 @@ class Fib(OpenrModule):
             await self.handler.add_mpls_routes(CLIENT_ID_OPENR, m_add)
         if m_del:
             await self.handler.delete_mpls_routes(CLIENT_ID_OPENR, m_del)
-        if warm:
-            # every surviving route is now accounted for in control-plane
-            # form; downstream (PrefixManager gating) sees the full state
-            self._warm_booted = False
-            self.programmed_unicast = desired_u
-            self.programmed_mpls = desired_m
-            if self.counters:
-                self.counters.set(
-                    "fib.warm_boot_reprogrammed", len(u_add) + len(m_add)
-                )
-            self._publish_programmed(snap_u, snap_m, full=True)
-        elif u_add or u_del or m_add or m_del:
-            self.programmed_unicast = desired_u
-            self.programmed_mpls = desired_m
-            self._publish_programmed(
-                snap_u, snap_m,
-                u_add=u_add, u_del=u_del, m_add=m_add, m_del=m_del,
+        # every surviving route is now accounted for in control-plane
+        # form; downstream (PrefixManager gating) sees the full state
+        self._warm_booted = False
+        self.programmed_unicast = desired_u
+        self.programmed_mpls = desired_m
+        if self.counters:
+            self.counters.set(
+                "fib.warm_boot_reprogrammed", len(u_add) + len(m_add)
             )
+        self._publish_programmed(snap_u, snap_m, full=True)
 
     def _complete_traces(self, n_covered: int) -> None:
         """Stamp FIB_PROGRAMMED on the first `n_covered` pending traces —
@@ -487,14 +592,14 @@ class Fib(OpenrModule):
         snap_u: dict[IpPrefix, RibEntry],
         snap_m: dict[int, RibMplsEntry],
         full: bool = False,
-        u_add: Iterable[UnicastRoute] = (),
         u_del: Iterable[IpPrefix] = (),
-        m_add: Iterable[MplsRoute] = (),
         m_del: Iterable[int] = (),
     ) -> None:
         """Stream programmed-route updates (reference: Fib's
-        fibRouteUpdatesQueue_ †, consumed by PrefixManager gating). Reads
-        only the snapshot actually handed to the handler."""
+        fibRouteUpdatesQueue_ †, consumed by PrefixManager gating).
+        ``snap_u``/``snap_m`` are the RibEntry bindings actually handed
+        to the handler — the whole table on the full paths, ONLY the
+        changed bindings on the delta path."""
         if self.fib_updates is None:
             return
         upd = RouteUpdate()
@@ -504,11 +609,9 @@ class Fib(OpenrModule):
             upd.mpls_to_update = dict(snap_m)
         else:
             upd.type = RouteUpdateType.INCREMENTAL
-            ua = {r.dest for r in u_add}
-            upd.unicast_to_update = {p: e for p, e in snap_u.items() if p in ua}
+            upd.unicast_to_update = dict(snap_u)
             upd.unicast_to_delete = list(u_del)
-            ma = {r.top_label for r in m_add}
-            upd.mpls_to_update = {l: e for l, e in snap_m.items() if l in ma}
+            upd.mpls_to_update = dict(snap_m)
             upd.mpls_to_delete = list(m_del)
         self.fib_updates.push(upd)
 
@@ -518,18 +621,18 @@ class Fib(OpenrModule):
         """Desired-vs-programmed delta counts + examples (single source
         of truth for convergence checks — validate uses this instead of
         re-deriving the diff)."""
-        desired_u = {p: e.to_unicast_route() for p, e in self.desired_unicast.items()}
-        desired_m = {l: e.to_mpls_route() for l, e in self.desired_mpls.items()}
+        desired_u = {p: e.to_unicast_route() for p, e in self.desired_unicast.items()}  # orlint: disable=OR012 — convergence accessor (validate/invariants), not the program cycle
+        desired_m = {l: e.to_mpls_route() for l, e in self.desired_mpls.items()}  # orlint: disable=OR012 — convergence accessor
         u_stale = [
             str(p) for p, r in desired_u.items()
             if self.programmed_unicast.get(p) != r
         ]
-        u_del = [str(p) for p in self.programmed_unicast if p not in desired_u]
+        u_del = [str(p) for p in self.programmed_unicast if p not in desired_u]  # orlint: disable=OR012 — convergence accessor
         m_stale = [
             l for l, r in desired_m.items()
             if self.programmed_mpls.get(l) != r
         ]
-        m_del = [l for l in self.programmed_mpls if l not in desired_m]
+        m_del = [l for l in self.programmed_mpls if l not in desired_m]  # orlint: disable=OR012 — convergence accessor
         return {
             "converged": not (u_stale or u_del or m_stale or m_del),
             "desired_unicast": len(desired_u),

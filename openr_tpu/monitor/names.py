@@ -57,12 +57,20 @@ COUNTERS: frozenset[str] = frozenset(
         "decision.spf_ms",
         "decision.spf_runs",
         "decision.spf_solve_ms",
+        # decision: nexthop-group intern table size (gauge)
+        "decision.nexthop_groups",
         # fib
         "fib.perf_traces_completed",
         "fib.program_ok",
         "fib.program_fail",
         "fib.program_fail_streak",
         "fib.program_ms",
+        # delta-native programming (docs/Fib.md): batched chunk calls,
+        # per-chunk size stat, routes written, delta-book scan size
+        "fib.program_batches",
+        "fib.program_batch_size",
+        "fib.program_scan_routes",
+        "fib.routes_programmed",
         "fib.warm_boot_reprogrammed",
         "fib.warm_boot_routes",
         # kvstore
@@ -132,6 +140,8 @@ COUNTERS: frozenset[str] = frozenset(
         "prefixmgr.advertised",
         "prefixmgr.events",
         "prefixmgr.policy_denied",
+        "prefixmgr.range_chunks",
+        "prefixmgr.range_prefixes",
         "prefixmgr.redistributed",
         # common/tasks guard_task default
         "task.uncaught_exceptions",
@@ -162,6 +172,7 @@ TEMPLATES: dict[str, str | None] = {
     # decision engine substructure
     "decision.decode.*": None,
     "decision.dev_cache.*": None,
+    "decision.elect.*": None,
     "decision.spf.*": None,
     # per-jitted-function compile counts (monitor/compile_ledger.py) —
     # the fn segment is the jit wrapper's name

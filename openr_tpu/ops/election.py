@@ -1,0 +1,121 @@
+"""Device-side multi-advertiser best-path election.
+
+The masked-argmax / masked-argmin step of the batched election
+(decision/election.py) as jitted segmented reductions: one dispatch per
+rebuild elects every multi-advertiser (anycast ECMP) prefix against the
+solved root-distance vector. Inputs are integer-exact mirrors of the
+NumPy path (`elect_multi_np`), so both produce identical results —
+gated by tests/test_prefix_scale.py.
+
+Shapes are bucketed (pad_bucket) on both the slot axis and the segment
+count, so churn in the advertiser matrix only recompiles when a bucket
+is outgrown (the OR010 discipline). Padding slots are ineligible
+(known=False, rank=-1) and scattered to a trailing padding segment, so
+they cannot perturb any real prefix's reduction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.common.util import pad_bucket
+from openr_tpu.decision.election import MultiElection, MultiTable
+from openr_tpu.monitor import compile_ledger
+from openr_tpu.ops.spf import INF_DIST
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def _elect_seg(seg, adv, known, rank, d_vec, reach, my_id, num_segments):
+    """Segmented election core (all int32/bool; indices sorted by
+    construction — the table is CSR-ordered)."""
+    is_me = known & (adv == my_id)
+    elig = (known & reach[adv]) | is_me
+    r_eff = jnp.where(elig, rank, jnp.int32(-1))
+    best_r = jax.ops.segment_max(
+        r_eff, seg, num_segments=num_segments, indices_are_sorted=True
+    )
+    is_best = elig & (r_eff == best_r[seg])
+    local = (
+        jax.ops.segment_max(
+            jnp.where(is_best & is_me, jnp.int32(1), jnp.int32(0)),
+            seg,
+            num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+        > 0
+    )
+    d_adv = jnp.where(is_best, d_vec[adv], INF_DIST)
+    min_igp = jax.ops.segment_min(
+        d_adv, seg, num_segments=num_segments, indices_are_sorted=True
+    )
+    chosen = is_best & (d_adv == min_igp[seg])
+    return best_r, min_igp, is_best, chosen, local
+
+
+def elect_multi_device(
+    t: MultiTable,
+    d_vec: np.ndarray,
+    reach_vec: np.ndarray,
+    my_id: int,
+    dev_cache: dict,
+    gen,
+) -> MultiElection:
+    """Run the multi-table election on device; returns the same
+    :class:`MultiElection` as `elect_multi_np`.
+
+    The advertiser matrix (seg/adv/known/rank) is static per election-
+    view generation and cached device-resident under ``gen``; only the
+    per-solve distance/reach vectors upload each call."""
+    s = len(t.adv)
+    m = len(t.prefixes)
+    sp = pad_bucket(s)
+    mp = pad_bucket(m)
+    cached = dev_cache.get(gen)
+    if cached is None or cached["sp"] != sp or cached["mp"] != mp:
+        seg = np.full(sp, mp - 1, np.int32)
+        seg[:s] = t.seg
+        adv = np.zeros(sp, np.int32)
+        adv[:s] = t.adv
+        known = np.zeros(sp, dtype=bool)
+        known[:s] = t.known
+        rank = np.full(sp, -1, np.int32)
+        rank[:s] = t.rank  # dense ranks < S: always fits int32
+        cached = {
+            "sp": sp,
+            "mp": mp,
+            "seg": jnp.asarray(seg),
+            "adv": jnp.asarray(adv),
+            "known": jnp.asarray(known),
+            "rank": jnp.asarray(rank),
+        }
+        dev_cache[gen] = cached
+    best_r, min_igp, is_best, chosen, local = _elect_seg(
+        cached["seg"],
+        cached["adv"],
+        cached["known"],
+        cached["rank"],
+        jnp.asarray(d_vec.astype(np.int32)),
+        jnp.asarray(reach_vec),
+        jnp.int32(my_id),
+        num_segments=mp,
+    )
+    best_r = np.asarray(best_r)
+    min_igp = np.asarray(min_igp)
+    is_best_h = np.asarray(is_best)
+    chosen_h = np.asarray(chosen)
+    local_h = np.asarray(local)
+    compile_ledger.record_transfer(
+        best_r.nbytes + min_igp.nbytes + is_best_h.nbytes
+        + chosen_h.nbytes + local_h.nbytes
+    )
+    return MultiElection(
+        survive=(best_r[:m] >= 0) & ~local_h[:m],
+        local=local_h[:m],
+        is_best=is_best_h[:s],
+        chosen=chosen_h[:s],
+        min_igp=min_igp[:m].astype(np.int64),
+    )

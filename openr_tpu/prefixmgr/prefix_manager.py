@@ -54,6 +54,11 @@ class PrefixEvent:
     source: PrefixSource = PrefixSource.API
     entries: tuple[PrefixEntry, ...] = ()
     dest_areas: tuple[str, ...] = ()  # () = all configured areas
+    # range origination (prefixmgr/ranges.py): contiguous prefix blocks
+    # advertised as chunked PrefixDatabases — the book holds the range
+    # descriptors, never count× PrefixEntry dataclasses. Appended field
+    # (wire evolution: older peers default it to ()).
+    ranges: tuple = ()
 
 
 @dataclass
@@ -97,6 +102,11 @@ class PrefixManager(OpenrModule):
         self._entries: dict[
             tuple[PrefixSource, IpPrefix], tuple[PrefixEntry, tuple[str, ...]]
         ] = {}
+        # (source, range key) -> (PrefixRange, dest_areas): the range
+        # origination book — O(ranges), never O(prefixes)
+        self._range_entries: dict[tuple, tuple] = {}
+        # range key -> (PrefixRange, advertised areas) for withdrawal
+        self._range_adv: dict[tuple, tuple] = {}
         # prefix -> set of areas currently advertised into
         self._advertised: dict[IpPrefix, set[str]] = {}
         self._originations: list[_Origination] = [
@@ -134,12 +144,22 @@ class PrefixManager(OpenrModule):
                             self.counters.increment("prefixmgr.policy_denied")
                         continue
                 self._entries[(ev.source, e.prefix)] = (e, ev.dest_areas)
+            # ranges bypass per-entry policy: the template is the only
+            # entry shape, and expanding a million members through the
+            # policy engine is exactly what range origination avoids —
+            # operators policy the template before handing it over
+            for r in ev.ranges:
+                self._range_entries[(ev.source, r.key())] = (r, ev.dest_areas)
         elif ev.type == PrefixEventType.WITHDRAW_PREFIXES:
             for e in ev.entries:
                 self._entries.pop((ev.source, e.prefix), None)
+            for r in ev.ranges:
+                self._range_entries.pop((ev.source, r.key()), None)
         elif ev.type == PrefixEventType.WITHDRAW_SOURCE:
             for key in [k for k in self._entries if k[0] == ev.source]:
                 del self._entries[key]
+            for key in [k for k in self._range_entries if k[0] == ev.source]:
+                del self._range_entries[key]
         self._sync_advertisements()
         if self.counters:
             self.counters.increment("prefixmgr.events")
@@ -268,8 +288,80 @@ class PrefixManager(OpenrModule):
                 best[prefix] = (source, entry, areas)
         return {p: (e, a) for p, (_s, e, a) in best.items()}
 
+    def _sync_ranges(self) -> None:
+        """Make the KvStore reflect the range origination book: each
+        range becomes RANGE_CHUNK-sized per-prefix-key PrefixDatabases
+        (Decision's prefix ingest handles multi-entry values natively),
+        advertised once per range — a steady-state sync pass touches
+        nothing, so the cost is O(changed ranges × chunks), never
+        O(advertised prefixes)."""
+        want = {
+            rkey: (rng, areas)
+            for (_src, rkey), (rng, areas) in sorted(
+                self._range_entries.items()
+            )
+        }
+        all_areas = tuple(self.config.area_ids())
+        for rkey, (rng, dest_areas) in want.items():
+            areas = tuple(dest_areas or all_areas)
+            prev = self._range_adv.get(rkey)
+            if prev is not None:
+                # re-advertise only when the CONTENT moved: a re-push
+                # of the same block with new template metrics or dest
+                # areas must reach the KvStore (version bumps supersede
+                # the old values), while a steady-state sync pass stays
+                # a no-op (review finding: keying on (base, plen,
+                # count) alone silently dropped template changes)
+                if prev[0].template == rng.template and prev[1] == areas:
+                    continue
+                stale = set(prev[1]) - set(areas)
+                if stale:
+                    self._withdraw_range_areas(prev[0], stale)
+            chunks = 0
+            for area in areas:
+                for first, entries in rng.chunks():
+                    key = C.prefix_key(self.node_name, area, first)
+                    db = PrefixDatabase(
+                        this_node_name=self.node_name,
+                        prefix_entries=entries,
+                        area=area,
+                    )
+                    self.kv_client.persist_key(
+                        area, key, to_wire(db), ttl_ms=self.ttl_ms
+                    )
+                    chunks += 1
+            self._range_adv[rkey] = (rng, areas)
+            if self.counters:
+                self.counters.increment("prefixmgr.range_chunks", chunks)
+        for rkey in [k for k in self._range_adv if k not in want]:
+            rng, areas = self._range_adv.pop(rkey)
+            self._withdraw_range_areas(rng, areas)
+        if self.counters:
+            self.counters.set(
+                "prefixmgr.range_prefixes",
+                sum(len(r) for r, _a in self._range_adv.values()),
+            )
+
+    def _withdraw_range_areas(self, rng, areas) -> None:
+        """Tombstone every chunk of `rng` in `areas` (full withdrawal
+        or the stale-area slice of a re-origination)."""
+        for area in areas:
+            for first, entries in rng.chunks():
+                key = C.prefix_key(self.node_name, area, first)
+                tombstone = PrefixDatabase(
+                    this_node_name=self.node_name,
+                    prefix_entries=entries,
+                    area=area,
+                    delete_prefix=True,
+                )
+                self.kv_client.persist_key(
+                    area, key, to_wire(tombstone), ttl_ms=self.ttl_ms
+                )
+                self.kv_client.unset_key(area, key)
+
     def _sync_advertisements(self) -> None:
         """Make the KvStore reflect the current entry book exactly."""
+        self._sync_ranges()
         want = self._best_entries()
         all_areas = tuple(self.config.area_ids())
         # advertise / update

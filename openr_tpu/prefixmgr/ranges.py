@@ -1,0 +1,98 @@
+"""Range origination: advertise a contiguous block of prefixes as ONE
+object instead of minting one PrefixEntry dataclass per prefix.
+
+The million-prefix data plane needs originators that can say "this node
+owns 10.128.0.0/9 carved into /24s" without holding a million Python
+objects: a :class:`PrefixRange` is a frozen descriptor (base address as
+an integer, prefix length, count, one template entry carrying the
+shared metrics/flags), and prefixes materialize lazily — per chunk at
+advertisement time, per index on demand. PrefixManager advertises a
+range as chunked per-prefix-key PrefixDatabases (RANGE_CHUNK entries
+per KvStore key), so the wire and the LSDB see the normal prefix-key
+shape while the origination book stays O(ranges).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field, replace
+
+from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.topology import PrefixEntry
+
+#: prefixes per advertised PrefixDatabase chunk (one KvStore key each):
+#: big enough that a 1M-prefix range is ~1k keys, small enough that one
+#: chunk's decode stays well under a flood frame budget
+RANGE_CHUNK = 1024
+
+
+def _v4_str(addr: int) -> str:
+    return (
+        f"{(addr >> 24) & 0xFF}.{(addr >> 16) & 0xFF}."
+        f"{(addr >> 8) & 0xFF}.{addr & 0xFF}"
+    )
+
+
+@dataclass(frozen=True)
+class PrefixRange:
+    """``count`` consecutive ``/plen`` IPv4 prefixes starting at
+    ``base`` (must be ``plen``-aligned), all sharing ``template``'s
+    metrics/flags. Materialization is arithmetic — no ipaddress parse
+    per prefix — and lazy."""
+
+    base: str  # network address of the first prefix, e.g. "10.128.0.0"
+    plen: int
+    count: int
+    template: PrefixEntry = field(
+        default_factory=lambda: PrefixEntry(
+            prefix=IpPrefix(prefix="0.0.0.0/32")
+        )
+    )
+
+    def __post_init__(self):
+        base_int = int(ipaddress.IPv4Address(self.base))
+        step = 1 << (32 - self.plen)
+        if base_int % step:
+            raise ValueError(
+                f"range base {self.base} is not /{self.plen}-aligned"
+            )
+        if base_int + self.count * step > 1 << 32:
+            raise ValueError("range overflows the v4 address space")
+        object.__setattr__(self, "_base_int", base_int)
+        object.__setattr__(self, "_step", step)
+
+    def __len__(self) -> int:
+        return self.count
+
+    def prefix_at(self, i: int) -> IpPrefix:
+        if not 0 <= i < self.count:
+            raise IndexError(i)
+        # canonical by construction: the base is aligned, so every
+        # member address is its own network address — IpPrefix.make's
+        # normalization would be a no-op (and a 1M-range parse bill)
+        return IpPrefix(
+            prefix=f"{_v4_str(self._base_int + i * self._step)}/{self.plen}"
+        )
+
+    def entry_at(self, i: int) -> PrefixEntry:
+        return replace(self.template, prefix=self.prefix_at(i))
+
+    def entries(self):
+        """Lazy iterator over the range's PrefixEntry objects."""
+        for i in range(self.count):
+            yield self.entry_at(i)
+
+    def chunks(self, size: int = RANGE_CHUNK):
+        """Yield (first_prefix_str, tuple-of-entries) advertisement
+        chunks; each becomes one per-prefix-key PrefixDatabase."""
+        for lo in range(0, self.count, size):
+            hi = min(lo + size, self.count)
+            yield (
+                str(self.prefix_at(lo).prefix),
+                tuple(self.entry_at(i) for i in range(lo, hi)),
+            )
+
+    def key(self) -> tuple[str, int, int]:
+        """Identity of the block (base, plen, count) — the origination
+        book's dict key."""
+        return (self.base, self.plen, self.count)

@@ -46,6 +46,18 @@ class IpPrefix:
         net = ipaddress.ip_network(s, strict=False)
         return IpPrefix(prefix=str(net))
 
+    def __hash__(self):
+        # the generated frozen-dataclass hash builds a field tuple per
+        # call; at a million prefixes every RIB/FIB dict probe pays it,
+        # and the diff walk alone does millions of probes per rebuild.
+        # Cache the string hash on the instance (explicit __hash__ in
+        # the class body: @dataclass keeps it).
+        try:
+            return self._hash
+        except AttributeError:
+            object.__setattr__(self, "_hash", hash(self.prefix))
+            return self._hash
+
     @cached_property
     def network(self) -> ipaddress.IPv4Network | ipaddress.IPv6Network:
         # cached_property writes to __dict__ directly, so it works on a
@@ -68,7 +80,7 @@ class IpPrefix:
 
 
 @total_ordering
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class NextHop:
     """One nexthop of a route.
 

@@ -89,7 +89,7 @@ class PrefixMetrics:
     distance: int = 0
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class PrefixEntry:
     """One advertised prefix — element of the `prefix:` key value.
 

@@ -338,3 +338,40 @@ def erdos_renyi_lsdb(
         entry = PrefixEntry(prefix=loopback(i))
         ps._entries[entry.prefix] = {s: entry}
     return LsdbView(csr), ps, csr
+
+
+def ramp_prefix_state(
+    names: list[str],
+    n_prefixes: int,
+    anycast_every: int = 0,
+    base: str = "16.0.0.0",
+) -> "object":
+    """PrefixState with `n_prefixes` /32s advertised round-robin across
+    `names[1:]` (node 0 is the bench vantage point — keeping it out of
+    the advertiser set makes routes == prefixes exactly).
+
+    Prefixes come from a PrefixRange (prefixmgr/ranges.py): string
+    minting is integer arithmetic, no per-prefix ipaddress parse.
+    With ``anycast_every`` = k > 0, every k-th prefix gains a second
+    advertiser (equal metrics — an ECMP-tie anycast), exercising the
+    multi-advertiser election matrix at scale.
+    """
+    from openr_tpu.decision.linkstate import PrefixState
+    from openr_tpu.prefixmgr.ranges import PrefixRange
+
+    ps = PrefixState()
+    rng = PrefixRange(base=base, plen=32, count=n_prefixes)
+    adv = names[1:] or names
+    n_adv = len(adv)
+    entries = ps._entries
+    for i in range(n_prefixes):
+        e = rng.entry_at(i)
+        per = {adv[i % n_adv]: e}
+        if anycast_every and i % anycast_every == 0 and n_adv > 1:
+            # the +1 offset is provably a DIFFERENT advertiser, so the
+            # anycast count is exact (a pseudo-random second pick could
+            # collide with the first and silently degrade to plain)
+            per[adv[(i + 1) % n_adv]] = e
+        entries[e.prefix] = per
+    ps._rev += 1
+    return ps

@@ -32,6 +32,8 @@ class Bad:
         await asyncio.sleep(jitter)
         self._pending = pending + [1]  # OR003: stale read across await
         self.counters.increment("bogus.counter.name")  # OR007: unregistered
+        for _p, _per in self.ps.prefixes.items():  # OR012: per-prefix loop
+            pass
         return json.dumps({"pub": 1})  # OR011: text frame on a wire seam
 
     async def helper(self):

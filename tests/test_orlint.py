@@ -24,7 +24,7 @@ KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
 
 ALL_CODES = {
     "OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007",
-    "OR008", "OR009", "OR010", "OR011",
+    "OR008", "OR009", "OR010", "OR011", "OR012",
 }
 
 
@@ -580,6 +580,48 @@ def test_or011_text_wire_scope(tmp_path):
             tmp_path, snippet, rel=exempt_rel, select={"OR011"}
         )
         assert codes_of(res) == [], exempt_rel
+
+
+def test_or012_prefix_loop_scope(tmp_path):
+    """Per-prefix loops over PrefixState/RouteDatabase tables flagged in
+    decision/ and fib/ (for-loops AND comprehensions, through sorted()/
+    .items() wrappers); scoped locals and out-of-scope dirs are clean."""
+    snippet = """
+    def rebuild(ps, rdb, fib):
+        for p, per in sorted(ps.prefixes.items()):
+            pass
+        stale = [p for p in fib.desired_unicast if p not in rdb.unicast_routes]
+        return stale
+    """
+    hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/decision/m.py", select={"OR012"}
+    )
+    # the loop, the listcomp's desired_unicast iter — the membership
+    # test on unicast_routes is not an iteration and stays clean
+    assert codes_of(hit) == ["OR012", "OR012"]
+    fib_hit = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/fib/m.py", select={"OR012"}
+    )
+    assert codes_of(fib_hit) == ["OR012", "OR012"]
+    out = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/kvstore/m.py", select={"OR012"}
+    )
+    assert codes_of(out) == []
+    scoped = lint_snippet(
+        tmp_path,
+        """
+        def reassemble(touched, view):
+            out = {}
+            for p in sorted(touched):
+                out[p] = 1
+            for p, per in view.complex_items:
+                out[p] = 2
+            return out
+        """,
+        rel="openr_tpu/decision/m.py",
+        select={"OR012"},
+    )
+    assert codes_of(scoped) == []
 
 
 # ------------------------------------------- suppression + baseline plumbing

@@ -1,0 +1,116 @@
+"""OR012: per-prefix Python loop over PrefixState/RouteDatabase in a
+data-plane hot path.
+
+Scope: ``decision/`` and ``fib/``. The million-prefix data plane moved
+per-prefix best-path election and FIB programming onto vectorized /
+delta-native paths (decision/election.py, the Fib pending book); the
+pattern that regresses it is a Python ``for`` loop (or comprehension)
+iterating one of the O(prefixes) tables:
+
+  * ``PrefixState.prefixes`` (``ps.prefixes.items()`` and friends),
+  * ``RouteDatabase.unicast_routes``,
+  * Fib's ``desired_unicast`` / ``programmed_unicast`` /
+    ``desired_mpls`` / ``programmed_mpls`` books.
+
+At 10k prefixes such a loop is invisible; at 1M it is seconds per
+rebuild/program cycle. Iterating a *scoped* local (touched-prefix sets,
+view.complex_items, a popped delta book) is fine — only the named
+whole-table attributes trip the rule.
+
+Deliberate seams carry inline suppressions with the reasoning: the
+oracle's scalar reference path (what the vectorized election is
+parity-gated against), Fib's full-resync/dry-run table projections
+(O(P) by design, never the steady state), the cross-area merge fold
+(bypassed by the single-area fast path), and operator accessors.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+
+SCOPE_DIRS = ("decision", "fib")
+
+#: whole-table attribute names whose iteration is O(prefixes)
+HOT_ATTRS = frozenset(
+    {
+        "prefixes",
+        "unicast_routes",
+        "desired_unicast",
+        "programmed_unicast",
+        "desired_mpls",
+        "programmed_mpls",
+    }
+)
+
+#: call wrappers that keep the iterable O(table)
+_WRAPPERS = frozenset({"sorted", "list", "tuple", "set", "reversed"})
+_VIEWS = frozenset({"items", "values", "keys"})
+
+
+def _hot_attr(node: ast.AST) -> str | None:
+    """The HOT_ATTRS name an iterable expression ultimately walks, or
+    None. Unwraps sorted()/list() calls and .items()/.values()/.keys()
+    views."""
+    while True:
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _WRAPPERS and node.args:
+                node = node.args[0]
+                continue
+            if isinstance(f, ast.Attribute) and f.attr in _VIEWS:
+                node = f.value
+                continue
+            return None
+        if isinstance(node, ast.Attribute):
+            return node.attr if node.attr in HOT_ATTRS else None
+        return None
+
+
+class PrefixLoopRule(Rule):
+    code = "OR012"
+    name = "prefix-table-loop"
+    description = (
+        "per-prefix Python loop over PrefixState/RouteDatabase in a "
+        "decision/fib hot path — use the vectorized election view or "
+        "the delta book"
+    )
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if not (ctx.part_set() & set(SCOPE_DIRS)):
+            return
+        func = "<module>"
+        stack: list[tuple[ast.AST, str]] = [(ctx.tree, func)]
+        while stack:
+            node, func = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                func = node.name
+            iters: list[tuple[ast.AST, ast.AST]] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append((node, node.iter))
+            elif isinstance(
+                node,
+                (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+            ):
+                iters.extend((node, g.iter) for g in node.generators)
+            for owner, it in iters:
+                attr = _hot_attr(it)
+                if attr is None:
+                    continue
+                yield self.finding(
+                    ctx,
+                    owner,
+                    f"python loop over O(prefixes) table `.{attr}` in a "
+                    f"decision/fib hot path — vectorize through the "
+                    f"election view (decision/election.py) or drive the "
+                    f"cycle from the delta book; scalar fallback seams "
+                    f"need an inline justification",
+                    scope=func,
+                    subject=f"{attr}:{func}",
+                )
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, func))
