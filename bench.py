@@ -1074,6 +1074,10 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
             None if degraded else round(TARGET_MS / solve_p50, 4)
         ),
         "convergence_p50_ms": conv.get("convergence_p50_ms"),
+        # hop-span-derived per-stage p50 breakdown of the same traces
+        # (docs/Monitor.md "Flood tracing") — the attributable scaling
+        # curve's per-point decomposition, carried from day one
+        "convergence_attribution": conv.get("convergence_attribution"),
         "prefix_churn_p50_ms": pchurn.get("prefix_churn_p50_ms"),
         "topo_churn_p50_ms": tchurn.get("topo_churn_p50_ms"),
         # largest completed prefix-ramp rung's end-to-end throughput

@@ -549,6 +549,7 @@ async def _new_traces(cluster, seen_before: dict[str, int], timeout_s: float):
 _FLOOD_COUNTERS = (
     "kvstore.floods_sent",
     "kvstore.flood_bytes",
+    "kvstore.flood_span_bytes",
     "kvstore.flood_encodes",
     "kvstore.flood_keys_coalesced",
     "kvstore.full_syncs",
@@ -569,6 +570,7 @@ def measure_flood(
     flap_rounds: int = 4,
     seed: int = 11,
     timeout_s: float = 180.0,
+    trace_every: int = 0,
 ) -> dict:
     """Full-stack emulated-cluster flood benchmark for ONE wire codec
     (`--flood-bench` runs it for both and prints the comparison).
@@ -648,7 +650,16 @@ def measure_flood(
                 ),
             )
         return replace(
-            ncfg, kvstore=replace(ncfg.kvstore, key_ttl_ms=3_600_000)
+            ncfg,
+            kvstore=replace(
+                ncfg.kvstore,
+                key_ttl_ms=3_600_000,
+                # cross-node flood tracing (docs/Monitor.md): sampled
+                # hop spans ride the floods; 0 = tracing off (the
+                # baseline the --flood-trace overhead gate compares to)
+                trace_sample_every=trace_every,
+                trace_seed=seed,
+            ),
         )
 
     c = Cluster.from_edges(
@@ -664,22 +675,28 @@ def measure_flood(
     def snap() -> dict[str, int]:
         return {k: csum(k) for k in _FLOOD_COUNTERS}
 
-    def seam_ms_sum() -> float:
-        """Cluster-wide pure-CPU time inside the wire seam: every
-        flood encode (`kvstore.flood_encode_ms`) plus every receive
-        decode (`kvstore.flood_decode_ms`). Neither stat spans an
-        await, so event-loop queueing — which dominates the wall-clock
-        `kvstore.flood_fanout_ms` latency under a 64-node churn wave
-        and drowns the codec effect in scheduler noise — can't inflate
-        it (docs/Wire.md)."""
-        total = 0.0
+    def seam_split() -> dict[str, float]:
+        """Cluster-wide pure-CPU time inside the wire seam, split by
+        side: every flood encode (`kvstore.flood_encode_ms`) and every
+        receive decode (`kvstore.flood_decode_ms`). Neither stat spans
+        an await, so event-loop queueing — which dominates the
+        wall-clock `kvstore.flood_fanout_ms` latency under a 64-node
+        churn wave and drowns the codec effect in scheduler noise —
+        can't inflate it (docs/Wire.md)."""
+        out = {"enc": 0.0, "dec": 0.0}
         for n in c.nodes.values():
-            for stat in ("kvstore.flood_encode_ms",
-                         "kvstore.flood_decode_ms"):
+            for key, stat in (
+                ("enc", "kvstore.flood_encode_ms"),
+                ("dec", "kvstore.flood_decode_ms"),
+            ):
                 s = n.counters.stats.get(stat)
                 if s is not None:
-                    total += s.sum
-        return total
+                    out[key] += s.sum
+        return out
+
+    def seam_ms_sum() -> float:
+        s = seam_split()
+        return s["enc"] + s["dec"]
 
     ids: dict[str, int] = {}
 
@@ -723,7 +740,7 @@ def measure_flood(
 
             # stage 1: seeded prefix churn → counter-derived throughput
             base = snap()
-            seam0 = seam_ms_sum()
+            split0 = seam_split()
             advertised: set[tuple[str, int]] = set()
             t0 = loop.time()
             for _ in range(churn_events):
@@ -744,7 +761,10 @@ def measure_flood(
                 await asyncio.sleep(0.05)
             elapsed = loop.time() - t0
             churn = {k: csum(k) - base[k] for k in _FLOOD_COUNTERS}
-            seam_ms = seam_ms_sum() - seam0
+            split1 = seam_split()
+            seam_enc = split1["enc"] - split0["enc"]
+            seam_dec = split1["dec"] - split0["dec"]
+            seam_ms = seam_enc + seam_dec
             _stage(f"churn drained ({elapsed:.1f}s)")
 
             # stage 2: link flaps → trace-derived convergence latency
@@ -799,6 +819,14 @@ def measure_flood(
 
             floods = churn["kvstore.floods_sent"]
             tarr = np.array(trace_ms) if trace_ms else np.array([0.0])
+            trace_stats = None
+            if trace_every > 0:
+                # completed hop-span traces cluster-wide: completions,
+                # deepest path, waterfall-vs-total agreement, and the
+                # per-stage attribution the BENCH row carries
+                from openr_tpu.emulator import tracing
+
+                trace_stats = tracing.trace_report(c)
             return {
                 "codec": codec,
                 "nodes": len(c.nodes),
@@ -819,6 +847,23 @@ def measure_flood(
                     floods / max(seam_ms / 1e3, 1e-9), 1
                 ),
                 "wire_seam_ms": round(seam_ms, 1),
+                "wire_seam_encode_ms": round(seam_enc, 1),
+                "wire_seam_decode_ms": round(seam_dec, 1),
+                # codec efficiency, robust to coalescing batch shape:
+                # µs/flood conflates batch size with codec cost (bigger
+                # batches = fewer, fatter frames), ns/byte does not
+                "seam_ns_per_byte": round(
+                    seam_ms * 1e6
+                    / max(churn["kvstore.flood_bytes"], 1),
+                    2,
+                ),
+                # flood tracing's DIRECT wire footprint: packed span
+                # bytes shipped as a fraction of all flood bytes
+                "span_byte_share": round(
+                    churn["kvstore.flood_span_bytes"]
+                    / max(churn["kvstore.flood_bytes"], 1),
+                    5,
+                ),
                 "floods_per_sec_wall": round(floods / elapsed, 1),
                 "flood_bytes": churn["kvstore.flood_bytes"],
                 "bytes_per_flood": round(
@@ -843,6 +888,15 @@ def measure_flood(
                     "probe_miss": ae["kvstore.full_sync_probe_miss"],
                     "keys_sent": ae["kvstore.full_sync_keys_sent"],
                 },
+                "trace_every": trace_every,
+                "flood_traces": trace_stats,
+                # per-stage p50 breakdown from hop spans (alongside
+                # convergence_p50_ms, per the observability plan)
+                "convergence_attribution": (
+                    trace_stats["attribution"].get("stages_p50_ms")
+                    if trace_stats is not None
+                    else None
+                ),
                 "invariants": "ok",
             }
         finally:
@@ -937,6 +991,25 @@ def main() -> None:
         "the slow BASELINE abort the comparison",
     )
     ap.add_argument(
+        "--flood-trace", action="store_true",
+        help="run the flood workload in interleaved traced/untraced "
+        "pairs on the binary codec (--flood-trace-every sampling, "
+        "--flood-repeats pairs) and report completed cross-node "
+        "traces, the named-stage waterfall/attribution, and tracing's "
+        "isolated wire cost (span byte share + seam ns/byte ratio). "
+        "With --smoke, exits 1 unless sampled traces complete "
+        "end-to-end across >=3 hops, waterfalls attribute >=95%% of "
+        "each span's total, and both overhead estimators stay <5%% "
+        "(docs/Monitor.md 'Flood tracing')",
+    )
+    ap.add_argument(
+        "--flood-trace-every", type=int, default=8,
+        help="head-sampling period for the traced --flood-trace run "
+        "(every Nth origination per node, seeded; the ci lane passes "
+        "16 — sparser sampling trades span count for a wider margin "
+        "under the 5%% overhead gate)",
+    )
+    ap.add_argument(
         "--flood-repeats", type=int, default=1,
         help="interleaved json/bin measurement rounds; each reported "
         "comparison scalar is the per-metric median across rounds "
@@ -959,6 +1032,144 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.flood_trace:
+        kw = dict(
+            side=args.flood_side,
+            churn_events=args.flood_events,
+            flap_rounds=args.flood_flaps,
+            timeout_s=args.flood_timeout,
+        )
+        # interleaved (baseline, traced) pairs — the PR 8 lesson: this
+        # host class drifts between adjacent runs, and the workload
+        # itself is timing-coupled (coalescing batch shapes shift run
+        # to run), so single-pair comparisons swing tens of percent.
+        # Tracing overhead is therefore measured by two estimators
+        # that ISOLATE the tracing cost instead of the batch shape:
+        #   * span_byte_share — packed span bytes as a fraction of all
+        #     flood bytes (the direct wire footprint; counter-derived);
+        #   * seam ns/byte ratio, min-per-arm — codec efficiency per
+        #     byte (the seam stat is pure CPU, so contention and
+        #     unlucky draws only ever add time; µs-per-FLOOD is
+        #     reported but NOT gated: span bookkeeping slows relays a
+        #     hair, the pump then coalesces MORE keys per frame, and
+        #     per-flood time rises while per-byte cost falls — a batch
+        #     shape change, not a tracing cost).
+        pairs = max(1, args.flood_repeats)
+        runs_b: list[dict] = []
+        runs_t: list[dict] = []
+        for _ in range(pairs):
+            runs_b.append(measure_flood("bin", **kw))
+            runs_t.append(
+                measure_flood(
+                    "bin",
+                    trace_every=max(1, args.flood_trace_every),
+                    **kw,
+                )
+            )
+
+        def seam_us_per_flood(r: dict) -> float:
+            return r["wire_seam_ms"] * 1e3 / max(r["floods_sent"], 1)
+
+        base_nsb = min(r["seam_ns_per_byte"] for r in runs_b)
+        traced_nsb = min(r["seam_ns_per_byte"] for r in runs_t)
+        per_byte_pct = round((traced_nsb / base_nsb - 1.0) * 100, 2)
+        span_shares = [
+            round(r["span_byte_share"] * 100, 2) for r in runs_t
+        ]
+        span_share_pct = max(span_shares)
+        # headline: the larger of the two isolated costs (per-byte
+        # processing degradation, added span bytes)
+        overhead_pct = max(per_byte_pct, span_share_pct)
+        reports = [r["flood_traces"] or {} for r in runs_t]
+        attrs = [ts.get("attribution") or {} for ts in reports]
+        traced = runs_t[-1]
+        detail = {
+            "pairs": pairs,
+            "baseline": runs_b[-1],
+            "traced": traced,
+            "seam_per_byte_overhead_pct": per_byte_pct,
+            "span_byte_share_pct": span_share_pct,
+            "span_byte_share_runs_pct": span_shares,
+            "seam_ns_per_byte_baseline_runs": [
+                r["seam_ns_per_byte"] for r in runs_b
+            ],
+            "seam_ns_per_byte_traced_runs": [
+                r["seam_ns_per_byte"] for r in runs_t
+            ],
+            "seam_us_per_flood_baseline_runs": [
+                round(seam_us_per_flood(r), 2) for r in runs_b
+            ],
+            "seam_us_per_flood_traced_runs": [
+                round(seam_us_per_flood(r), 2) for r in runs_t
+            ],
+            "trace_every": traced["trace_every"],
+            # quality gates aggregate conservatively across traced
+            # runs: completions/hops must be reached in EVERY run is
+            # too strict for a smoke (draws differ) — best-of for
+            # reach, worst-of for correctness fractions
+            "completions": max(
+                (ts.get("completions", 0) for ts in reports), default=0
+            ),
+            "max_hops": max(
+                (ts.get("max_hops", 0) for ts in reports), default=0
+            ),
+            "waterfall_ok_frac": min(
+                (ts.get("waterfall_ok_frac") or 0 for ts in reports),
+                default=0,
+            ),
+            "attribution_coverage_p50": min(
+                (a.get("coverage_p50") or 0 for a in attrs), default=0
+            ),
+            "convergence_attribution": traced.get(
+                "convergence_attribution"
+            ),
+            "overhead_pct": overhead_pct,
+        }
+        print(
+            json.dumps(
+                {
+                    "metric": "flood_trace_overhead_pct",
+                    "value": overhead_pct,
+                    "unit": "%",
+                    "vs_baseline": None,
+                    "detail": detail,
+                }
+            )
+        )
+        if args.smoke:
+            checks = {
+                # traces actually flowed and completed cluster-wide
+                "traces completed (>=50)": detail["completions"] >= 50,
+                # at least one span crossed >=3 flooding hops end-to-end
+                ">=3-hop trace completed": detail["max_hops"] >= 3,
+                # named stages telescope to the span total: every
+                # waterfall within 5% of its trace's total_ms, p50
+                # coverage >=95% (the acceptance's attribution bar) —
+                # in EVERY traced run
+                "waterfalls match totals": (
+                    detail["waterfall_ok_frac"] >= 0.95
+                    and detail["attribution_coverage_p50"] >= 0.95
+                ),
+                # sampled tracing's isolated wire cost <5%: per-byte
+                # codec efficiency must not degrade AND the packed
+                # spans' direct byte footprint must stay small
+                "tracing overhead <5%": (
+                    per_byte_pct < 5.0 and span_share_pct < 5.0
+                ),
+                "invariants clean": all(
+                    r["invariants"] == "ok" for r in (*runs_b, *runs_t)
+                ),
+            }
+            failed = [name for name, ok in checks.items() if not ok]
+            if failed:
+                print(
+                    f"flood-trace smoke FAILED: {'; '.join(failed)} — "
+                    f"detail: {json.dumps(detail)}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        return
 
     if args.flood_bench:
         kw = dict(

@@ -91,6 +91,22 @@ JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --flood-bench --flood-side 4 --flood-events 120 --flood-flaps 2 \
     --smoke --backend cpu
 
+echo "== flood-trace smoke (hop-span waterfall + overhead gate) =="
+# the cluster observability gate (docs/Monitor.md "Flood tracing"): on
+# a small emulated grid, sampled cross-node flood traces must complete
+# end-to-end across >= 3 hops, every completed span's named-stage
+# waterfall must telescope to its total (>= 95% attributed), and
+# sampled tracing's isolated wire cost must stay < 5%: span bytes as
+# a share of flood bytes, AND wire-seam ns-per-byte vs the untraced
+# binary baseline (1-in-16 sampling, 2 interleaved pairs, per-arm MIN
+# — the pure-CPU seam measure only ever gains time from contention;
+# per-FLOOD time is reported but conflates coalescing batch shape
+# with codec cost, so it is not the gate)
+JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
+    --flood-trace --flood-trace-every 16 --flood-repeats 2 \
+    --flood-side 4 --flood-events 120 --flood-flaps 1 \
+    --smoke --backend cpu
+
 echo "== serde micro-bench (encode/decode ns per Publication) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_serde.py --iters 500
 

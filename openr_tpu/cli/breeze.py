@@ -876,14 +876,17 @@ def prefixmgr_withdraw(ctx, prefixes):
 # ----------------------------------------------------------------------- perf
 
 
-@cli.command()
+@cli.group(invoke_without_command=True)
 @click.option("--limit", default=10, show_default=True, type=int,
               help="most recent traces to render")
 @click.pass_context
 def perf(ctx, limit):
     """Recent convergence traces with per-stage deltas (reference:
     breeze perf †): every trace is one update's walk spark → kvstore →
-    decision → fib, markers stamped at each stage."""
+    decision → fib, markers stamped at each stage. Subcommand
+    `waterfall` renders sampled cross-node flood spans instead."""
+    if ctx.invoked_subcommand is not None:
+        return
     res = _run(ctx, "get_perf_events", {"limit": limit})
     traces = res["traces"]
     if not traces:
@@ -900,6 +903,116 @@ def perf(ctx, limit):
         ]
         click.echo(_table(rows, ["stage", "node", "delta-ms"]))
         click.echo("")
+
+
+def _scrape_endpoints(ctx, endpoints: str, method: str, params: dict):
+    """Call one ctrl method on every endpoint ("host:port,host:port";
+    empty = just the root --host/--port). Returns {endpoint: result};
+    unreachable endpoints are reported and skipped, so one dead node
+    never blanks a fleet view."""
+    eps: list[tuple[str, int]] = []
+    if endpoints:
+        for raw in endpoints.split(","):
+            host, _, port = raw.strip().rpartition(":")
+            if not port.isdigit():
+                raise click.ClickException(
+                    f"bad endpoint {raw.strip()!r}: expected host:port"
+                )
+            eps.append((host or ctx.obj["host"], int(port)))
+    else:
+        eps.append((ctx.obj["host"], ctx.obj["port"]))
+
+    async def one(host: str, port: int):
+        cli_ = RpcClient(host=host, port=port, ssl=ctx.obj.get("ssl"))
+        await cli_.connect(timeout=ctx.obj["timeout"])
+        try:
+            return await cli_.call(
+                method, params, timeout=ctx.obj["timeout"]
+            )
+        finally:
+            await cli_.close()
+
+    async def go():
+        results = await asyncio.gather(
+            *(one(h, p) for h, p in eps), return_exceptions=True
+        )
+        out = {}
+        for (h, p), res in zip(eps, results):
+            if isinstance(res, BaseException):
+                click.echo(f"# {h}:{p} unreachable: {res}", err=True)
+            else:
+                out[f"{h}:{p}"] = res
+        return out
+
+    return asyncio.run(go())
+
+
+@perf.command("waterfall")
+@click.option("--limit", default=3, show_default=True, type=int,
+              help="most recent flood traces (by id) to render")
+@click.option("--endpoints", default="",
+              help="comma-separated host:port ctrl endpoints to scrape "
+              "and assemble cluster-wide (default: just this node)")
+@click.pass_context
+def perf_waterfall(ctx, limit, endpoints):
+    """Sampled cross-node flood spans as propagation trees + named-stage
+    waterfalls (docs/Monitor.md "Flood tracing"): each trace is one
+    sampled origination's walk across the flooding mesh, every hop
+    attributed (kvstore / encode / wire / decision / fib)."""
+    from openr_tpu.monitor import flood_trace
+
+    per_node = _scrape_endpoints(
+        ctx, endpoints, "get_flood_traces", {"limit": 200}
+    )
+    traces = [t for res in per_node.values() for t in res["traces"]]
+    if not traces:
+        click.echo("no completed flood traces yet "
+                   "(is kvstore.trace_sample_every set?)")
+        return
+    trees = flood_trace.propagation_tree(traces)
+    by_id: dict[int, list[dict]] = {}
+    for t in traces:
+        by_id.setdefault(t["trace_id"], []).append(t)
+    # deepest / widest propagation first — a 0-hop local span is the
+    # least interesting thing a cluster-wide waterfall can show
+    ranked = sorted(
+        trees,
+        key=lambda tid: (
+            trees[tid]["max_hops"], trees[tid]["completions"]
+        ),
+        reverse=True,
+    )
+    for tid in ranked[:limit]:
+        tree = trees[tid]
+        click.echo(
+            f"trace {tid:x}  origin {tree['origin']}  "
+            f"{tree['completions']} completions  "
+            f"max {tree['max_hops']} hops"
+        )
+        for parent, child in tree["edges"]:
+            click.echo(f"  {parent} -> {child}")
+        # deepest completion's waterfall: the full-path breakdown
+        falls = [
+            w
+            for w in (
+                t.get("waterfall") or flood_trace.waterfall(t)
+                for t in by_id[tid]
+            )
+            if w is not None
+        ]
+        if not falls:
+            continue
+        deep = max(falls, key=lambda w: w["hops"])
+        rows = [
+            [s["stage"], s["node"], f"+{s['ms']:.3f}"]
+            for s in deep["stages"]
+        ]
+        click.echo(_table(rows, ["stage", "node", "delta-ms"]))
+        click.echo(
+            f"  total {deep['total_ms']:.3f} ms, attributed "
+            f"{deep['attributed_ms']:.3f} ms "
+            f"(coverage {deep['coverage'] * 100:.1f}%)\n"
+        )
 
 
 # -------------------------------------------------------------------- monitor
@@ -981,6 +1094,71 @@ def monitor_prometheus(ctx):
     latency percentiles — what a /metrics scrape would return."""
     res = _run(ctx, "get_counters_prometheus")
     click.echo(res["text"], nl=False)
+
+
+@monitor.command("fleet")
+@click.option("--endpoints", default="",
+              help="comma-separated host:port ctrl endpoints to scrape "
+              "(default: just this node — a 1-node fleet)")
+@click.option("--prefix", default="", help="counter name prefix filter")
+@click.option("--top", default=0, type=int,
+              help="cap the table at N rows (0 = all)")
+@click.pass_context
+def monitor_fleet(ctx, endpoints, prefix, top):
+    """Cluster-wide counter distributions (docs/Monitor.md "Fleet
+    aggregation"): scrape every endpoint's counters and render per-key
+    cross-node min/p50/p99/max with the arg-max node — queue depths,
+    flood fan-out, rebuild and FIB-program latencies as fleet
+    percentiles instead of N separate dashboards."""
+    from openr_tpu.monitor.fleet import (
+        FLEET_HEADERS,
+        aggregate_counters,
+        fleet_rows,
+    )
+
+    per_node = _scrape_endpoints(
+        ctx, endpoints, "get_counters", {"prefix": prefix}
+    )
+    if not per_node:
+        raise click.ClickException("no endpoint reachable")
+    agg = aggregate_counters(per_node, prefix=prefix)
+    rows = fleet_rows(agg, limit=top)
+    if not rows:
+        click.echo("no counters matched")
+        return
+    click.echo(f"# {len(per_node)} node(s) scraped")
+    click.echo(_table(rows, FLEET_HEADERS))
+
+
+@monitor.command("flight")
+@click.option("--limit", default=50, show_default=True, type=int)
+@click.option("--kind", default=None, help="filter by event kind")
+@click.pass_context
+def monitor_flight(ctx, limit, kind):
+    """This node's flight-recorder ring (docs/Monitor.md): the recent
+    structured events — rebuild dispatches, flood fan-outs, queue
+    highwater crossings, backoff saturations, peer transitions — that a
+    post-mortem reads; dumped automatically on emulator invariant
+    failures."""
+    import datetime
+
+    res = _run(ctx, "get_flight_recorder", {"limit": limit})
+    events = res.get("events") or []
+    if kind:
+        events = [e for e in events if e["kind"] == kind]
+    if not events:
+        click.echo("flight recorder empty")
+        return
+    click.echo(
+        f"# node {res['node']}: {res.get('recorded', 0)} recorded, "
+        f"showing {len(events)}"
+    )
+    for e in events:
+        ts = datetime.datetime.fromtimestamp(e["ts"]).strftime("%H:%M:%S.%f")[:-3]
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted(e.get("attrs", {}).items())
+        )
+        click.echo(f"{ts}  {e['kind']:<26} {attrs}")
 
 
 @monitor.command("logs")

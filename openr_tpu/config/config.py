@@ -61,6 +61,21 @@ class KvstoreConfig:
     # grace before declaring KVSTORE_SYNCED with zero peers (covers the
     # window before LinkMonitor delivers the first PeerEvent)
     initial_sync_grace_s: float = 2.0
+    # cross-node flood tracing (docs/Monitor.md "Flood tracing"):
+    # deterministic head-sampling — every Nth ACCEPTED local origination
+    # carries a per-hop flood span cluster-wide. 0 disables tracing
+    # (the default: span stamps cost wire bytes on every sampled hop).
+    # The sampling phase is derived from (node_name, trace_seed) so a
+    # seeded emulation replays the same sampled set while different
+    # nodes stay decorrelated. Affordability guidance: a coalesced
+    # flood batch is traced when ANY merged origination was sampled
+    # (per-frame taint ≈ 1-(1-1/N)^batch), so size N with the CLUSTER
+    # — a few × node count under heavy churn keeps the wire overhead
+    # in low single digits (measured: docs/Monitor.md, BENCH_TRACE);
+    # each sampled origination still completes a span on every node
+    # it reaches, so trace volume stays ample.
+    trace_sample_every: int = 0
+    trace_seed: int = 0
 
 
 @dataclass

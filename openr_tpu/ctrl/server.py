@@ -143,6 +143,7 @@ class CtrlServer(OpenrModule):
             "advertise_prefixes", "withdraw_prefixes", "get_advertised_prefixes",
             "set_rib_policy", "get_rib_policy", "get_event_logs",
             "get_perf_events", "get_counters_prometheus",
+            "get_flood_traces", "get_flight_recorder",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -191,6 +192,39 @@ class CtrlServer(OpenrModule):
                 pe.to_jsonable()
                 for pe in self.node.monitor.recent_perf(limit)
             ],
+        }
+
+    async def get_flood_traces(self, params: dict) -> dict:
+        """Completed sampled flood spans from this node's Monitor ring,
+        each with its server-computed named-stage waterfall — the
+        per-node slice `breeze perf waterfall` (and any cluster-wide
+        collector) assembles into propagation trees
+        (docs/Monitor.md "Flood tracing")."""
+        from openr_tpu.monitor import flood_trace
+
+        limit = int(params.get("limit") or 50)
+        traces = []
+        for pe in self.node.monitor.recent_flood_traces(limit):
+            tr = pe.to_jsonable()
+            tr["waterfall"] = flood_trace.waterfall(tr)
+            traces.append(tr)
+        return {"node": self.node.name, "traces": traces}
+
+    async def get_flight_recorder(self, params: dict) -> dict:
+        """This node's flight-recorder ring (monitor/flight.py), newest
+        `limit` events — the on-demand counterpart of the automatic
+        invariant-failure dump (docs/Emulator.md)."""
+        fr = getattr(self.node, "flight", None)
+        if fr is None:
+            return {"node": self.node.name, "events": [], "recorded": 0}
+        limit = params.get("limit")
+        return {
+            "node": self.node.name,
+            "recorded": fr.recorded,
+            "capacity": fr.capacity,
+            "events": fr.dump(
+                limit=int(limit) if limit is not None else None
+            ),
         }
 
     async def get_counters_prometheus(self, params: dict) -> dict:

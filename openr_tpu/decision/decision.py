@@ -321,6 +321,7 @@ class Decision(OpenrModule):
                 kernel_impl=dcfg.spf_kernel,
                 native_rib=dcfg.native_rib,
                 mesh=mesh,
+                counters=counters,
             )
         self.debounce = AsyncDebounce(
             dcfg.debounce_min_ms, dcfg.debounce_max_ms, self._rebuild_routes,
@@ -1213,6 +1214,12 @@ class Decision(OpenrModule):
             if self._warm_idle_rounds == _WARM_IDLE_TRIM:
                 self.trim_warm_state()
         if self.counters:
+            self.counters.flight_record(
+                "decision.rebuild",
+                path=path or "full",
+                ms=round(self._last_spf_ms, 3),
+                traces=len(traces),
+            )
             self.counters.increment("decision.spf_runs")
             if path == "prefix_only":
                 self.counters.increment("decision.rebuild.prefix_only")

@@ -200,9 +200,14 @@ class TpuSpfSolver:
         kernel_impl: str = "split",
         native_rib: str = "auto",
         mesh=None,
+        counters=None,
     ):
         self.use_dense = use_dense
         self.dense_waste_limit = dense_waste_limit
+        # optional per-node Counters registry: annotated solver phases
+        # then record wall durations into `profile.<span>_ms` stats
+        # (monitor/profiling.py) alongside the xprof timeline rows
+        self.counters = counters
         if use_pallas:
             # fail at construction, not mid-solve: the Pallas kernel is
             # interpreter-only on current hardware (ops/spf_pallas.py
@@ -731,7 +736,7 @@ class TpuSpfSolver:
             # ops.spf_split.batched_sssp_split_rib)
             vp = dev["vp"]
             gs = self._pick_gs_and_count(dev)
-            with profiling.annotate("spf:batched_solve"):
+            with profiling.annotate("spf:batched_solve", counters=self.counters):
                 dist_dev, packed = batched_sssp_split_rib(
                     dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
                     dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"],
@@ -747,7 +752,7 @@ class TpuSpfSolver:
             d_root, fh, lfa = unpack_rib_buffer(buf, vp, b, self.enable_lfa)
             return csr, _LazyDist(dist_dev, d_root), fh, nbr_ids, lfa
 
-        with profiling.annotate("spf:batched_solve"):
+        with profiling.annotate("spf:batched_solve", counters=self.counters):
             dist = self._solve_dist(
                 csr, roots, _dispatched=(table, dev, has_over)
             )
@@ -815,7 +820,7 @@ class TpuSpfSolver:
         solved = self.solve(ls, my_node)
         if solved is None:
             return (rdb, None) if return_artifact else rdb
-        with profiling.annotate("spf:rib_assembly"):
+        with profiling.annotate("spf:rib_assembly", counters=self.counters):
             rdb = self._assemble_routes(rdb, ls, ps, my_node, solved)
         if return_artifact:
             return rdb, SolveArtifact(
@@ -1066,7 +1071,7 @@ class TpuSpfSolver:
                         jnp.asarray(cols[off : off + top]),
                     ].set(INF_DIST)
             gs = pick_gs_chunks(vp)
-            with profiling.annotate("spf:warm_solve"):
+            with profiling.annotate("spf:warm_solve", counters=self.counters):
                 dist_dev2, packed = batched_sssp_split_warm_rib(
                     dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
                     dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"],

@@ -24,5 +24,10 @@ from openr_tpu.emulator.invariants import (  # noqa: F401
     Violation,
     assert_invariants,
     check_cluster,
+    dump_flight_recorders,
     wait_quiescent,
+)
+from openr_tpu.emulator.tracing import (  # noqa: F401
+    collect_flood_traces,
+    trace_report,
 )

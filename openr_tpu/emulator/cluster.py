@@ -285,6 +285,21 @@ class Cluster:
                 raise TimeoutError(f"cluster did not converge: {detail}")
             await asyncio.sleep(0.02)
 
+    def fleet_counters(self, prefix: str = "") -> dict:
+        """Cluster-wide counter distributions (docs/Monitor.md "Fleet
+        aggregation"): every live node's Counters snapshot folded into
+        per-key cross-node min/p50/p99/max — the emulator-side twin of
+        ``breeze monitor fleet``."""
+        from openr_tpu.monitor.fleet import aggregate_counters
+
+        return aggregate_counters(
+            {
+                name: node.counters.snapshot()
+                for name, node in self.nodes.items()
+            },
+            prefix=prefix,
+        )
+
     # -------------------------------------------------------------- control
 
     def _links_between(self, a: str, b: str) -> list[LinkSpec]:

@@ -12,25 +12,35 @@ field; it runs on the CPU oracle backend and never touches jax.
 from __future__ import annotations
 
 import asyncio
+from dataclasses import replace
 
 from openr_tpu.emulator.cluster import Cluster
-from openr_tpu.monitor import perf
+from openr_tpu.monitor import flood_trace, perf
+from openr_tpu.monitor.fleet import percentile as _percentile
 
 
-def _percentile(vals: list[float], q: float) -> float:
-    vals = sorted(vals)
-    return vals[min(len(vals) - 1, int(len(vals) * q))]
+def _trace_every_1(ncfg):
+    """Sample EVERY origination: the bench cluster is 4 nodes, so full
+    tracing is cheap and every link-down's adjacency re-advertisement
+    carries a hop span — the attribution source."""
+    return replace(
+        ncfg, kvstore=replace(ncfg.kvstore, trace_sample_every=1)
+    )
 
 
 async def collect_convergence_traces(
     trials: int = 3, timeout_s: float = 20.0
-) -> list:
+) -> tuple[list, list[dict]]:
     """Run `trials` link-down events on a 4-node cluster; return every
-    completed PerfEvents trace (ending FIB_PROGRAMMED) they produced."""
+    completed PerfEvents trace (ending FIB_PROGRAMMED) they produced,
+    plus the completed cross-node flood spans (jsonable dicts) for the
+    per-stage attribution."""
     # triangle + stub: failing a-b leaves both endpoints reachable, so
     # every link-down yields route CHANGES (reroute via c) on live nodes
     c = Cluster.from_edges(
-        [("a", "b"), ("b", "c"), ("a", "c"), ("c", "d")], solver="cpu"
+        [("a", "b"), ("b", "c"), ("a", "c"), ("c", "d")],
+        solver="cpu",
+        node_config_transform=_trace_every_1,
     )
     await c.start()
     traces: list = []
@@ -51,13 +61,19 @@ async def collect_convergence_traces(
             await c.wait_converged(timeout=timeout_s)
             # let the heal's own traces land before the next baseline
             await asyncio.sleep(0.3)
+        from openr_tpu.emulator.tracing import collect_flood_traces
+
+        flood = collect_flood_traces(c)
     finally:
         await c.stop()
-    return [
-        t
-        for t in traces
-        if t.last_event() == perf.FIB_PROGRAMMED and len(t.events) >= 5
-    ]
+    return (
+        [
+            t
+            for t in traces
+            if t.last_event() == perf.FIB_PROGRAMMED and len(t.events) >= 5
+        ],
+        flood,
+    )
 
 
 def _trace_count(node) -> int:
@@ -91,10 +107,12 @@ async def _wait_new_traces(
 
 def measure_convergence(trials: int = 3, timeout_s: float = 20.0) -> dict:
     """Synchronous wrapper for bench harnesses: p50/p99 of trace-derived
-    link-down convergence plus sample counts. Returns convergence_p50_ms
+    link-down convergence plus sample counts, and the hop-span-derived
+    `convergence_attribution` (per-stage p50 across the sampled flood
+    spans — docs/Monitor.md "Flood tracing"). Returns convergence_p50_ms
     None only when no trace completed (reported, never raised)."""
     try:
-        traces = asyncio.run(
+        traces, flood = asyncio.run(
             collect_convergence_traces(trials=trials, timeout_s=timeout_s)
         )
     except Exception as e:  # noqa: BLE001 — a bench must not die on this
@@ -102,6 +120,7 @@ def measure_convergence(trials: int = 3, timeout_s: float = 20.0) -> dict:
     if not traces:
         return {"convergence_p50_ms": None, "traces": 0}
     totals = [t.total_ms() for t in traces]
+    attr = flood_trace.attribution(flood)
     return {
         "convergence_p50_ms": round(_percentile(totals, 0.5), 3),
         "convergence_p99_ms": round(_percentile(totals, 0.99), 3),
@@ -111,6 +130,11 @@ def measure_convergence(trials: int = 3, timeout_s: float = 20.0) -> dict:
             ev: round(v, 3)
             for ev, v in _stage_p50(traces).items()
         },
+        # named-stage breakdown from the hop spans: where along the
+        # flooding mesh + pipeline the end-to-end time actually went
+        "convergence_attribution": attr.get("stages_p50_ms"),
+        "attribution_coverage_p50": attr.get("coverage_p50"),
+        "flood_traces": attr.get("traces", 0),
     }
 
 

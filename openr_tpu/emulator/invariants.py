@@ -30,6 +30,10 @@ hint — a failing soak always prints the seed needed to reproduce it.
 from __future__ import annotations
 
 import asyncio
+import json
+import os
+import tempfile
+import time
 from dataclasses import dataclass
 
 from openr_tpu.decision.decision import merge_area_ribs
@@ -324,6 +328,60 @@ def check_queue_bounds(cluster) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------- flight-recorder dumps
+
+
+def dump_flight_recorders(
+    cluster, violations=None, label: str = "invariant-failure"
+) -> str | None:
+    """Write every involved node's flight-recorder ring (plus its raw
+    counter snapshot) as one JSON file per node under a fresh dump
+    directory, and return that directory — the post-mortem artifact a
+    failing soak attaches next to its replay seed (docs/Emulator.md).
+
+    "Involved" = the nodes the violations name; violations that name no
+    node (cluster-wide checks) widen the dump to every live node. Nodes
+    without a recorder (bare clusters built outside OpenrNode) are
+    skipped; returns None when nothing was dumpable."""
+    names = sorted({v.node for v in (violations or []) if v.node})
+    if not names or any(v.node is None for v in (violations or [])):
+        names = sorted(cluster.nodes)
+    targets = [
+        (n, cluster.nodes[n])
+        for n in names
+        if n in cluster.nodes
+        and getattr(cluster.nodes[n], "flight", None) is not None
+    ]
+    if not targets:
+        return None
+    dump_dir = tempfile.mkdtemp(prefix="openr-flight-")
+    for name, node in targets:
+        payload = {
+            "node": name,
+            "label": label,
+            "wrote_at": time.time(),  # orlint: disable=OR006 — post-mortem artifact metadata, not a seeded decision
+            "violations": [
+                str(v) for v in (violations or []) if v.node in (name, None)
+            ],
+            "events": node.flight.dump(),
+            # raw counters only (no expanded stat percentiles — they
+            # triple the file for no post-mortem value)
+            "counters": dict(node.counters.counters),
+        }
+        path = os.path.join(dump_dir, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+    return dump_dir
+
+
+def _flight_hint(cluster, violations, label: str) -> str:
+    try:
+        d = dump_flight_recorders(cluster, violations, label=label)
+    except Exception:  # noqa: BLE001 — the dump must never mask the failure
+        return ""
+    return f"\nflight-recorder dumps: {d}" if d else ""
+
+
 # -------------------------------------------------------------- entry points
 
 
@@ -346,9 +404,10 @@ def assert_invariants(cluster, context: str = "") -> None:
     if violations:
         hint = f" (replay: {context})" if context else ""
         lines = "\n  ".join(str(v) for v in violations)
+        flight = _flight_hint(cluster, violations, label=context or "assert")
         raise AssertionError(
             f"{len(violations)} cluster invariant violation(s){hint}:\n"
-            f"  {lines}"
+            f"  {lines}{flight}"
         )
 
 
@@ -384,8 +443,11 @@ async def wait_quiescent(
         if loop.time() >= deadline:
             hint = f" (replay: {context})" if context else ""
             lines = "\n  ".join(str(v) for v in last[:8])
+            flight = _flight_hint(
+                cluster, last, label=context or "quiesce-timeout"
+            )
             raise AssertionError(
                 f"cluster failed to quiesce within {timeout_s:.0f}s"
-                f"{hint}; last violations:\n  {lines}"
+                f"{hint}; last violations:\n  {lines}{flight}"
             )
         await asyncio.sleep(poll_s)

@@ -1,0 +1,58 @@
+"""Cluster-side flood-trace collector for the in-process emulator.
+
+Walks every node's Monitor perf ring for completed *sampled* flood
+traces (``PerfEvents.trace_id`` set, span ends at FIB_PROGRAMMED) and
+feeds them to the pure assembly math in
+``openr_tpu/monitor/flood_trace.py`` — waterfalls, propagation trees,
+and the per-stage ``convergence_attribution`` the benchmarks report.
+
+The emulator shares one process (one monotonic clock), so cross-node
+stage deltas here are exact — this is the regime the waterfall's
+attribution acceptance (≥95% of end-to-end time named) is defined in.
+"""
+
+from __future__ import annotations
+
+from openr_tpu.monitor import flood_trace, perf
+
+
+def collect_flood_traces(cluster) -> list[dict]:
+    """Every completed sampled flood span across the cluster, as the
+    jsonable trace dicts the assembly math consumes (one entry per
+    completing node per trace — a 9-node flood yields up to 9 spans of
+    one trace_id)."""
+    out: list[dict] = []
+    for node in cluster.nodes.values():
+        for tr in node.monitor.perf_traces:
+            if (
+                getattr(tr, "trace_id", 0)
+                and tr.last_event() == perf.FIB_PROGRAMMED
+            ):
+                out.append(tr.to_jsonable())
+    return out
+
+
+def trace_report(cluster) -> dict:
+    """One-call summary for benches and CI gates: completions, deepest
+    path, per-stage p50 attribution, and waterfall-vs-total agreement.
+
+    ``waterfall_ok`` counts spans whose named stages sum to within 5%
+    of the span's end-to-end total — the "no silent gap" check the
+    flood-trace smoke lane asserts on."""
+    traces = collect_flood_traces(cluster)
+    attr = flood_trace.attribution(traces)
+    falls = [
+        w for w in (flood_trace.waterfall(t) for t in traces)
+        if w is not None
+    ]
+    ok = sum(1 for w in falls if abs(1.0 - w["coverage"]) <= 0.05)
+    multi_hop = sum(1 for w in falls if w["hops"] >= 1)
+    return {
+        "completions": len(falls),
+        "multi_hop_completions": multi_hop,
+        "max_hops": max((w["hops"] for w in falls), default=0),
+        "waterfall_ok": ok,
+        "waterfall_ok_frac": round(ok / len(falls), 4) if falls else None,
+        "trees": len(flood_trace.propagation_tree(traces)),
+        "attribution": attr,
+    }

@@ -378,6 +378,12 @@ class Fib(OpenrModule):
                     self.counters.set(
                         "fib.program_fail_streak", self._fail_streak
                     )
+                    self.counters.flight_record(
+                        "fib.program_fail",
+                        streak=self._fail_streak,
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        backoff_ms=round(self.backoff.current_ms, 1),
+                    )
                 if (
                     self.backoff.current_ms >= self.config.node.fib.max_retry_ms
                     and not self._warned_backoff_saturated
@@ -386,6 +392,12 @@ class Fib(OpenrModule):
                     # the FibService is persistently failing, not just
                     # riding out transient retry noise
                     self._warned_backoff_saturated = True
+                    if self.counters:
+                        self.counters.flight_record(
+                            "fib.backoff_saturated",
+                            streak=self._fail_streak,
+                            ms=round(self.backoff.current_ms, 1),
+                        )
                     log.warning(
                         "%s: programming backoff saturated at %.0f ms "
                         "after %d consecutive failures — FibService looks "

@@ -10,6 +10,7 @@ receive every accepted update on the publications queue.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import time
 from dataclasses import dataclass, field
@@ -118,6 +119,21 @@ class KvStore(OpenrModule):
         }
         self.peers: dict[tuple[str, str], _Peer] = {}  # (area, node) -> peer
         self.initial_sync_done = asyncio.Event()
+        # flood tracing (docs/Monitor.md): deterministic head-sampling
+        # of local originations. The phase offset is a stable hash of
+        # (node, seed): every Nth accepted origination per node is
+        # sampled, decorrelated across nodes, reproducible per seed.
+        kcfg0 = config.node.kvstore
+        self._trace_origins = 0
+        self._trace_phase = 0
+        if kcfg0.trace_sample_every > 0:
+            h = hashlib.blake2b(
+                f"{self.node_name}:{kcfg0.trace_seed}:flood-trace".encode(),
+                digest_size=4,
+            )
+            self._trace_phase = int.from_bytes(h.digest(), "big") % (
+                kcfg0.trace_sample_every
+            )
         self.flood_topos: dict[str, "FloodTopo"] = {}
         if config.node.kvstore.enable_flood_optimization:
             from openr_tpu.kvstore.floodtopo import FloodTopo
@@ -202,6 +218,9 @@ class KvStore(OpenrModule):
         self.peers[key] = peer
         if self.counters is not None:
             self.counters.increment("kvstore.peers_added")
+            self.counters.flight_record(
+                "kvstore.peer_up", peer=spec.node_name, area=spec.area
+            )
         self._spawn_sync(peer)
 
     def _spawn_sync(self, peer: _Peer) -> None:
@@ -231,6 +250,9 @@ class KvStore(OpenrModule):
                 pass
         if self.counters is not None:
             self.counters.increment("kvstore.peers_removed")
+            self.counters.flight_record(
+                "kvstore.peer_down", peer=node_name, area=area
+            )
         ft = self.flood_topos.get(area)
         if ft is not None:
             ft.peer_down(node_name)
@@ -349,6 +371,16 @@ class KvStore(OpenrModule):
                         self.counters.increment("kvstore.peer_disconnects")
                 if self.counters is not None:
                     self.counters.increment("kvstore.full_sync_failures")
+                    self.counters.flight_record(
+                        "kvstore.sync_failed",
+                        peer=peer.spec.node_name,
+                        area=area,
+                        error=f"{type(e).__name__}: {e}"[:200],
+                        backoff_ms=round(peer.backoff.current_ms, 1),
+                        saturated=bool(
+                            peer.backoff.current_ms >= peer.backoff.max_ms
+                        ),
+                    )
 
     def _maybe_initial_sync_done(self) -> None:
         # true also for the peers-all-deleted case (vacuous all())
@@ -375,7 +407,28 @@ class KvStore(OpenrModule):
         accepted, _stale = db.merge(pub.key_vals)
         if accepted or pub.expired_keys:
             pe = pub.perf_events
-            if pe is not None:
+            relayed_span = False
+            if from_peer is None:
+                # local origination: deterministic head-sampling may
+                # begin a cross-node flood span here
+                pe = self._maybe_sample_trace(pe)
+            elif pe is not None and pe.trace_id:
+                # relayed sampled flood: append this node's hop span
+                relayed_span = True
+                if pe.stamp_hop_rx(self.node_name) and (
+                    self.counters is not None
+                ):
+                    self.counters.increment("kvstore.flood_hops")
+            if pe is not None and not relayed_span:
+                # stamped at the origin (and on every un-sampled trace,
+                # exactly as before) but SKIPPED at span-traced relays:
+                # there the hop span's rx stamp carries the same
+                # information ~4x cheaper on the wire (packed span vs
+                # one PerfEvent dataclass per hop) — the reason sampled
+                # tracing stays under the flood-bench's 5% overhead
+                # gate. The origin stamp keeps the per-trace stage
+                # tables (convergence stages_p50) comparable across
+                # sampled and un-sampled runs.
                 pe.add_perf_event(perf.KVSTORE_FLOODED, node=self.node_name)
             out = Publication(
                 area=area,
@@ -388,8 +441,57 @@ class KvStore(OpenrModule):
                 out.node_ids.append(self.node_name)
             if not self._publish(out):
                 return accepted  # stopping: merged, not notifiable
-            self._flood(area, out, exclude=from_peer)
+            flood_pub = out
+            if pe is not None:
+                lean = pe.wire_lean()
+                if lean is not pe:
+                    # span-traced pub with a fat marker list (e.g. a
+                    # sampled flap-wave adjacency advertisement whose
+                    # LinkMonitor debounce merged dozens of neighbor
+                    # events): the WIRE copy ships lean — without this
+                    # the serialize-once frame freezes the full merged
+                    # marker list and every relay re-ships it (measured
+                    # as the dominant tracing overhead at 64 nodes).
+                    # The LOCAL pipeline (out, already published) keeps
+                    # the full trace; missing fan-out stamps on it are
+                    # harmless — a terminal span's waterfall never
+                    # reads its own fan-out.
+                    flood_pub = Publication(
+                        area=area,
+                        key_vals=accepted,
+                        expired_keys=list(out.expired_keys),
+                        node_ids=list(out.node_ids),
+                        perf_events=lean,
+                    )
+            self._flood(area, flood_pub, exclude=from_peer)
         return accepted
+
+    def _maybe_sample_trace(self, pe):
+        """Head-sampling at origination (docs/Monitor.md flood tracing):
+        every Nth accepted LOCAL publication — seeded phase, so a
+        replayed emulation samples the identical set — becomes a
+        cross-node flood trace. A publication with no trace gets a
+        fresh one (prefix churn floods carry none); an existing trace
+        (adjacency updates born at Spark) is tagged in place."""
+        n = self.config.node.kvstore.trace_sample_every
+        if n <= 0:
+            return pe
+        self._trace_origins += 1
+        if (self._trace_origins + self._trace_phase) % n:
+            return pe
+        if pe is None:
+            pe = perf.PerfEvents()
+        if pe.trace_id == 0:
+            h = hashlib.blake2b(digest_size=8)
+            h.update(self.node_name.encode())
+            h.update(self._trace_origins.to_bytes(8, "big"))
+            pe.begin_flood_trace(
+                self.node_name,
+                trace_id=(int.from_bytes(h.digest(), "big") >> 1) | 1,
+            )
+            if self.counters is not None:
+                self.counters.increment("kvstore.flood_traces_sampled")
+        return pe
 
     def _publish(self, pub: Publication) -> bool:
         """Push to the local publication queue, tolerating the shutdown
@@ -431,6 +533,22 @@ class KvStore(OpenrModule):
             and pname not in pub.node_ids
             and (spt is None or pname in spt)
         ]
+        pe = pub.perf_events
+        if targets and pe is not None and pe.trace_id:
+            # stamp this node's hop span (enqueue + encode) BEFORE the
+            # serialize-once encode below, so the stamps freeze into
+            # the shared wire frame every peer ships
+            pe.stamp_hop_fanout(self.node_name)
+        if targets and self.counters is not None:
+            # flight recorder: fan-outs are the first thing a post-
+            # mortem of a wedged flood mesh wants to see
+            self.counters.flight_record(
+                "kvstore.flood_fanout",
+                area=area,
+                keys=len(pub.key_vals),
+                expired=len(pub.expired_keys),
+                peers=len(targets),
+            )
         if any(
             getattr(p.session, "codec", None) == "bin" for p in targets
         ):
@@ -526,6 +644,11 @@ class KvStore(OpenrModule):
                 self.counters.increment(
                     "kvstore.flood_backpressure_drops", len(peer.pending_keys)
                 )
+                self.counters.flight_record(
+                    "kvstore.flood_backpressure",
+                    peer=peer.spec.node_name,
+                    keys=len(peer.pending_keys),
+                )
             peer.pending_keys.clear()
             peer.pending_expired.clear()
             peer.pending_src = None
@@ -597,13 +720,21 @@ class KvStore(OpenrModule):
                 # node_ids carries only us: per-key provenance is lost
                 # when coalescing across publications, and understating
                 # node_ids is safe — a duplicate delivery is rejected by
-                # merge() and never re-flooded, so loops still terminate
+                # merge() and never re-flooded, so loops still terminate.
+                # A span-carrying merged trace ships WIRE-LEAN (origin
+                # markers only): the coalescing merge unions every
+                # batched trace's markers, and without the trim one
+                # sampled publication makes every deep relay frame
+                # carry ~_MERGE_CAP PerfEvent dataclasses (measured 3x
+                # wire-seam cost at 64 nodes; the hop span carries the
+                # per-hop record instead). `pe` itself stays fat for
+                # the session-death fold-back below.
                 pub = Publication(
                     area=peer.spec.area,
                     key_vals=kv,
                     expired_keys=sorted(exp),
                     node_ids=[self.node_name],
-                    perf_events=pe,
+                    perf_events=pe.wire_lean() if pe is not None else None,
                 )
             session = peer.session
             if session is None:
@@ -633,19 +764,34 @@ class KvStore(OpenrModule):
                         self.counters.increment(
                             "kvstore.flood_bytes", nbytes
                         )
+                    pe_sent = pub.perf_events
+                    if pe_sent is not None and pe_sent.span_bin:
+                        # flood tracing's direct wire footprint: the
+                        # packed span bytes this frame shipped — the
+                        # numerator of the bench's span_byte_share
+                        # overhead measure (docs/Monitor.md)
+                        self.counters.increment(
+                            "kvstore.flood_span_bytes",
+                            len(pe_sent.span_bin),
+                        )
                     self.counters.add_value(
                         "kvstore.flood_fanout_ms",
                         (asyncio.get_running_loop().time() - t0) * 1e3,
                     )
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001
+            except Exception as exc:  # noqa: BLE001
                 peer.flood_failures += 1
                 peer.synced = False
                 if self.counters is not None:
                     # per-peer flood_failures was previously invisible in
                     # the counter export — chaos soaks watch this pair
                     self.counters.increment("kvstore.flood_failures")
+                    self.counters.flight_record(
+                        "kvstore.flood_failed",
+                        peer=peer.spec.node_name,
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                    )
                 # drop the session only if it is still the one that
                 # failed: a concurrent sync may have already torn it
                 # down (counted there) or re-established a fresh one

@@ -116,6 +116,19 @@ class RQueue(Generic[T]):
             self.highwater = n
             if self.counters is not None:
                 self.counters.set(self._k_highwater, n)
+                if self.policy is not None and n * 2 >= self.maxsize:
+                    # flight recorder: a policied seam crossing half its
+                    # bound with a NEW watermark is the early overload
+                    # signal a post-mortem wants; rare by construction
+                    # (each depth fires at most once per queue lifetime)
+                    fr = getattr(self.counters, "flight_record", None)
+                    if fr is not None:
+                        fr(
+                            "queue.highwater",
+                            queue=self.ckey,
+                            depth=n,
+                            cap=self.maxsize,
+                        )
         if self.counters is not None:
             self.counters.set(self._k_depth, n)
 

@@ -9,5 +9,11 @@ from openr_tpu.monitor.counters import (  # noqa: F401
     Counters,
     render_prometheus,
 )
+from openr_tpu.monitor.fleet import aggregate_counters  # noqa: F401
+from openr_tpu.monitor.flight import FlightEvent, FlightRecorder  # noqa: F401
 from openr_tpu.monitor.monitor import LogSample, Monitor  # noqa: F401
-from openr_tpu.monitor.perf import PerfEvent, PerfEvents  # noqa: F401
+from openr_tpu.monitor.perf import (  # noqa: F401
+    HopSpan,
+    PerfEvent,
+    PerfEvents,
+)

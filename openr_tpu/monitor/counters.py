@@ -129,6 +129,19 @@ class _Stat:
 class Counters:
     counters: dict[str, float] = field(default_factory=dict)
     stats: dict[str, _Stat] = field(default_factory=dict)
+    # optional per-node FlightRecorder (monitor/flight.py), attached by
+    # OpenrNode — riding the registry because every module already
+    # holds a Counters, so record sites need no new constructor
+    # plumbing. Excluded from snapshot()/compare: it is a post-mortem
+    # ring, not a metric.
+    flight: object | None = field(default=None, compare=False, repr=False)
+
+    def flight_record(self, kind: str, **attrs) -> None:
+        """Record one flight-recorder event; no-op when no recorder is
+        attached (benches / bare Counters in tests)."""
+        f = self.flight
+        if f is not None:
+            f.record(kind, **attrs)
 
     def set(self, key: str, value: float) -> None:
         self.counters[key] = value

@@ -1,0 +1,91 @@
+"""Fleet metric aggregation: cross-node counter distributions.
+
+Per-node counters answer "what is node X doing"; at cluster scale the
+operator question is distributional — "what is the p99 queue depth
+across the fleet, and which node is the max". This module turns N
+per-node ``Counters.snapshot()`` dicts into per-key cross-node
+distributions (min/p50/p99/max/mean + the argmax node), shared by:
+
+  * ``breeze monitor fleet`` — scrapes ``get_counters`` from a list of
+    ctrl endpoints and renders the table;
+  * ``Cluster.fleet_counters()`` — the emulator hook (same math over
+    the in-process nodes' registries);
+  * benches/CI that gate on fleet-wide percentiles.
+
+Percentiles here are EXACT over the per-node values (node counts are
+small — thousands at most), unlike the log-bucketed within-node stat
+histograms (monitor/counters.py, ~12% bucket error).
+"""
+
+from __future__ import annotations
+
+
+def percentile(vals: list[float], q: float) -> float:
+    """Exact nearest-rank percentile over raw values — the one
+    definition shared by the fleet tables, the flood-trace attribution
+    (monitor/flood_trace.py) and the emulator convergence bench
+    (emulator/convergence.py); the within-node stat histograms use the
+    log-bucketed approximation in monitor/counters.py instead."""
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, int(len(vs) * q))]
+
+
+_percentile = percentile  # module-internal alias
+
+
+def aggregate_counters(
+    snapshots: dict[str, dict[str, float]], prefix: str = ""
+) -> dict[str, dict]:
+    """``{node: snapshot}`` → ``{key: distribution}``.
+
+    Each distribution: ``{"nodes", "min", "p50", "p99", "max", "mean",
+    "sum", "max_node"}``. Keys missing on a node simply don't
+    contribute (a key present on 3 of 64 nodes aggregates over 3 —
+    ``nodes`` says so)."""
+    per_key: dict[str, list[tuple[float, str]]] = {}
+    for node, snap in snapshots.items():
+        for k, v in snap.items():
+            if prefix and not k.startswith(prefix):
+                continue
+            per_key.setdefault(k, []).append((float(v), node))
+    out: dict[str, dict] = {}
+    for k, pairs in per_key.items():
+        vals = [v for v, _n in pairs]
+        vmax, max_node = max(pairs, key=lambda p: p[0])
+        out[k] = {
+            "nodes": len(vals),
+            "min": min(vals),
+            "p50": _percentile(vals, 0.5),
+            "p99": _percentile(vals, 0.99),
+            "max": vmax,
+            "mean": sum(vals) / len(vals),
+            "sum": sum(vals),
+            "max_node": max_node,
+        }
+    return out
+
+
+def fleet_rows(
+    agg: dict[str, dict], limit: int = 0
+) -> list[list[str]]:
+    """Render-ready rows (key, nodes, min, p50, p99, max, max-node),
+    sorted by key; ``limit`` > 0 keeps the first N."""
+    def fmt(v: float) -> str:
+        return f"{v:g}" if v == int(v) else f"{v:.3f}"
+
+    rows = [
+        [
+            k,
+            str(d["nodes"]),
+            fmt(d["min"]),
+            fmt(d["p50"]),
+            fmt(d["p99"]),
+            fmt(d["max"]),
+            d["max_node"],
+        ]
+        for k, d in sorted(agg.items())
+    ]
+    return rows[:limit] if limit > 0 else rows
+
+
+FLEET_HEADERS = ["counter", "nodes", "min", "p50", "p99", "max", "max-node"]

@@ -1,0 +1,108 @@
+"""Per-node flight recorder: a bounded ring of recent structured events.
+
+A 1000-node soak failure used to mean "re-run with logging": the
+invariant checker names the node that diverged, but the *history* that
+led there — which rebuilds dispatched, which floods fanned out, which
+queues crossed their highwater, which backoffs saturated, which peer
+sessions flapped — was gone. The flight recorder keeps that history as
+a cheap bounded ring per node, dumped automatically when
+``emulator/invariants.py`` fails a check (the dump directory rides the
+failure message next to the replay seed) or on demand over ctrl
+(``get_flight_recorder`` / ``breeze monitor flight``).
+
+Recording is wired through the node's :class:`Counters` registry
+(``counters.flight_record(kind, **attrs)``) — the one object every
+module already holds — so adding a record site needs no new plumbing.
+Event kinds in use (documented in docs/Monitor.md):
+
+  decision.rebuild           path, ms, traces — one per dispatched rebuild
+  kvstore.flood_fanout       area, keys, expired, peers
+  kvstore.peer_up/peer_down  peer, area
+  kvstore.sync_failed        peer, area, error, backoff_ms, saturated
+  kvstore.flood_failed       peer, error
+  kvstore.flood_backpressure peer, keys dropped at the pending bound
+  fib.program_fail           streak, error, backoff_ms
+  fib.backoff_saturated      streak, ms
+  queue.highwater            queue, depth, cap — policied seam crossed
+                             half its bound with a new watermark
+
+Kinds are free-form dotted strings (module.what); they are NOT counter
+names and are not registered in monitor/names.py — the ring is a
+post-mortem artifact, not a metrics surface.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+#: default ring capacity — sized so a 64-node churn storm's tail (a few
+#: hundred fan-outs + rebuilds per node) survives until the post-storm
+#: invariant check runs, while 1000 nodes × capacity stays ~100 MB-scale
+DEFAULT_CAPACITY = 512
+
+
+@dataclass
+class FlightEvent:
+    """One recorded event: wall-clock + monotonic stamps, a dotted kind,
+    and free-form attributes (must stay jsonable — the dump is JSON)."""
+
+    ts: float  # epoch seconds (cross-node alignable, NTP-grade)
+    mono_ns: int  # monotonic, exact within the node
+    seq: int  # per-recorder sequence (ring eviction survivor ordering)
+    kind: str
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+    def to_jsonable(self) -> dict:
+        return {
+            "ts": self.ts,
+            "mono_ns": self.mono_ns,
+            "seq": self.seq,
+            "kind": self.kind,
+            "attrs": self.attrs,
+        }
+
+
+class FlightRecorder:
+    """Bounded ring of :class:`FlightEvent`s (oldest evicted first)."""
+
+    def __init__(self, node: str = "", capacity: int = DEFAULT_CAPACITY):
+        self.node = node
+        self.capacity = capacity
+        self._ring: collections.deque[FlightEvent] = collections.deque(
+            maxlen=capacity
+        )
+        self._seq = itertools.count()
+        self.recorded = 0  # lifetime count (ring length saturates)
+
+    def record(self, kind: str, **attrs: Any) -> None:
+        """Append one event. Hot-path cheap: one dataclass + deque
+        append; attrs should already be plain jsonable values."""
+        self.recorded += 1
+        self._ring.append(
+            FlightEvent(
+                ts=time.time(),
+                mono_ns=time.monotonic_ns(),
+                seq=next(self._seq),
+                kind=kind,
+                attrs=attrs,
+            )
+        )
+
+    def dump(self, limit: int | None = None) -> list[dict]:
+        """Jsonable snapshot, oldest first (the post-mortem read order).
+        ``limit`` keeps only the newest N (0 = none)."""
+        events = list(self._ring)
+        if limit is not None and limit >= 0:
+            # events[-0:] would be the WHOLE list — honor limit=0
+            events = events[-limit:] if limit else []
+        return [e.to_jsonable() for e in events]
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)

@@ -1,0 +1,205 @@
+"""Cross-node flood-trace assembly: waterfalls, attribution, trees.
+
+Pure functions over the *jsonable* trace dicts `PerfEvents.to_jsonable`
+emits for sampled flood traces (``trace_id`` set, ``hops`` chain) — no
+emulator or jax imports, so both the ctrl server and the breeze CLI can
+use them directly. The emulator-side collector that walks a live
+Cluster's Monitor rings is ``openr_tpu/emulator/tracing.py``.
+
+A completed span (one node's FIB_PROGRAMMED of a sampled flood) is
+attributed to NAMED stages along its whole path:
+
+  per relay hop i:   kvstore_process  rx → fan-out enqueue (decode,
+                                      store merge, local publish)
+                     flood_encode     enqueue → wire encode (tx stamp)
+                     wire             tx(i) → rx(i+1): socket + the
+                                      sender's flood-pump wait
+  terminal node:     decision_queue   rx → DECISION_RECEIVED
+                     decision_debounce  → DECISION_DEBOUNCED
+                     spf_solve          → SPF_SOLVE_DONE (incl. the
+                                          REBUILD_* path marker)
+                     route_dispatch     → ROUTE_UPDATE_SENT
+                     fib_program        → FIB_PROGRAMMED
+
+The stages telescope — consecutive deltas over one checkpoint chain —
+so a clean trace's stage sum equals its end-to-end total exactly
+(``coverage`` ≈ 1.0). Missing stamps or non-monotonic checkpoints
+(clock-domain mixes on real multi-host deployments) leave gaps, and
+coverage reports honestly how much of the total was attributed.
+"""
+
+from __future__ import annotations
+
+from openr_tpu.monitor import perf
+from openr_tpu.monitor.fleet import percentile as _percentile
+
+#: canonical stage order (rendering + attribution tables)
+STAGES: tuple[str, ...] = (
+    "kvstore_process",
+    "flood_encode",
+    "wire",
+    "decision_queue",
+    "decision_debounce",
+    "spf_solve",
+    "route_dispatch",
+    "fib_program",
+)
+
+_TERMINAL_CHAIN: tuple[tuple[str, str], ...] = (
+    (perf.DECISION_RECEIVED, "decision_queue"),
+    (perf.DECISION_DEBOUNCED, "decision_debounce"),
+    (perf.SPF_SOLVE_DONE, "spf_solve"),
+    (perf.ROUTE_UPDATE_SENT, "route_dispatch"),
+    (perf.FIB_PROGRAMMED, "fib_program"),
+)
+
+
+def is_flood_trace(tr: dict) -> bool:
+    return bool(tr.get("trace_id")) and bool(tr.get("hops"))
+
+
+def waterfall(tr: dict) -> dict | None:
+    """Per-hop named-stage breakdown of one completed span (jsonable
+    trace dict). Returns None for untraced/uncompleted records.
+
+    Output: ``{"trace_id", "origin", "terminal", "hops", "total_ms",
+    "stages": [{"stage", "node", "ms"}...], "attributed_ms",
+    "coverage"}`` — stages in checkpoint order, coverage =
+    attributed/total."""
+    if not is_flood_trace(tr):
+        return None
+    hops = sorted(tr["hops"], key=lambda h: h.get("hop", 0))
+    events = tr.get("events") or []
+    origin_ts = tr.get("origin_ts_ns") or hops[0].get("rx_ns", 0)
+    term = hops[-1].get("node", "")
+    fib_ts = next(
+        (
+            e["ts_ns"]
+            for e in reversed(events)
+            if e.get("event") == perf.FIB_PROGRAMMED
+            and e.get("node") == term
+        ),
+        0,
+    )
+    if not origin_ts or not fib_ts or fib_ts < origin_ts:
+        return None
+    total_ms = (fib_ts - origin_ts) / 1e6
+    stages: list[dict] = []
+    cur = origin_ts
+
+    def emit(stage: str, node: str, ts: int) -> None:
+        nonlocal cur
+        # missing stamp (0) or a backward checkpoint → skip: the gap
+        # stays unattributed and shows up as coverage < 1
+        if ts and ts >= cur:
+            stages.append(
+                {"stage": stage, "node": node, "ms": (ts - cur) / 1e6}
+            )
+            cur = ts
+
+    for i, h in enumerate(hops):
+        node = h.get("node", "")
+        if i > 0:
+            emit("wire", node, h.get("rx_ns", 0))
+        if i < len(hops) - 1:
+            emit("kvstore_process", node, h.get("enq_ns", 0))
+            emit("flood_encode", node, h.get("tx_ns", 0))
+    # terminal decision chain: first marker of each stage stamped by the
+    # terminal node at/after the current checkpoint (merged traces can
+    # carry repeats; monotonicity picks the right one). The terminal's
+    # own fan-out stamps are skipped — that branch runs in parallel with
+    # the decision path and would double-book the timeline.
+    for marker, stage in _TERMINAL_CHAIN:
+        ts = next(
+            (
+                e["ts_ns"]
+                for e in events
+                if e.get("event") == marker
+                and e.get("node") == term
+                and e["ts_ns"] >= cur
+            ),
+            0,
+        )
+        emit(stage, term, ts)
+    attributed = sum(s["ms"] for s in stages)
+    return {
+        "trace_id": tr["trace_id"],
+        "origin": tr.get("origin", ""),
+        "terminal": term,
+        "hops": len(hops) - 1,  # edges traversed, 0 = origin-local span
+        "total_ms": round(total_ms, 3),
+        "stages": [
+            {**s, "ms": round(s["ms"], 3)} for s in stages
+        ],
+        "attributed_ms": round(attributed, 3),
+        "coverage": round(attributed / total_ms, 4) if total_ms > 0 else 0.0,
+    }
+
+
+def attribution(traces: list[dict]) -> dict:
+    """Cross-trace per-stage p50 breakdown — the `convergence_attribution`
+    benchmarks report next to `convergence_p50_ms`. Stage deltas are
+    summed per trace first (a 5-hop trace has 5 wire segments), then
+    the p50 is taken across traces per stage."""
+    falls = [w for w in (waterfall(t) for t in traces) if w is not None]
+    if not falls:
+        return {"traces": 0, "stages_p50_ms": {}, "coverage_p50": None}
+    per_stage: dict[str, list[float]] = {}
+    for w in falls:
+        sums: dict[str, float] = {}
+        for s in w["stages"]:
+            sums[s["stage"]] = sums.get(s["stage"], 0.0) + s["ms"]
+        for stage, ms in sums.items():
+            per_stage.setdefault(stage, []).append(ms)
+    return {
+        "traces": len(falls),
+        "max_hops": max(w["hops"] for w in falls),
+        "total_p50_ms": round(
+            _percentile([w["total_ms"] for w in falls], 0.5), 3
+        ),
+        "stages_p50_ms": {
+            stage: round(_percentile(per_stage[stage], 0.5), 3)
+            for stage in STAGES
+            if stage in per_stage
+        },
+        "coverage_p50": round(
+            _percentile([w["coverage"] for w in falls], 0.5), 4
+        ),
+    }
+
+
+def propagation_tree(traces: list[dict]) -> dict:
+    """Assemble cluster-wide completions into per-trace propagation
+    trees: each completed span contributes its path's parent→child
+    edges (the union over spans is the flood tree as actually walked).
+
+    Returns ``{trace_id: {"origin", "nodes", "edges", "max_hops",
+    "completions"}}`` with edges sorted for stable rendering."""
+    out: dict[int, dict] = {}
+    for tr in traces:
+        if not is_flood_trace(tr):
+            continue
+        hops = sorted(tr["hops"], key=lambda h: h.get("hop", 0))
+        entry = out.setdefault(
+            tr["trace_id"],
+            {
+                "origin": tr.get("origin", ""),
+                "nodes": set(),
+                "edges": set(),
+                "max_hops": 0,
+                "completions": 0,
+            },
+        )
+        entry["completions"] += 1
+        entry["max_hops"] = max(entry["max_hops"], len(hops) - 1)
+        prev = None
+        for h in hops:
+            node = h.get("node", "")
+            entry["nodes"].add(node)
+            if prev is not None:
+                entry["edges"].add((prev, node))
+            prev = node
+    for entry in out.values():
+        entry["nodes"] = sorted(entry["nodes"])
+        entry["edges"] = sorted(entry["edges"])
+    return out

@@ -91,6 +91,15 @@ class Monitor(OpenrModule):
             self.perf_traces.append(trace)
             if self.counters:
                 self.counters.increment("monitor.perf_traces")
+                if getattr(trace, "trace_id", 0):
+                    # completed sampled flood span (hop-span trace) —
+                    # cross-node BY CONSTRUCTION (span-traced pubs skip
+                    # the per-hop markers, so the events list alone can
+                    # look single-origin at a relay), counted for the
+                    # cluster-wide collector and excluded from the
+                    # single-node convergence stat
+                    self.counters.increment("monitor.flood_traces")
+                    continue
                 # the windowed stat only ingests single-origin traces:
                 # markers stamped on different HOSTS carry unrelated
                 # monotonic epochs, so a cross-node total is ordering
@@ -114,3 +123,12 @@ class Monitor(OpenrModule):
     def recent_perf(self, limit: int = 20) -> list:
         """Most recent completed convergence traces, oldest first."""
         return list(self.perf_traces)[-limit:]
+
+    def recent_flood_traces(self, limit: int = 50) -> list:
+        """Most recent completed SAMPLED flood spans (hop-span traces),
+        oldest first — the per-node slice the cluster-wide collector
+        (ctrl get_flood_traces / emulator.tracing) assembles."""
+        out = [
+            t for t in self.perf_traces if getattr(t, "trace_id", 0)
+        ]
+        return out[-limit:]

@@ -82,6 +82,11 @@ COUNTERS: frozenset[str] = frozenset(
         "kvstore.flood_encodes",
         "kvstore.flood_failures",
         "kvstore.flood_fanout_ms",
+        # cross-node flood tracing (docs/Monitor.md "Flood tracing"):
+        # sampled originations, relayed hop-span stamps, span wire bytes
+        "kvstore.flood_traces_sampled",
+        "kvstore.flood_hops",
+        "kvstore.flood_span_bytes",
         "kvstore.flood_keys_coalesced",
         "kvstore.flood_root_missing",
         "kvstore.floods_held",
@@ -128,6 +133,7 @@ COUNTERS: frozenset[str] = frozenset(
         "watchdog.scans",
         "watchdog.stalls",
         "monitor.convergence_ms",
+        "monitor.flood_traces",
         "monitor.log_samples",
         "monitor.perf_traces",
         "monitor.perf_traces_multi_origin",
@@ -177,6 +183,9 @@ TEMPLATES: dict[str, str | None] = {
     # per-jitted-function compile counts (monitor/compile_ledger.py) —
     # the fn segment is the jit wrapper's name
     "jax.compiles.*": "jax.compiles.<fn>",
+    # annotated profiling spans' wall durations (monitor/profiling.py
+    # annotate(counters=...)) — the span segment is the annotation name
+    "profile.*_ms": "profile.<span>_ms",
     # platform error taxonomy
     "platform.*": None,
 }

@@ -33,6 +33,19 @@ clock domains, so cross-host deltas are only indicative of ordering,
 never of duration (the reference uses unix timestamps and accepts NTP
 skew instead; we keep exact in-process deltas, the quantity the
 benchmarks and the windowed convergence stat are built on).
+
+Cross-node flood spans (docs/Monitor.md "Flood tracing"): a SAMPLED
+origination (KvStore traces every Nth locally-originated publication,
+seeded — KvStoreConfig.trace_sample_every) additionally carries a
+:class:`HopSpan` chain — origin node + origination stamp, then one span
+per flooding hop with rx / fan-out-enqueue / tx stamps. The fields ride
+`PerfEvents` as APPENDED wire fields, so the PR 8 binary evolution rules
+make them a zero-negotiation change: an old peer skips them, a new peer
+defaults them. Every node on the flood path completes its own span at
+FIB_PROGRAMMED; the collector (`monitor/flood_trace.py`,
+`emulator/tracing.py`, `breeze perf waterfall`) reassembles the
+completed spans cluster-wide into a propagation tree with a per-hop
+named-stage waterfall.
 """
 
 from __future__ import annotations
@@ -92,13 +105,197 @@ class PerfEvent:
 
 
 @dataclass
+class HopSpan:
+    """One flooding hop of a sampled cross-node trace.
+
+    ``rx_ns`` is when this node received the flood (the origination
+    stamp on hop 0); ``enq_ns`` when the node fanned the update out
+    toward its peers (KvStore `_flood`); ``tx_ns`` when the wire frame
+    was encoded/shipped (serialize-once encodes at fan-out time, so
+    enq≈tx on the binary path — pump wait shows up in the next hop's
+    wire stage). 0 = never stamped (e.g. a leaf with no onward peers).
+    All stamps share the STAMPING node's monotonic clock; cross-node
+    deltas are only exact when the nodes share a clock (in-process
+    emulator — the regime the waterfall is built for)."""
+
+    node: str = ""
+    hop: int = 0
+    rx_ns: int = 0
+    enq_ns: int = 0
+    tx_ns: int = 0
+
+
+class FloodSpan:
+    """Working (unpacked) form of the flood-span extension: trace
+    identity + the HopSpan chain. On the wire this travels as ONE
+    compact packed bytes field (`PerfEvents.span_bin`) — see the pack
+    format below — because a generic per-field dataclass encoding of
+    the chain measured ~3x the whole publication's wire-seam cost,
+    which would defeat the "tracing stays affordable" sampling story."""
+
+    __slots__ = ("trace_id", "origin", "origin_ts_ns", "hops")
+
+    def __init__(
+        self,
+        trace_id: int = 0,
+        origin: str = "",
+        origin_ts_ns: int = 0,
+        hops: list[HopSpan] | None = None,
+    ):
+        self.trace_id = trace_id
+        self.origin = origin
+        self.origin_ts_ns = origin_ts_ns
+        self.hops = hops if hops is not None else []
+
+
+# ---- packed span codec -------------------------------------------------
+#
+#   [ver=0x01]
+#   uvarint trace_id
+#   uvarint len(origin) + utf8
+#   uvarint origin_ts_ns
+#   uvarint nhops, then per hop (hop index = position):
+#     uvarint len(node) + utf8
+#     zigzag(rx - prev_rx)            prev_rx = origin_ts for hop 0
+#     uvarint enq_code                0 = unset, else zigzag(enq-rx)+1
+#     uvarint tx_code                 0 = unset, else zigzag(tx-enq|rx)+1
+#
+# Same-clock stamps make the deltas small (1-4 byte varints); zigzag
+# keeps cross-clock-domain (multi-host) spans decodable, just fat.
+# An unknown version byte decodes as "no span" — the extension is
+# observability, never worth a frame rejection.
+
+_SPAN_VER = 0x01
+
+
+def _w_uv(out: bytearray, n: int) -> None:
+    while n > 0x7F:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def _r_uv(buf: bytes, pos: int) -> tuple[int, int]:
+    n = 0
+    shift = 0
+    while True:
+        b = buf[pos]  # IndexError on truncation → caller drops the span
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 77:
+            raise ValueError("span varint too long")
+
+
+def _zz(v: int) -> int:
+    return (v << 1) if v >= 0 else ((-v << 1) - 1)
+
+
+def _unzz(u: int) -> int:
+    return (u >> 1) if not u & 1 else -((u + 1) >> 1)
+
+
+def pack_span(span: FloodSpan) -> bytes:
+    out = bytearray((_SPAN_VER,))
+    _w_uv(out, span.trace_id)
+    ob = span.origin.encode()
+    _w_uv(out, len(ob))
+    out += ob
+    _w_uv(out, span.origin_ts_ns)
+    _w_uv(out, len(span.hops))
+    prev_rx = span.origin_ts_ns
+    for h in span.hops:
+        nb = h.node.encode()
+        _w_uv(out, len(nb))
+        out += nb
+        _w_uv(out, _zz(h.rx_ns - prev_rx))
+        prev_rx = h.rx_ns
+        _w_uv(out, _zz(h.enq_ns - h.rx_ns) + 1 if h.enq_ns else 0)
+        base = h.enq_ns or h.rx_ns
+        _w_uv(out, _zz(h.tx_ns - base) + 1 if h.tx_ns else 0)
+    return bytes(out)
+
+
+def unpack_span(blob: bytes) -> FloodSpan | None:
+    """None on empty/unknown-version/corrupt input — a span is
+    best-effort observability, never a decode failure."""
+    if not blob or blob[0] != _SPAN_VER:
+        return None
+    try:
+        pos = 1
+        trace_id, pos = _r_uv(blob, pos)
+        n, pos = _r_uv(blob, pos)
+        origin = blob[pos : pos + n].decode()
+        pos += n
+        origin_ts, pos = _r_uv(blob, pos)
+        nhops, pos = _r_uv(blob, pos)
+        if nhops > len(blob):  # corrupt count guard
+            return None
+        hops: list[HopSpan] = []
+        prev_rx = origin_ts
+        for i in range(nhops):
+            n, pos = _r_uv(blob, pos)
+            node = blob[pos : pos + n].decode()
+            pos += n
+            d, pos = _r_uv(blob, pos)
+            rx = prev_rx + _unzz(d)
+            prev_rx = rx
+            ec, pos = _r_uv(blob, pos)
+            enq = rx + _unzz(ec - 1) if ec else 0
+            tc, pos = _r_uv(blob, pos)
+            tx = ((enq or rx) + _unzz(tc - 1)) if tc else 0
+            hops.append(HopSpan(node, i, rx, enq, tx))
+        return FloodSpan(trace_id, origin, origin_ts, hops)
+    except (IndexError, ValueError, UnicodeDecodeError):
+        return None
+
+
+def _cap_events(ev: list[PerfEvent], cap: int) -> list[PerfEvent]:
+    """Trim a marker list to ~`cap` keeping (a) the origin, (b) the
+    newest stamps, and (c) at least ONE stamp per node — the per-hop
+    keep-one guard: a sampled multi-hop trace whose interior nodes only
+    contributed one marker each must not lose them to the eviction
+    policy, or the waterfall silently drops interior hops. May exceed
+    `cap` by the number of distinct nodes outside the kept tail — i.e.
+    bounded by the flood path length, which is exactly the information
+    being preserved."""
+    if len(ev) <= cap:
+        return list(ev)
+    keep: set[int] = {0, len(ev) - 1}
+    seen: set[str] = set()
+    for i, e in enumerate(ev):  # earliest marker of each node (its rx-ish)
+        if e.node not in seen:
+            seen.add(e.node)
+            keep.add(i)
+    i = len(ev) - 1
+    while len(keep) < cap and i >= 0:
+        keep.add(i)
+        i -= 1
+    return [ev[i] for i in sorted(keep)]
+
+
+@dataclass
 class PerfEvents:
     """Ordered marker list carried on queue payloads.
 
     reference: PerfEvents †. Markers are appended in stamp order;
-    `deltas()` yields the per-stage breakdown operators read."""
+    `deltas()` yields the per-stage breakdown operators read.
+
+    ``span_bin`` is the cross-node flood-span extension (module
+    docstring): ONE appended wire field with a default, so both codecs
+    evolve without negotiation, packed compactly (pack_span) because it
+    rides every traced flood frame. The unpacked working copy is the
+    transient ``_span`` (lazy; every mutation re-packs, so ``span_bin``
+    is always wire-current). ``trace_id == 0`` means "not a sampled
+    flood trace" — the hop stamp calls are no-ops then."""
 
     events: list[PerfEvent] = field(default_factory=list)
+    # packed flood-span extension (appended wire field; see pack_span)
+    span_bin: bytes | None = None
+    # unpacked span (transient — never on the wire; serde skips _fields)
+    _span: FloodSpan | None = field(default=None, compare=False, repr=False)
 
     @classmethod
     def start(cls, event: str, node: str = "") -> "PerfEvents":
@@ -111,9 +308,7 @@ class PerfEvents:
     ) -> None:
         """Stamp one stage marker (reference: addPerfEvent †)."""
         if len(self.events) >= MAX_EVENTS_PER_TRACE:
-            # evict the second-oldest, never the origin or the new stamp:
-            # total_ms stays origin→newest and the trace still completes
-            self.events.pop(1)
+            self._evict_one()
         self.events.append(
             PerfEvent(
                 event=event,
@@ -122,25 +317,165 @@ class PerfEvents:
             )
         )
 
+    def _evict_one(self) -> None:
+        """Evict one middle marker: never the origin, never the newest,
+        and never a node's LAST remaining stamp (the per-hop keep-one
+        guard — interior flood hops often hold exactly one marker, and
+        losing it silently drops that hop from the waterfall). Falls
+        back to the second-oldest when every node is down to one."""
+        counts: dict[str, int] = {}
+        for e in self.events:
+            counts[e.node] = counts.get(e.node, 0) + 1
+        for i in range(1, len(self.events) - 1):
+            if counts[self.events[i].node] > 1:
+                self.events.pop(i)
+                return
+        self.events.pop(1)
+
+    # ------------------------------------------------- flood hop spans
+
+    def _get_span(self) -> FloodSpan | None:
+        """Lazy unpack of the wire extension (decode cost is paid only
+        by code that actually reads the span, not by every flood)."""
+        if self._span is None and self.span_bin:
+            self._span = unpack_span(self.span_bin)
+        return self._span
+
+    @property
+    def trace_id(self) -> int:
+        s = self._get_span()
+        return s.trace_id if s is not None else 0
+
+    @property
+    def origin(self) -> str:
+        s = self._get_span()
+        return s.origin if s is not None else ""
+
+    @property
+    def origin_ts_ns(self) -> int:
+        s = self._get_span()
+        return s.origin_ts_ns if s is not None else 0
+
+    @property
+    def hops(self) -> list[HopSpan]:
+        s = self._get_span()
+        return s.hops if s is not None else []
+
+    def begin_flood_trace(
+        self, node: str, trace_id: int, ts_ns: int | None = None
+    ) -> None:
+        """Mark this trace as a sampled flood trace originating HERE:
+        hop 0's rx stamp is the origination time (KvStore stamps this
+        on every Nth accepted local origination)."""
+        ts = time.monotonic_ns() if ts_ns is None else ts_ns
+        self._span = FloodSpan(
+            trace_id=trace_id,
+            origin=node,
+            origin_ts_ns=ts,
+            hops=[HopSpan(node=node, hop=0, rx_ns=ts)],
+        )
+        self.span_bin = pack_span(self._span)
+
+    def stamp_hop_rx(self, node: str, ts_ns: int | None = None) -> bool:
+        """Append this node's hop span on flood receive. No-op (False)
+        when untraced or when the node already holds a span (duplicate
+        delivery suppressed by the flood loop guard upstream, but a
+        merge can re-route one)."""
+        s = self._get_span()
+        if s is None or not s.trace_id:
+            return False
+        if any(h.node == node for h in s.hops):
+            return False
+        s.hops.append(
+            HopSpan(
+                node=node,
+                hop=len(s.hops),
+                rx_ns=time.monotonic_ns() if ts_ns is None else ts_ns,
+            )
+        )
+        self.span_bin = pack_span(s)
+        return True
+
+    def stamp_hop_fanout(self, node: str, ts_ns: int | None = None) -> None:
+        """Stamp this node's span at fan-out time (enqueue toward peers
+        + encode): called by KvStore `_flood` BEFORE the serialize-once
+        encode, so the stamps freeze into the shared wire frame.
+        WRITE-ONCE: a later re-flood touching the same trace (e.g. a
+        version-refresh of an already-fanned key) must not move the
+        stamps — the frame that actually propagated carried the first
+        ones, and a late re-stamp fabricates a giant enq→tx delta in
+        the local completion that the shipped frames never saw."""
+        s = self._get_span()
+        if s is None or not s.trace_id:
+            return
+        for h in reversed(s.hops):
+            if h.node == node:
+                if h.tx_ns:
+                    return
+                t = time.monotonic_ns() if ts_ns is None else ts_ns
+                if h.enq_ns == 0:
+                    h.enq_ns = t
+                h.tx_ns = t
+                self.span_bin = pack_span(s)
+                return
+
     def copy(self) -> "PerfEvents":
         """Independent snapshot. Every consumer that stamps a trace on
         its own schedule (local Decision/Fib vs the per-peer flood
         pump, one advertisement per area) must take its own copy —
         sharing the mutable list leaks one pipeline's markers into
-        another's trace."""
-        return PerfEvents(events=list(self.events))
+        another's trace. The packed span bytes are immutable (every
+        stamp re-packs a fresh blob), so carrying them is safe; the
+        unpacked working copy stays lazy."""
+        return PerfEvents(events=list(self.events), span_bin=self.span_bin)
+
+    # wire-lean marker budget for span-carrying traces: the origin's
+    # own pipeline markers are ≤ ~5 (NEIGHBOR_EVENT → KVSTORE_FLOODED)
+    _LEAN_EVENT_CAP = 8
+
+    def wire_lean(self) -> "PerfEvents":
+        """Wire-bound slimming of a SPAN-carrying trace: keep only the
+        origin node's markers. The hop span subsumes per-hop markers,
+        but the per-peer flood coalescing merge unions every batched
+        trace's events — so one sampled publication taints whole
+        coalesced batches, and a deep relay ships toward _MERGE_CAP
+        PerfEvent dataclasses on EVERY frame (measured 3x wire-seam
+        cost at 64 nodes before this). Untraced traces pass through
+        unchanged — legacy multi-origin ring traces keep their union.
+        Receivers lose the merged-in FOREIGN markers; their own local
+        stamps (the waterfall's terminal chain) land after receive as
+        always."""
+        s = self._get_span()
+        if s is None:
+            return self
+        ev = [e for e in self.events if e.node == s.origin]
+        if len(ev) == len(self.events) <= self._LEAN_EVENT_CAP:
+            return self
+        if len(ev) > self._LEAN_EVENT_CAP:
+            # same invariant as every other trim here: keep the FIRST
+            # stamp (the origin anchor) and the NEWEST stamps — the
+            # most recent origin stage must survive, not the middle
+            ev = [ev[0], *ev[-(self._LEAN_EVENT_CAP - 1):]]
+        return PerfEvents(events=ev, span_bin=self.span_bin)
 
     def merge(self, other: "PerfEvents") -> "PerfEvents":
         """Combine two traces (e.g. several coalesced neighbor events
         feeding one advertisement): union of markers, timestamp order.
         The merge of stable-sorted streams keeps stamp order for equal
-        timestamps."""
+        timestamps.
+
+        Flood-span identity: the merged trace keeps self's span when
+        self carries one, else other's. Two DISTINCT sampled traces
+        coalescing keep only the first chain — splicing two unrelated
+        hop chains would fabricate a propagation path; the collector
+        sees one coherent (if partial) trace instead. The packed blobs
+        compare cheaply, so no unpack happens here."""
         ev = sorted([*self.events, *other.events], key=lambda e: e.ts_ns)
         if len(ev) > _MERGE_CAP:
-            # same invariant as add_perf_event's eviction: keep the
-            # origin marker and the NEWEST stamps, drop the middle
-            ev = [ev[0], *ev[-(_MERGE_CAP - 1):]]
-        return PerfEvents(events=ev)
+            ev = _cap_events(ev, _MERGE_CAP)
+        return PerfEvents(
+            events=ev, span_bin=self.span_bin or other.span_bin
+        )
 
     def deltas(self) -> list[tuple[str, float]]:
         """Per-stage (event, ms-since-previous-marker); first stage is 0."""
@@ -163,7 +498,7 @@ class PerfEvents:
 
     def to_jsonable(self) -> dict:
         """Operator-facing encoding used by get_perf_events."""
-        return {
+        out = {
             "events": [
                 {"event": e.event, "ts_ns": e.ts_ns, "node": e.node}
                 for e in self.events
@@ -174,3 +509,18 @@ class PerfEvents:
             ],
             "total_ms": round(self.total_ms(), 3),
         }
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
+            out["origin"] = self.origin
+            out["origin_ts_ns"] = self.origin_ts_ns
+            out["hops"] = [
+                {
+                    "node": h.node,
+                    "hop": h.hop,
+                    "rx_ns": h.rx_ns,
+                    "enq_ns": h.enq_ns,
+                    "tx_ns": h.tx_ns,
+                }
+                for h in self.hops
+            ]
+        return out

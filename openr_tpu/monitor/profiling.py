@@ -11,16 +11,26 @@ Usage:
       solver.compute_routes(...)
   with profiling.annotate("spf:solve"):        # named span inside it
       ...
+  with profiling.annotate("spf:solve", counters=node_counters):
+      ...  # ALSO records wall ms into the `profile.spf:solve_ms` stat
 
 bench.py honors OPENR_BENCH_TRACE=<dir> and wraps its timed iterations;
 TpuSpfSolver annotates solve/assembly phases so the xprof timeline
 separates device solve time from host RIB assembly.
+
+With a :class:`Counters` registry passed, every annotated span ALSO
+records its wall duration into the windowed ``profile.<span>_ms``
+histogram stat — so solver phase timings land on the same Prometheus
+surface (and `breeze monitor fleet` distributions) as every other
+latency in the system, whether or not an xprof session is active
+(docs/Monitor.md).
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import time
 
 log = logging.getLogger(__name__)
 
@@ -52,11 +62,57 @@ def trace(trace_dir: str | None):
             log.warning("jax profiler trace export failed", exc_info=True)
 
 
-def annotate(name: str):
-    """Named trace span (xprof timeline row); no-op without jax."""
+def annotate(name: str, counters=None):
+    """Named trace span (xprof timeline row); no-op without jax. With
+    `counters`, the span's wall duration is additionally recorded into
+    the ``profile.<name>_ms`` Counters histogram — device-side phase
+    closure onto the common metric surface."""
+    inner = _raw_annotation(name)
+    if counters is None:
+        return inner
+    return _TimedSpan(name, counters, inner)
+
+
+def _raw_annotation(name: str):
     try:
         import jax
 
         return jax.profiler.TraceAnnotation(name)
     except Exception:  # noqa: BLE001
         return contextlib.nullcontext()
+
+
+class _TimedSpan:
+    """Context manager wrapping the (possibly no-op) jax annotation with
+    a wall-clock timer recorded into Counters on exit. Nested spans each
+    record their own duration (the outer includes the inner, as xprof
+    timelines do). Re-entrant only via fresh instances — annotate()
+    returns a new one per call."""
+
+    __slots__ = ("name", "counters", "inner", "_t0")
+
+    def __init__(self, name: str, counters, inner):
+        self.name = name
+        self.counters = counters
+        self.inner = inner
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        try:
+            self.inner.__enter__()
+        except Exception:  # noqa: BLE001 — profiling must never break prod
+            self.inner = contextlib.nullcontext()
+            self.inner.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            self.inner.__exit__(exc_type, exc, tb)
+        except Exception:  # noqa: BLE001
+            log.warning("trace annotation exit failed", exc_info=True)
+        self.counters.add_value(
+            f"profile.{self.name}_ms",
+            (time.perf_counter() - self._t0) * 1e3,
+        )
+        return False

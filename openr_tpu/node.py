@@ -52,6 +52,15 @@ class OpenrNode:
         self.config = config
         self.name = config.node_name
         self.counters = Counters()
+        # per-node flight recorder (monitor/flight.py): bounded ring of
+        # recent structured events, dumped by the emulator's invariant
+        # checker on failure and over ctrl on demand. Attached to the
+        # Counters registry so every module's record sites reach it
+        # through plumbing they already have.
+        from openr_tpu.monitor.flight import FlightRecorder
+
+        self.flight = FlightRecorder(node=self.name)
+        self.counters.flight = self.flight
 
         # ---- queues (reference: Main.cpp queue construction †) ----------
         # Every seam is depth-gauged; the policied ones are bounded with

@@ -356,3 +356,43 @@ def test_decision_rib_policy_set(live, tmp_path):
     assert "installed" in out
     out = invoke(live, "a", "decision", "rib-policy")
     assert "weight-b" in out
+
+
+def test_monitor_fleet_single_endpoint(live):
+    """`breeze monitor fleet` with no --endpoints aggregates the one
+    root node (a 1-node fleet) — the table shape and scrape plumbing."""
+    out = invoke(live, "a", "monitor", "fleet", "--prefix", "kvstore.")
+    assert "1 node(s) scraped" in out
+    assert "kvstore.floods_sent" in out
+    assert "max-node" in out  # header row
+
+
+def test_monitor_fleet_multi_endpoint(live):
+    eps = ",".join(
+        f"127.0.0.1:{live.port(n)}" for n in ("a", "b", "c")
+    )
+    out = invoke(
+        live, "a", "monitor", "fleet", "--endpoints", eps,
+        "--prefix", "kvstore.floods_sent",
+    )
+    assert "3 node(s) scraped" in out
+    assert "kvstore.floods_sent" in out
+
+
+def test_monitor_flight(live):
+    out = invoke(live, "a", "monitor", "flight", "--limit", "200")
+    # a converged node has recorded at least peer-up + rebuild events
+    assert "kvstore.peer_up" in out
+    assert "decision.rebuild" in out
+    out = invoke(
+        live, "a", "monitor", "flight", "--kind", "decision.rebuild"
+    )
+    assert "kvstore.peer_up" not in out
+
+
+def test_perf_waterfall_unsampled_cluster(live):
+    """Without kvstore.trace_sample_every the subcommand reports the
+    empty state instead of erroring — and the plain `breeze perf`
+    group default still renders ordinary traces."""
+    out = invoke(live, "a", "perf", "waterfall")
+    assert "no completed flood traces" in out

@@ -1,0 +1,164 @@
+"""Flight recorder + fleet aggregation: ring semantics, the Counters
+attachment, module record sites, the invariant-failure dump artifact,
+and the cross-node counter distribution math behind
+`breeze monitor fleet` / `Cluster.fleet_counters`."""
+
+import asyncio
+import json
+import os
+
+from openr_tpu.emulator import invariants
+from openr_tpu.emulator.cluster import Cluster
+from openr_tpu.monitor.counters import Counters
+from openr_tpu.monitor.fleet import aggregate_counters, fleet_rows
+from openr_tpu.monitor.flight import FlightRecorder
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_ring_bounded_and_ordered():
+    fr = FlightRecorder(node="a", capacity=4)
+    for i in range(10):
+        fr.record("k", i=i)
+    assert len(fr) == 4
+    assert fr.recorded == 10
+    dump = fr.dump()
+    assert [e["attrs"]["i"] for e in dump] == [6, 7, 8, 9]  # oldest first
+    assert [e["seq"] for e in dump] == sorted(e["seq"] for e in dump)
+    assert fr.dump(limit=2)[0]["attrs"]["i"] == 8
+    fr.clear()
+    assert len(fr) == 0 and fr.recorded == 10
+
+
+def test_counters_flight_record_attachment():
+    c = Counters()
+    c.flight_record("noop", x=1)  # no recorder attached: silent no-op
+    fr = FlightRecorder(node="a")
+    c.flight = fr
+    c.flight_record("decision.rebuild", path="full", ms=1.5)
+    assert len(fr) == 1
+    ev = fr.dump()[0]
+    assert ev["kind"] == "decision.rebuild"
+    assert ev["attrs"] == {"path": "full", "ms": 1.5}
+    json.dumps(fr.dump())  # the dump must stay jsonable
+
+
+def test_module_record_sites_populate_ring():
+    """A started cluster's normal life (peer up, fan-outs, rebuilds)
+    must land in every node's ring through the existing Counters
+    plumbing — no dedicated wiring per module."""
+
+    async def body():
+        c = Cluster.from_edges([("a", "b"), ("b", "c")], solver="cpu")
+        await c.start()
+        try:
+            await c.wait_converged(timeout=30.0)
+            for name, node in c.nodes.items():
+                kinds = {e["kind"] for e in node.flight.dump()}
+                assert "kvstore.peer_up" in kinds, (name, kinds)
+                assert "decision.rebuild" in kinds, (name, kinds)
+                assert "kvstore.flood_fanout" in kinds, (name, kinds)
+        finally:
+            await c.stop()
+
+    run(body())
+
+
+# -------------------------------------------------- invariant-fail dump
+
+
+def test_dump_flight_recorders_writes_artifact():
+    c = Cluster.from_edges([("a", "b")], solver="cpu")  # not started
+    c.nodes["a"].flight.record("test.event", detail="x")
+    v = [invariants.Violation("kvstore.divergence", "a", "differs")]
+    d = invariants.dump_flight_recorders(c, v, label="unit-test")
+    assert d is not None and os.path.isdir(d)
+    # violations naming only node a → only a dumped
+    assert sorted(os.listdir(d)) == ["a.json"]
+    payload = json.load(open(os.path.join(d, "a.json")))
+    assert payload["node"] == "a" and payload["label"] == "unit-test"
+    assert payload["events"][0]["kind"] == "test.event"
+    assert "counters" in payload
+    assert payload["violations"] == ["kvstore.divergence: [a] differs"]
+
+
+def test_dump_widens_to_all_nodes_for_cluster_checks():
+    c = Cluster.from_edges([("a", "b")], solver="cpu")
+    v = [invariants.Violation("cluster.unconverged", None, "nope")]
+    d = invariants.dump_flight_recorders(c, v)
+    assert sorted(os.listdir(d)) == ["a.json", "b.json"]
+
+
+def test_wait_quiescent_failure_attaches_dump():
+    """The automatic path: a quiescence timeout must embed the dump
+    directory in the failure message next to the replay context."""
+
+    async def body():
+        c = Cluster.from_edges([("a", "b")], solver="cpu")  # never started
+        try:
+            await invariants.wait_quiescent(
+                c, timeout_s=0.3, poll_s=0.05, context="seed=123"
+            )
+        except AssertionError as e:
+            msg = str(e)
+            assert "seed=123" in msg
+            assert "flight-recorder dumps: " in msg
+            d = msg.rsplit("flight-recorder dumps: ", 1)[1].strip()
+            assert os.path.isdir(d)
+            assert sorted(os.listdir(d)) == ["a.json", "b.json"]
+        else:
+            raise AssertionError("expected quiescence failure")
+
+    run(body())
+
+
+# ----------------------------------------------------------- fleet math
+
+
+def test_aggregate_counters_distributions():
+    snaps = {
+        f"n{i}": {"kvstore.floods_sent": float(i), "only.on.n3": 7.0}
+        if i == 3
+        else {"kvstore.floods_sent": float(i)}
+        for i in range(10)
+    }
+    agg = aggregate_counters(snaps)
+    d = agg["kvstore.floods_sent"]
+    assert d["nodes"] == 10
+    assert d["min"] == 0.0 and d["max"] == 9.0 and d["max_node"] == "n9"
+    assert d["p50"] == 5.0 and d["p99"] == 9.0
+    assert d["sum"] == 45.0
+    assert agg["only.on.n3"]["nodes"] == 1  # partial keys aggregate honestly
+    # prefix filter
+    assert set(aggregate_counters(snaps, prefix="only.")) == {"only.on.n3"}
+    rows = fleet_rows(agg, limit=1)
+    assert len(rows) == 1 and rows[0][0] == "kvstore.floods_sent"
+
+
+def test_cluster_fleet_counters():
+    async def body():
+        c = Cluster.from_edges([("a", "b"), ("b", "c")], solver="cpu")
+        await c.start()
+        try:
+            await c.wait_converged(timeout=30.0)
+            agg = c.fleet_counters(prefix="kvstore.")
+            d = agg["kvstore.floods_sent"]
+            assert d["nodes"] == 3 and d["max"] >= d["p50"] >= d["min"]
+            assert d["max_node"] in c.nodes
+        finally:
+            await c.stop()
+
+    run(body())
+
+
+def test_dump_limit_zero_and_none():
+    fr = FlightRecorder(node="a", capacity=8)
+    for i in range(5):
+        fr.record("k", i=i)
+    assert fr.dump(limit=0) == []
+    assert len(fr.dump(limit=None)) == 5

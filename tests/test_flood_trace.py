@@ -1,0 +1,393 @@
+"""Cross-node flood tracing: packed span codec, hop stamping, per-hop
+eviction guard, waterfall/attribution/tree math, wire round trips, and
+the end-to-end emulator contract — a sampled origination completes
+multi-hop spans cluster-wide that the ctrl API exports with waterfalls
+attributing ~100% of the end-to-end time."""
+
+import asyncio
+from dataclasses import replace
+
+from openr_tpu.emulator import tracing
+from openr_tpu.emulator.cluster import Cluster
+from openr_tpu.monitor import flood_trace, perf
+from openr_tpu.monitor.perf import FloodSpan, HopSpan, PerfEvents
+from openr_tpu.rpc import RpcClient
+from openr_tpu.types.kvstore import Publication
+from openr_tpu.types.serde import (
+    from_jsonable,
+    from_wire_bin,
+    to_jsonable,
+    to_wire_bin,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ----------------------------------------------------------- span codec
+
+
+def test_pack_unpack_roundtrip_exact():
+    span = FloodSpan(
+        trace_id=(1 << 62) + 12345,
+        origin="origin-node",
+        origin_ts_ns=10_000_000_000_000,
+        hops=[
+            HopSpan("origin-node", 0, 10_000_000_000_000,
+                    10_000_000_050_000, 10_000_000_060_000),
+            # unset enq/tx (leaf that never fanned out)
+            HopSpan("leaf", 1, 10_000_002_000_000, 0, 0),
+            # cross-clock-domain regression: rx EARLIER than upstream
+            HopSpan("other-host", 2, 9_999_999_000_000,
+                    9_999_999_100_000, 9_999_999_100_000),
+        ],
+    )
+    got = perf.unpack_span(perf.pack_span(span))
+    assert got is not None
+    assert got.trace_id == span.trace_id
+    assert got.origin == span.origin
+    assert got.origin_ts_ns == span.origin_ts_ns
+    assert [
+        (h.node, h.hop, h.rx_ns, h.enq_ns, h.tx_ns) for h in got.hops
+    ] == [
+        (h.node, h.hop, h.rx_ns, h.enq_ns, h.tx_ns) for h in span.hops
+    ]
+
+
+def test_unpack_garbage_and_unknown_version():
+    assert perf.unpack_span(b"") is None
+    assert perf.unpack_span(b"\xff\x01\x02") is None  # unknown version
+    # truncated payload: best-effort None, never a raise
+    blob = perf.pack_span(
+        FloodSpan(5, "a", 100, [HopSpan("a", 0, 100, 110, 120)])
+    )
+    for cut in range(1, len(blob)):
+        perf.unpack_span(blob[:cut])  # must not raise
+
+
+def test_stamp_lifecycle_and_lazy_unpack():
+    pe = PerfEvents()
+    assert pe.trace_id == 0 and pe.hops == []
+    assert pe.stamp_hop_rx("x") is False  # untraced: no-op
+    pe.begin_flood_trace("a", trace_id=7)
+    assert pe.trace_id == 7 and pe.origin == "a"
+    assert len(pe.hops) == 1 and pe.hops[0].rx_ns == pe.origin_ts_ns
+    pe.stamp_hop_fanout("a")
+    assert pe.hops[0].enq_ns >= pe.hops[0].rx_ns
+    assert pe.hops[0].tx_ns == pe.hops[0].enq_ns
+    assert pe.stamp_hop_rx("b") is True
+    assert pe.stamp_hop_rx("b") is False  # duplicate suppressed
+    assert [h.hop for h in pe.hops] == [0, 1]
+    # span_bin is always wire-current: a fresh decode sees every stamp
+    rt = PerfEvents(events=[], span_bin=pe.span_bin)
+    assert [h.node for h in rt.hops] == ["a", "b"]
+
+
+def test_copy_isolates_span_mutation():
+    pe = PerfEvents()
+    pe.begin_flood_trace("a", trace_id=9)
+    cp = pe.copy()
+    pe.stamp_hop_rx("b")
+    assert len(pe.hops) == 2
+    assert len(cp.hops) == 1  # the copy froze pre-stamp bytes
+
+
+def test_merge_keeps_first_span_identity():
+    a = PerfEvents()
+    a.begin_flood_trace("a", trace_id=11)
+    b = PerfEvents()
+    b.begin_flood_trace("b", trace_id=22)
+    merged = a.merge(b)
+    assert merged.trace_id == 11  # no chain splicing
+    untr = PerfEvents()
+    assert untr.merge(b).trace_id == 22  # other's span adopted
+
+
+# ------------------------------------------- per-hop keep-one eviction
+
+
+def test_eviction_preserves_one_marker_per_hop():
+    """The ring-eviction guard (satellite): a full trace must never
+    evict an interior node's LAST marker — the waterfall would silently
+    lose that hop."""
+    pe = PerfEvents()
+    pe.add_perf_event("ORIGIN", node="origin", ts_ns=1)
+    pe.add_perf_event("RX", node="interior-1", ts_ns=2)
+    pe.add_perf_event("RX", node="interior-2", ts_ns=3)
+    # flood the trace with one chatty node's markers
+    for i in range(3 * perf.MAX_EVENTS_PER_TRACE):
+        pe.add_perf_event("E", node="chatty", ts_ns=10 + i)
+    pe.add_perf_event("LAST", node="terminal", ts_ns=10_000)
+    assert len(pe.events) <= perf.MAX_EVENTS_PER_TRACE
+    nodes = {e.node for e in pe.events}
+    # every hop kept at least one stamp; origin + newest intact
+    assert {"origin", "interior-1", "interior-2", "terminal"} <= nodes
+    assert pe.events[0].node == "origin"
+    assert pe.last_event() == "LAST"
+
+
+def test_merge_cap_preserves_one_marker_per_node():
+    a = PerfEvents()
+    a.add_perf_event("ORIGIN", node="origin", ts_ns=1)
+    a.add_perf_event("RX", node="interior", ts_ns=2)
+    for i in range(perf.MAX_EVENTS_PER_TRACE):
+        a.add_perf_event("E", node="chatty", ts_ns=100 + i)
+    b = PerfEvents()
+    for i in range(perf.MAX_EVENTS_PER_TRACE):
+        b.add_perf_event("F", node="noisy", ts_ns=200 + i)
+    merged = a.merge(b)
+    assert {"origin", "interior"} <= {e.node for e in merged.events}
+    assert merged.events[0].node == "origin"
+
+
+# --------------------------------------------------------- wire compat
+
+
+def _mk_traced_pub() -> Publication:
+    pe = PerfEvents()
+    pe.add_perf_event(perf.NEIGHBOR_EVENT, node="a", ts_ns=50)
+    pe.begin_flood_trace("a", trace_id=99, ts_ns=100)
+    pe.stamp_hop_fanout("a", ts_ns=110)
+    pe.stamp_hop_rx("b", ts_ns=150)
+    return Publication(area="0", node_ids=["a"], perf_events=pe)
+
+
+def test_publication_span_binary_roundtrip():
+    pub = _mk_traced_pub()
+    rt = from_wire_bin(to_wire_bin(pub), Publication)
+    got = rt.perf_events
+    assert got.trace_id == 99 and got.origin == "a"
+    assert [(h.node, h.rx_ns, h.enq_ns, h.tx_ns) for h in got.hops] == [
+        ("a", 100, 110, 110),
+        ("b", 150, 0, 0),
+    ]
+
+
+def test_publication_span_json_roundtrip():
+    pub = _mk_traced_pub()
+    rt = from_jsonable(to_jsonable(pub), Publication)
+    assert rt.perf_events.trace_id == 99
+    assert len(rt.perf_events.hops) == 2
+
+
+def test_old_frame_without_span_defaults_clean():
+    """A pre-span peer's PerfEvents (events only) must decode with the
+    span defaulted off — append-only evolution, zero negotiation."""
+    old = {"events": [{"event": "X", "ts_ns": 5, "node": "a"}]}
+    pe = from_jsonable(old, PerfEvents)
+    assert pe.trace_id == 0 and pe.span_bin is None and pe.hops == []
+
+
+# ------------------------------------------------------ waterfall math
+
+
+def _synthetic_trace() -> dict:
+    ms = 1_000_000  # ns per ms
+    base = 100 * ms  # 0 means "never stamped" — keep synthetics nonzero
+    tr = {
+        "trace_id": 42,
+        "origin": "a",
+        "origin_ts_ns": 0,
+        "hops": [
+            {"node": "a", "hop": 0, "rx_ns": 0, "enq_ns": 1 * ms,
+             "tx_ns": 2 * ms},
+            {"node": "b", "hop": 1, "rx_ns": 5 * ms, "enq_ns": 6 * ms,
+             "tx_ns": 6 * ms},
+            {"node": "c", "hop": 2, "rx_ns": 9 * ms, "enq_ns": 0,
+             "tx_ns": 0},
+        ],
+        "events": [
+            {"event": perf.DECISION_RECEIVED, "ts_ns": 10 * ms, "node": "c"},
+            {"event": perf.DECISION_DEBOUNCED, "ts_ns": 20 * ms, "node": "c"},
+            {"event": perf.SPF_SOLVE_DONE, "ts_ns": 24 * ms, "node": "c"},
+            {"event": perf.ROUTE_UPDATE_SENT, "ts_ns": 25 * ms, "node": "c"},
+            {"event": perf.FIB_PROGRAMMED, "ts_ns": 30 * ms, "node": "c"},
+            # a NON-terminal node's decision markers must not leak in
+            {"event": perf.FIB_PROGRAMMED, "ts_ns": 8 * ms, "node": "b"},
+        ],
+        "total_ms": 30.0,
+    }
+    tr["origin_ts_ns"] += base
+    for h in tr["hops"]:
+        for k in ("rx_ns", "enq_ns", "tx_ns"):
+            if h[k] or k == "rx_ns":
+                h[k] += base
+    for e in tr["events"]:
+        e["ts_ns"] += base
+    return tr
+
+
+def test_waterfall_stages_telescope_to_total():
+    w = flood_trace.waterfall(_synthetic_trace())
+    assert w is not None
+    assert w["terminal"] == "c" and w["hops"] == 2
+    assert w["total_ms"] == 30.0
+    assert abs(w["attributed_ms"] - 30.0) < 1e-9
+    assert w["coverage"] == 1.0
+    by = {}
+    for s in w["stages"]:
+        by[s["stage"]] = by.get(s["stage"], 0.0) + s["ms"]
+    assert by["kvstore_process"] == 2.0  # 1 (a) + 1 (b)
+    assert by["flood_encode"] == 1.0  # 1 (a) + 0 (b)
+    assert by["wire"] == 6.0  # 3 (a→b) + 3 (b→c)
+    assert by["decision_queue"] == 1.0
+    assert by["decision_debounce"] == 10.0
+    assert by["spf_solve"] == 4.0
+    assert by["route_dispatch"] == 1.0
+    assert by["fib_program"] == 5.0
+
+
+def test_waterfall_missing_stamp_reduces_coverage():
+    tr = _synthetic_trace()
+    tr["events"] = [
+        e for e in tr["events"]
+        if not (e["event"] == perf.DECISION_RECEIVED and e["node"] == "c")
+    ]
+    w = flood_trace.waterfall(tr)
+    # the rx→DEBOUNCED gap widens decision_debounce; still attributed
+    assert w["coverage"] == 1.0
+    tr2 = _synthetic_trace()
+    tr2["events"] = [
+        e for e in tr2["events"] if e["node"] != "c"
+    ]  # no terminal completion markers at all
+    assert flood_trace.waterfall(tr2) is None
+
+
+def test_attribution_and_tree():
+    traces = [_synthetic_trace(), _synthetic_trace()]
+    attr = flood_trace.attribution(traces)
+    assert attr["traces"] == 2 and attr["max_hops"] == 2
+    assert attr["coverage_p50"] == 1.0
+    assert attr["stages_p50_ms"]["wire"] == 6.0
+    tree = flood_trace.propagation_tree(traces)
+    assert tree[42]["edges"] == [("a", "b"), ("b", "c")]
+    assert tree[42]["completions"] == 2
+    assert tree[42]["max_hops"] == 2
+
+
+# ------------------------------------------------ end-to-end (emulator)
+
+
+def test_sampled_flood_trace_cluster_e2e():
+    """On a 4-node line with sampling=1, a prefix origination must
+    complete spans on every node — including a 3-hop span at the far
+    end — with waterfalls attributing ≥95% of each span's total, and
+    the ctrl API must export them."""
+
+    def transform(ncfg):
+        return replace(
+            ncfg,
+            kvstore=replace(
+                ncfg.kvstore, trace_sample_every=1, trace_seed=7
+            ),
+        )
+
+    async def body():
+        c = Cluster.from_edges(
+            [("a", "b"), ("b", "c"), ("c", "d")],
+            solver="cpu",
+            node_config_transform=transform,
+            enable_ctrl=True,
+        )
+        await c.start()
+        try:
+            await c.wait_converged(timeout=30.0)
+            from openr_tpu.prefixmgr.prefix_manager import (
+                PrefixEvent, PrefixEventType, PrefixSource,
+            )
+            from openr_tpu.types.network import IpPrefix
+            from openr_tpu.types.topology import PrefixEntry
+
+            c.nodes["a"].prefix_events.push(
+                PrefixEvent(
+                    type=PrefixEventType.ADD_PREFIXES,
+                    source=PrefixSource.API,
+                    entries=(
+                        PrefixEntry(prefix=IpPrefix.make("10.88.0.1/32")),
+                    ),
+                )
+            )
+            deadline = asyncio.get_running_loop().time() + 15.0
+            rep = None
+            while asyncio.get_running_loop().time() < deadline:
+                rep = tracing.trace_report(c)
+                if rep["max_hops"] >= 3:
+                    break
+                await asyncio.sleep(0.1)
+            assert rep is not None and rep["max_hops"] >= 3, rep
+            assert rep["completions"] >= 4
+            assert rep["waterfall_ok_frac"] >= 0.95
+            attr = rep["attribution"]
+            assert attr["coverage_p50"] >= 0.95
+            # every named stage family present in the p50 table
+            assert {"wire", "fib_program"} <= set(attr["stages_p50_ms"])
+            # flood-trace counters flowed
+            assert sum(
+                n.counters.get("kvstore.flood_traces_sampled")
+                for n in c.nodes.values()
+            ) >= 1
+            assert sum(
+                n.counters.get("kvstore.flood_hops")
+                for n in c.nodes.values()
+            ) >= 3
+            assert sum(
+                n.counters.get("monitor.flood_traces")
+                for n in c.nodes.values()
+            ) >= rep["completions"]
+
+            # ctrl export: the far node serves its spans + waterfalls
+            cli = RpcClient(port=c.nodes["d"].ctrl.port)
+            await cli.connect()
+            try:
+                res = await cli.call("get_flood_traces", {"limit": 50})
+                assert res["node"] == "d" and res["traces"]
+                got = res["traces"][-1]
+                assert got["trace_id"] and got["hops"]
+                assert got["waterfall"]["coverage"] >= 0.95
+            finally:
+                await cli.close()
+        finally:
+            await c.stop()
+
+    run(body())
+
+
+def test_wire_lean_keeps_origin_markers_only():
+    """The coalesced-flood wire path ships span traces LEAN: foreign
+    merged-in markers dropped, origin context kept, span untouched —
+    without this one sampled publication makes every deep relay frame
+    carry the full merged marker union (3x wire-seam cost at 64 nodes)."""
+    pe = PerfEvents()
+    pe.add_perf_event(perf.NEIGHBOR_EVENT, node="origin", ts_ns=1)
+    pe.begin_flood_trace("origin", trace_id=5, ts_ns=2)
+    fat = pe
+    for i in range(40):  # foreign traces merged in by per-peer coalescing
+        other = PerfEvents()
+        other.add_perf_event("KVSTORE_FLOODED", node=f"n{i}", ts_ns=100 + i)
+        fat = fat.merge(other)
+    assert len(fat.events) > PerfEvents._LEAN_EVENT_CAP
+    lean = fat.wire_lean()
+    assert lean is not fat
+    assert {e.node for e in lean.events} == {"origin"}
+    assert len(lean.events) <= PerfEvents._LEAN_EVENT_CAP
+    assert lean.trace_id == 5 and lean.span_bin == fat.span_bin
+    # untraced traces pass through untouched (identity)
+    untr = PerfEvents()
+    for i in range(20):
+        untr.add_perf_event("E", node=f"n{i}", ts_ns=i)
+    assert untr.wire_lean() is untr
+    # already-lean traced traces pass through untouched too
+    assert pe.wire_lean() is pe
+
+
+def test_wire_lean_overcap_keeps_origin_anchor_and_newest():
+    pe = PerfEvents()
+    pe.add_perf_event("M0", node="o", ts_ns=1)
+    pe.begin_flood_trace("o", trace_id=9, ts_ns=2)
+    for i in range(20):
+        pe.add_perf_event(f"M{i+1}", node="o", ts_ns=10 + i)
+    lean = pe.wire_lean()
+    assert len(lean.events) == PerfEvents._LEAN_EVENT_CAP
+    assert lean.events[0].event == "M0"  # origin anchor kept
+    assert lean.events[-1].event == "M20"  # newest stamp kept

@@ -1,0 +1,88 @@
+"""monitor/profiling.py coverage (previously untested): no-op
+degradation without jax, nested annotate spans, and the duration→
+Counters recording that puts solver phase timings on the Prometheus
+surface."""
+
+import sys
+import time
+
+from openr_tpu.monitor import profiling
+from openr_tpu.monitor.counters import Counters
+
+
+class _NoJax:
+    """monkeypatch sys.modules['jax'] to None → `import jax` raises
+    ImportError inside profiling's guarded imports."""
+
+
+def test_annotate_noop_without_jax(monkeypatch):
+    monkeypatch.setitem(sys.modules, "jax", None)
+    with profiling.annotate("spf:solve"):
+        pass  # must not raise
+
+
+def test_trace_noop_without_jax(monkeypatch, caplog):
+    monkeypatch.setitem(sys.modules, "jax", None)
+    with profiling.trace("/tmp/definitely-not-used"):
+        pass
+    assert any(
+        "profiler unavailable" in r.message for r in caplog.records
+    )
+
+
+def test_trace_falsy_dir_is_noop():
+    # no jax import at all on the falsy-dir path
+    with profiling.trace(None):
+        pass
+    with profiling.trace(""):
+        pass
+
+
+def test_annotate_records_duration_into_counters():
+    c = Counters()
+    with profiling.annotate("spf:solve", counters=c):
+        time.sleep(0.01)
+    s = c.stats.get("profile.spf:solve_ms")
+    assert s is not None and s.count == 1
+    assert s.last >= 5.0  # slept 10 ms; generous lower bound
+    # exported through the standard snapshot surface
+    snap = c.snapshot()
+    assert snap["profile.spf:solve_ms.count"] == 1
+
+
+def test_annotate_records_even_without_jax(monkeypatch):
+    monkeypatch.setitem(sys.modules, "jax", None)
+    c = Counters()
+    with profiling.annotate("spf:rib_assembly", counters=c):
+        pass
+    assert c.stats["profile.spf:rib_assembly_ms"].count == 1
+
+
+def test_nested_annotate_outer_includes_inner():
+    c = Counters()
+    with profiling.annotate("outer", counters=c):
+        with profiling.annotate("inner", counters=c):
+            time.sleep(0.005)
+    outer = c.stats["profile.outer_ms"]
+    inner = c.stats["profile.inner_ms"]
+    assert outer.count == 1 and inner.count == 1
+    # xprof-timeline semantics: the outer span contains the inner one
+    assert outer.last >= inner.last
+
+
+def test_annotate_duration_recorded_on_exception():
+    c = Counters()
+    try:
+        with profiling.annotate("boom", counters=c):
+            raise RuntimeError("solver failed")
+    except RuntimeError:
+        pass
+    assert c.stats["profile.boom_ms"].count == 1
+
+
+def test_annotate_reentrant_fresh_instances():
+    c = Counters()
+    for _ in range(3):
+        with profiling.annotate("loop", counters=c):
+            pass
+    assert c.stats["profile.loop_ms"].count == 3
