@@ -1095,6 +1095,32 @@ def _measure(tpu_ok: bool, extra_detail: dict) -> None:
     _sidecar_flush(part)
     print(json.dumps(out))
 
+    # bench-history sentinel (benchmarks/history.py): append this run's
+    # row plus the compile-ledger and kernel-cost snapshots keyed by
+    # host fingerprint, then warn when a headline metric drifted >25%
+    # vs the median of prior same-fingerprint runs. Best-effort: a
+    # read-only checkout must never fail the measurement.
+    try:
+        from benchmarks.history import (
+            append_row,
+            check_history,
+            load_history,
+        )
+        from openr_tpu.monitor import device as device_telemetry
+
+        append_row(
+            out,
+            compiles=led.snapshot().per_fn,
+            kernel_cost={
+                k: r.to_jsonable()
+                for k, r in device_telemetry.kernel_rows().items()
+            },
+        )
+        for w in check_history(load_history()):
+            print(f"# bench-history REGRESSION: {w}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — history must never fail a run
+        print(f"# bench-history unavailable: {e}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     try:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -30,6 +31,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import numpy as np  # noqa: E402
 
 from openr_tpu.common.tasks import guard_task, reap  # noqa: E402
+
+
+def _bench_trace():
+    """OPENR_BENCH_TRACE=<dir> xprof trace hook shared by the measured
+    stages (same contract as bench.py's headline loop): a no-op when
+    unset or the profiler is unavailable (monitor/profiling.py)."""
+    from openr_tpu.monitor import profiling
+
+    return profiling.trace(os.environ.get("OPENR_BENCH_TRACE"))
 
 
 def build_decision(
@@ -461,7 +471,9 @@ def measure_topo_churn(
                     parity[0] = "ok"
         return samples, solves0, parity_solves
 
-    samples, solves0, parity_solves = asyncio.run(run())
+    # OPENR_BENCH_TRACE=<dir> captures an xprof trace of the churn rounds
+    with _bench_trace():
+        samples, solves0, parity_solves = asyncio.run(run())
     steady_compiles = led.compiles_since_warm()
     led.reset_warm()
     arr = np.array(samples) if samples else np.array([0.0])
@@ -902,7 +914,10 @@ def measure_flood(
         finally:
             await c.stop()
 
-    return asyncio.run(run())
+    # OPENR_BENCH_TRACE=<dir> wraps the whole flood run (churn + flap +
+    # anti-entropy stages) in an xprof trace
+    with _bench_trace():
+        return asyncio.run(run())
 
 
 def _smoke_gate(label: str, scoped: dict, checks: dict[str, bool]) -> None:

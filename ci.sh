@@ -12,6 +12,15 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# smoke lanes tee their scratch logs HERE, never into the worktree (a
+# stray trace_smoke.err at the repo root prompted this): /tmp scratch
+# survives the run for diagnosis and can't pollute git status
+SMOKE_LOG_DIR="${SMOKE_LOG_DIR:-/tmp/openr-ci-logs}"
+mkdir -p "$SMOKE_LOG_DIR"
+smoke_log() {  # usage: some_lane 2> >(smoke_log <name>)
+    tee "$SMOKE_LOG_DIR/$1.err" >&2
+}
+
 echo "== native build =="
 make -C native
 
@@ -60,14 +69,16 @@ echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # state under churn must be pure jit-cache hits (docs/Linting.md
 # OR008-OR010)
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
-    --topo-churn --nodes 320 --topo-rounds 30 --smoke --backend cpu
+    --topo-churn --nodes 320 --topo-rounds 30 --smoke --backend cpu \
+    2> >(smoke_log topo_churn_smoke)
 
 echo "== prefix-churn smoke (scoped-path counters + compile ledger gate) =="
 # the prefix-only rebuild path under the same zero-steady-state-
 # recompile gate: every churn round must be decision.rebuild.
 # prefix_only with zero SPF solves and zero post-warmup compiles
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
-    --prefix-churn --nodes 80 --prefix-rounds 40 --smoke --backend cpu
+    --prefix-churn --nodes 80 --prefix-rounds 40 --smoke --backend cpu \
+    2> >(smoke_log prefix_churn_smoke)
 
 echo "== 100k-prefix data-plane smoke (vectorized election + delta FIB) =="
 # the million-prefix pipeline at CI scale: one 100k-prefix rung through
@@ -77,7 +88,7 @@ echo "== 100k-prefix data-plane smoke (vectorized election + delta FIB) =="
 # post-warmup XLA compiles landed (PR 7 ledger), and the idle FIB
 # program pass scanned zero routes (the O(1) delta-book contract)
 JAX_PLATFORMS=cpu python benchmarks/bench_prefix_scale.py --smoke \
-    --prefixes 100000 --nodes 512
+    --prefixes 100000 --nodes 512 2> >(smoke_log prefix_scale_smoke)
 
 echo "== flood-throughput smoke (binary wire vs JSON baseline) =="
 # the wire-format acceptance gate (docs/Wire.md): on a small emulated
@@ -89,7 +100,7 @@ echo "== flood-throughput smoke (binary wire vs JSON baseline) =="
 # the emulator invariant checker stayed clean on both codecs
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --flood-bench --flood-side 4 --flood-events 120 --flood-flaps 2 \
-    --smoke --backend cpu
+    --smoke --backend cpu 2> >(smoke_log flood_bench_smoke)
 
 echo "== flood-trace smoke (hop-span waterfall + overhead gate) =="
 # the cluster observability gate (docs/Monitor.md "Flood tracing"): on
@@ -105,7 +116,26 @@ echo "== flood-trace smoke (hop-span waterfall + overhead gate) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --flood-trace --flood-trace-every 16 --flood-repeats 2 \
     --flood-side 4 --flood-events 120 --flood-flaps 1 \
-    --smoke --backend cpu
+    --smoke --backend cpu 2> >(smoke_log trace_smoke)
+
+echo "== device-telemetry smoke (kernel cost ledger + ctrl export) =="
+# the device telemetry gate (docs/Monitor.md "Device telemetry"): on
+# the CPU backend every canonical jitted kernel entry point (split RIB
+# solve, batched split/dense/edge kernels, sharded split over a 2x2
+# mesh, device election, KSP, pallas) must own a captured
+# cost_analysis/memory_analysis row, a live node must serve them
+# through ctrl get_device_telemetry with HBM gauges explicitly
+# degraded, and re-running everything post-warmup must add ZERO XLA
+# compiles — the capture path itself is compile-ledger gated
+JAX_PLATFORMS=cpu python benchmarks/bench_device_telemetry.py --smoke \
+    2> >(smoke_log device_telemetry_smoke)
+
+echo "== bench-history sentinel (warn-only) =="
+# flags >25% drift of the newest BENCH_HISTORY.jsonl row's headline
+# metrics vs the median of prior same-fingerprint runs
+# (benchmarks/history.py). Warn-only by design: bench variance on
+# burstable CI hosts is real, so the lane reports, never blocks
+JAX_PLATFORMS=cpu python benchmarks/history.py --check || true
 
 echo "== serde micro-bench (encode/decode ns per Publication) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_serde.py --iters 500

@@ -1161,6 +1161,117 @@ def monitor_flight(ctx, limit, kind):
         click.echo(f"{ts}  {e['kind']:<26} {attrs}")
 
 
+# --------------------------------------------------------------------- device
+
+
+@cli.group()
+def device():
+    """Device telemetry: kernel cost ledger + HBM gauges
+    (docs/Monitor.md "Device telemetry")."""
+
+
+@device.command("kernels")
+@click.pass_context
+def device_kernels(ctx):
+    """Kernel cost ledger joined with measured span times: per canonical
+    jitted entry point, XLA's static flops / bytes-accessed / resident
+    HBM, the measured `profile.<span>_ms` p50, and the achieved
+    GFLOP/s / GB/s that join implies — the static-vs-achieved view the
+    sparse-kernel selection heuristic reads (docs/Decision.md)."""
+    res = _run(ctx, "get_device_telemetry")
+    kernels = res.get("kernels") or []
+    if not kernels:
+        click.echo(
+            "no kernel cost rows captured yet (no jitted solve has "
+            "traced on this node's process)"
+        )
+        return
+
+    def mb(v):
+        return f"{v / 1e6:.2f}" if v else "0"
+
+    rows = []
+    for k in kernels:
+        if k.get("error"):
+            rows.append([k["fn"], k.get("span") or "-", "ERR", k["error"],
+                         "-", "-", "-", "-"])
+            continue
+        rows.append(
+            [
+                k["fn"],
+                k.get("span") or "-",
+                f"{k['flops']:.3g}",
+                f"{k['bytes_accessed']:.3g}",
+                mb(k["resident_hbm_bytes"]),
+                (
+                    f"{k['span_p50_ms']:.3f}"
+                    if k.get("span_p50_ms") is not None
+                    else "-"
+                ),
+                (
+                    f"{k['achieved_gflops']:g}"
+                    if k.get("achieved_gflops") is not None
+                    else "-"
+                ),
+                (
+                    f"{k['achieved_gbs']:g}"
+                    if k.get("achieved_gbs") is not None
+                    else "-"
+                ),
+            ]
+        )
+    click.echo(
+        _table(
+            rows,
+            ["kernel", "span", "flops", "bytes", "hbm-MB", "p50-ms",
+             "GFLOP/s", "GB/s"],
+        )
+    )
+    if res.get("shards"):
+        click.echo("")
+        srows = [
+            [
+                str(s["device"]),
+                s["platform"],
+                "x".join(str(d) for d in s["shard_shape"]),
+                f"{s['shard_bytes'] / 1e6:.2f}",
+            ]
+            for s in res["shards"]
+        ]
+        click.echo(
+            _table(srows, ["device", "platform", "shard", "MB"])
+        )
+
+
+@device.command("hbm")
+@click.pass_context
+def device_hbm(ctx):
+    """Per-device HBM gauges (live / peak / limit bytes) from
+    memory_stats(); degrades to an explicit note on backends without
+    them (CPU)."""
+    res = _run(ctx, "get_device_telemetry")
+    devices = res.get("devices") or []
+    if not devices:
+        click.echo(
+            "hbm telemetry unavailable (backend exposes no "
+            "memory_stats — e.g. cpu)"
+        )
+        return
+    rows = [
+        [
+            str(d["device"]),
+            d["kind"],
+            f"{d['hbm_bytes_in_use'] / 1e6:.1f}",
+            f"{d['hbm_peak_bytes'] / 1e6:.1f}",
+            f"{d['hbm_limit_bytes'] / 1e6:.1f}" if d["hbm_limit_bytes"] else "-",
+        ]
+        for d in devices
+    ]
+    click.echo(
+        _table(rows, ["device", "kind", "in-use-MB", "peak-MB", "limit-MB"])
+    )
+
+
 @monitor.command("logs")
 @click.option("--limit", default=50, show_default=True, type=int)
 @click.option("--event", default=None, help="filter by event name")

@@ -144,6 +144,7 @@ class CtrlServer(OpenrModule):
             "set_rib_policy", "get_rib_policy", "get_event_logs",
             "get_perf_events", "get_counters_prometheus",
             "get_flood_traces", "get_flight_recorder",
+            "get_device_telemetry",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -224,6 +225,28 @@ class CtrlServer(OpenrModule):
             "capacity": fr.capacity,
             "events": fr.dump(
                 limit=int(limit) if limit is not None else None
+            ),
+        }
+
+    async def get_device_telemetry(self, params: dict) -> dict:
+        """Device telemetry plane (docs/Monitor.md "Device telemetry"):
+        the process-wide kernel cost ledger joined server-side with
+        this node's measured span stats into achieved-throughput rows,
+        plus per-device HBM gauges (None-degraded on CPU backends) and
+        the last sharded solve's per-device shard layout."""
+        from openr_tpu.monitor import device as device_telemetry
+
+        rows = device_telemetry.kernel_rows()
+        snap = self.node.counters.snapshot()
+        dec = getattr(self.node, "decision", None)
+        solver = getattr(dec, "_tpu", None) if dec is not None else None
+        return {
+            "node": self.node.name,
+            "kernels": device_telemetry.efficiency_rows(rows, snap),
+            "devices": device_telemetry.sample_hbm() or [],
+            "hbm_available": bool(device_telemetry.telemetry().hbm_available),
+            "shards": (
+                list(solver.last_shard_rows) if solver is not None else []
             ),
         }
 

@@ -35,6 +35,7 @@ from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
 from openr_tpu.decision.oracle import metric_key
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
 from openr_tpu.monitor import compile_ledger, perf
+from openr_tpu.monitor import device as device_telemetry
 from openr_tpu.types.kvstore import Publication, Value
 from openr_tpu.types.routes import (
     RouteDatabase,
@@ -1284,6 +1285,13 @@ class Decision(OpenrModule):
                 # captured it, flatlining the metrics where compiles
                 # can occur)
                 compile_ledger.export_to(self.counters)
+                # device telemetry plane (monitor/device.py): kernel
+                # cost rows captured at trace time + per-device HBM
+                # gauges sampled at this rebuild edge. Same TPU-branch
+                # rule as the compile ledger — only the jitting engine
+                # has device executables to account
+                device_telemetry.export_to(self.counters)
+                device_telemetry.sample_hbm(self.counters)
             else:
                 self.counters.set(
                     "decision.nexthop_groups",

@@ -37,6 +37,7 @@ from openr_tpu.decision.ksp import (
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
 from openr_tpu.decision.oracle import SolveArtifact, metric_key
 from openr_tpu.monitor import compile_ledger, profiling
+from openr_tpu.monitor import device as device_telemetry
 from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
     INF_DIST,
@@ -325,6 +326,10 @@ class TpuSpfSolver:
         self.elect_stats = {
             "plain": 0, "multi": 0, "complex": 0, "device_elections": 0,
         }
+        # per-device shard layout of the last sharded solve's output
+        # (monitor/device.shard_rows — metadata only, no device sync);
+        # empty until a mesh-sharded solve runs
+        self.last_shard_rows: list[dict] = []
 
     def _device_arrays(self, csr, want: str):
         """Cached (and incrementally patched) device copies of the LSDB.
@@ -551,12 +556,33 @@ class TpuSpfSolver:
                 if self._mesh_fits(dev, roots):
                     from openr_tpu.parallel import sharded_sssp_split
 
-                    return sharded_sssp_split(
-                        dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
-                        dev["ov_nbr"], dev["ov_wgt"], dev["over"],
-                        jnp.asarray(roots), self.mesh,
-                        has_overloads=has_over,
+                    # per-shard span: dispatch wall only (the caller's
+                    # materialization pays completion — same contract as
+                    # the other _solve_dist paths); the output's
+                    # per-device shard layout is kept for ctrl/breeze
+                    with profiling.annotate(
+                        "spf:sharded_solve", counters=self.counters
+                    ):
+                        out = sharded_sssp_split(
+                            dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                            dev["ov_nbr"], dev["ov_wgt"], dev["over"],
+                            jnp.asarray(roots), self.mesh,
+                            has_overloads=has_over,
+                        )
+                    device_telemetry.observe(
+                        "sharded_sssp_split",
+                        lambda: sharded_sssp_split.lower(
+                            dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                            dev["ov_nbr"], dev["ov_wgt"], dev["over"],
+                            jnp.asarray(roots), self.mesh,
+                            has_overloads=has_over,
+                        ),
+                        span="spf:sharded_solve",
+                        # dispatch-only span (async return)
+                        span_complete=False,
                     )
+                    self.last_shard_rows = device_telemetry.shard_rows(out)
+                    return out
                 if not self._mesh_fallback_warned:
                     self._mesh_fallback_warned = True
                     log.warning(
@@ -566,11 +592,23 @@ class TpuSpfSolver:
                         dict(self.mesh.shape), dev["vp"], len(roots),
                     )
             gs = self._pick_gs_and_count(dev)
-            return batched_sssp_split(
+            out = batched_sssp_split(
                 dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
                 dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"], dev["over"],
                 jnp.asarray(roots), has_overloads=has_over, gs_chunks=gs,
             )
+            device_telemetry.observe(
+                "batched_sssp_split",
+                lambda: batched_sssp_split.lower(
+                    dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                    dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"],
+                    dev["over"], jnp.asarray(roots),
+                    has_overloads=has_over, gs_chunks=gs,
+                ),
+                span="spf:batched_dist",
+                span_complete=False,  # dispatch-only span (async return)
+            )
+            return out
         if table == "dense":
             if self.use_pallas:
                 from openr_tpu.ops.spf_pallas import (
@@ -585,14 +623,24 @@ class TpuSpfSolver:
                         dev["nbr"], dev["wgt"], dev["over"],
                         jnp.asarray(roots), has_overloads=has_over,
                     )
-            return batched_sssp_dense(
+            out = batched_sssp_dense(
                 dev["nbr"],
                 dev["wgt"],
                 dev["over"],
                 jnp.asarray(roots),
                 has_overloads=has_over,
             )
-        return batched_sssp(
+            device_telemetry.observe(
+                "batched_sssp_dense",
+                lambda: batched_sssp_dense.lower(
+                    dev["nbr"], dev["wgt"], dev["over"],
+                    jnp.asarray(roots), has_overloads=has_over,
+                ),
+                span="spf:batched_dist",
+                span_complete=False,  # dispatch-only span (async return)
+            )
+            return out
+        out = batched_sssp(
             dev["src"],
             dev["dst"],
             dev["metric"],
@@ -600,6 +648,16 @@ class TpuSpfSolver:
             jnp.asarray(roots),
             csr.padded_nodes,
         )
+        device_telemetry.observe(
+            "batched_sssp",
+            lambda: batched_sssp.lower(
+                dev["src"], dev["dst"], dev["metric"], dev["blocked"],
+                jnp.asarray(roots), csr.padded_nodes,
+            ),
+            span="spf:batched_dist",
+            span_complete=False,  # dispatch-only span (async return)
+        )
+        return out
 
     def _pick_gs_and_count(self, dev: dict) -> int:
         """Gauss-Seidel chunk pick + the regime observability counters
@@ -749,10 +807,33 @@ class TpuSpfSolver:
                 )
                 buf = np.asarray(packed)
                 compile_ledger.record_transfer(buf.nbytes)
+            # kernel cost ledger (docs/Monitor.md "Device telemetry"):
+            # only re-lowers when the compile ledger saw a fresh compile
+            # of this fn — a pure dict probe in steady state
+            device_telemetry.observe(
+                "batched_sssp_split_rib",
+                lambda: batched_sssp_split_rib.lower(
+                    dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                    dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"],
+                    dev["over"], jnp.asarray(roots),
+                    jnp.asarray(nbr_metric), jnp.asarray(nbr_ids_p),
+                    jnp.asarray(nbr_over), jnp.int32(my_id),
+                    has_overloads=has_over,
+                    with_lfa=self.enable_lfa,
+                    gs_chunks=gs,
+                ),
+                span="spf:batched_solve",
+            )
             d_root, fh, lfa = unpack_rib_buffer(buf, vp, b, self.enable_lfa)
             return csr, _LazyDist(dist_dev, d_root), fh, nbr_ids, lfa
 
-        with profiling.annotate("spf:batched_solve", counters=self.counters):
+        # distinct span from the fused split-RIB path's
+        # spf:batched_solve: this one ends at the ASYNC dispatch return
+        # (fh materializes below, outside it) — pooling its sub-ms
+        # samples into the completion-walled stat would drag that p50
+        # below any real solve and corrupt the efficiency join
+        # (review finding)
+        with profiling.annotate("spf:batched_dist", counters=self.counters):
             dist = self._solve_dist(
                 csr, roots, _dispatched=(table, dev, has_over)
             )
@@ -763,6 +844,17 @@ class TpuSpfSolver:
                 jnp.asarray(nbr_ids_p),
                 jnp.asarray(nbr_over),
             )
+        )
+        device_telemetry.observe(
+            "first_hop_matrix",
+            lambda: first_hop_matrix.lower(
+                dist,
+                jnp.asarray(nbr_metric),
+                jnp.asarray(nbr_ids_p),
+                jnp.asarray(nbr_over),
+            ),
+            span="spf:batched_dist",
+            span_complete=False,  # dispatch-only span (async return)
         )
         lfa = None
         if self.enable_lfa:
@@ -1083,6 +1175,19 @@ class TpuSpfSolver:
                 )
                 buf = np.asarray(packed)
                 compile_ledger.record_transfer(buf.nbytes)
+            device_telemetry.observe(
+                "batched_sssp_split_warm_rib",
+                lambda: batched_sssp_split_warm_rib.lower(
+                    dev["base_nbr"], dev["base_wgt"], dev["ov_ids"],
+                    dev["ov_nbr"], dev["ov_wgt"], dev["out_nbr"],
+                    dev["over"], jnp.asarray(roots),
+                    jnp.asarray(nbr_metric), jnp.asarray(nbr_ids_p),
+                    jnp.asarray(nbr_over),
+                    dist_dev, jnp.asarray(seed),
+                    has_overloads=has_over, gs_chunks=gs,
+                ),
+                span="spf:warm_solve",
+            )
             d_root, fh, _ = unpack_rib_buffer(buf, vp, bb, False)
             self.solve_count += 1
             self.warm_solves += 1
@@ -1495,10 +1600,13 @@ class TpuSpfSolver:
 
             self.elect_stats["device_elections"] += 1
             self._elect_dev.pop(view_gen, None)  # refresh LRU position
-            out = elect_multi_device(
-                multi, np.asarray(d_root), reach, my_id,
-                dev_cache=self._elect_dev, gen=view_gen,
-            )
+            with profiling.annotate(
+                "spf:election", counters=self.counters
+            ):
+                out = elect_multi_device(
+                    multi, np.asarray(d_root), reach, my_id,
+                    dev_cache=self._elect_dev, gen=view_gen,
+                )
             while len(self._elect_dev) > self._dev_lru_cap:
                 self._elect_dev.pop(next(iter(self._elect_dev)))
             return out
@@ -1785,6 +1893,25 @@ class TpuSpfSolver:
             np.asarray(d_root[:m], dtype=np.int64), int(INF_DIST)
         ).astype(np.int32)
         dist0_dev = jnp.asarray(dist0)
+        # one span over the whole KSP batch phase (device chunks + host
+        # path decode) — the `profile.spf:ksp_ms` stat the device
+        # telemetry efficiency join reads (docs/Monitor.md)
+        with profiling.annotate("spf:ksp", counters=self.counters):
+            self._ksp_chunks(
+                jobs, dests, chunk, my_id, d_nbr, d_wgt, blocked, k_eff,
+                max_hops, dist0_dev, csr, ls, my_node, out,
+            )
+
+    def _ksp_chunks(
+        self, jobs, dests, chunk, my_id, d_nbr, d_wgt, blocked, k_eff,
+        max_hops, dist0_dev, csr, ls, my_node, out,
+    ) -> None:
+        from openr_tpu.ops.ksp import (
+            ksp_edge_disjoint_dense,
+            paths_to_host,
+        )
+        from openr_tpu.decision.ksp import ksp_route_from_paths
+
         for start in range(0, len(jobs), chunk):
             sub = dests[start : start + chunk]
             b = pad_batch(len(sub))

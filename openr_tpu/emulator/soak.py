@@ -94,6 +94,14 @@ class SoakConfig:
     # without bound, trips here instead of hiding inside total RSS
     # (the million-prefix data plane's leak class; docs/Decision.md)
     prefix_table_slack_mb: float = 24.0
+    # device-HBM watermark (monitor/device.py sample_hbm): summed live
+    # bytes_in_use across local devices must stay within this slack of
+    # the post-round-1 baseline — the leak class where device-resident
+    # LSDB table sets, warm distance matrices, or election matrices
+    # accumulate in HBM across churn rounds. Skipped (None samples) on
+    # backends without memory_stats (CPU), where the RSS watermark
+    # already covers the same arrays in host RAM.
+    hbm_slack_mb: float = 64.0
     # control knob: build the cluster with messaging bounds DISABLED
     # (caps stay configured, queues unbounded) to prove the watermark
     # checks catch unbounded growth
@@ -109,6 +117,7 @@ class RoundSample:
     schedule_hash: str
     warm_mb: float = 0.0  # summed Decision warm-start cache footprint
     prefix_mb: float = 0.0  # summed prefix-table + intern-table footprint
+    hbm_mb: float | None = None  # summed device bytes_in_use (None on cpu)
 
 
 @dataclass
@@ -120,10 +129,12 @@ class SoakReport:
         lines = [f"soak seed={self.seed}: {len(self.rounds)} round(s) clean"]
         for s in self.rounds:
             rss = f"{s.rss_mb:.0f}MB" if s.rss_mb is not None else "n/a"
+            hbm = f"{s.hbm_mb:.0f}MB" if s.hbm_mb is not None else "n/a"
             lines.append(
                 f"  round {s.round}: rss={rss} objects={s.objects} "
                 f"churn={s.churn_events} warm={s.warm_mb}MB "
-                f"prefix={s.prefix_mb}MB schedule={s.schedule_hash[:12]}"
+                f"prefix={s.prefix_mb}MB hbm={hbm} "
+                f"schedule={s.schedule_hash[:12]}"
             )
         return "\n".join(lines)
 
@@ -260,7 +271,9 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
         await cluster.wait_converged(timeout=cfg.quiesce_timeout_s)
         report = SoakReport(seed=cfg.seed)
         churn_rng = plan.rng("soak/churn")
-        baseline: tuple[float | None, int, float, float] | None = None
+        baseline: (
+            tuple[float | None, int, float, float, float | None] | None
+        ) = None
         for rnd in range(cfg.rounds):
             plan.active = True
             cluster.make_storm(
@@ -292,6 +305,13 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                 )
             except AssertionError as e:
                 raise SoakError(str(e)) from e
+            # HBM first: on a cpu-oracle soak this is the process's
+            # FIRST jax touch, and the import's ~60k live objects must
+            # land inside round 0's object-watermark baseline, not be
+            # charged to round 1 as a phantom leak
+            from openr_tpu.monitor import device as device_telemetry
+
+            hbm_mb = device_telemetry.hbm_in_use_mb()
             rss_mb, objects = _memory_sample()
             warm_mb = (
                 sum(
@@ -316,19 +336,32 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                     schedule_hash=plan.schedule_hash(),
                     warm_mb=round(warm_mb, 2),
                     prefix_mb=round(prefix_mb, 2),
+                    hbm_mb=None if hbm_mb is None else round(hbm_mb, 2),
                 )
             )
             log.info(
                 "soak round %d clean: rss=%s objects=%d churn=%d "
-                "warm=%.1fMB prefix=%.1fMB",
+                "warm=%.1fMB prefix=%.1fMB hbm=%s",
                 rnd, rss_mb, objects, churner.events, warm_mb, prefix_mb,
+                hbm_mb,
             )
             if rnd == 0:
                 # round 1 is the warmup baseline (JIT caches, interned
                 # bytes); monotone growth is judged from here on
-                baseline = (rss_mb, objects, warm_mb, prefix_mb)
+                baseline = (rss_mb, objects, warm_mb, prefix_mb, hbm_mb)
                 continue
-            base_rss, base_obj, base_warm, base_prefix = baseline
+            base_rss, base_obj, base_warm, base_prefix, base_hbm = baseline
+            if (
+                hbm_mb is not None
+                and base_hbm is not None
+                and hbm_mb > base_hbm + cfg.hbm_slack_mb
+            ):
+                raise SoakError(
+                    f"device-HBM watermark breach ({context}): "
+                    f"{hbm_mb:.1f}MB live device memory > baseline "
+                    f"{base_hbm:.1f}MB + {cfg.hbm_slack_mb:.0f}MB slack "
+                    "(device-resident tables or warm matrices leaking?)"
+                )
             if warm_mb > base_warm + cfg.warm_cache_slack_mb:
                 raise SoakError(
                     f"warm-cache watermark breach ({context}): "

@@ -174,6 +174,13 @@ class CompileLedger:
         with self._lock:
             return LedgerSnapshot(per_fn=dict(self._compiles))
 
+    def compiles_of(self, fn: str) -> int:
+        """Compile count of one jitted function (0 when never seen or
+        the ledger is not installed) — the device-telemetry recapture
+        trigger (monitor/device.py), cheap enough for hot paths."""
+        with self._lock:
+            return self._compiles.get(fn, 0)
+
     def mark_warm(self) -> None:
         """Declare warmup over: compiles after this point are
         steady-state violations (see compiles_since_warm)."""
@@ -233,6 +240,10 @@ def mark_warm() -> None:
 
 def record_transfer(nbytes: int) -> None:
     _LEDGER.record_transfer(nbytes)
+
+
+def compiles_of(fn: str) -> int:
+    return _LEDGER.compiles_of(fn)
 
 
 def export_to(counters) -> None:

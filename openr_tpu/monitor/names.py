@@ -183,6 +183,14 @@ TEMPLATES: dict[str, str | None] = {
     # per-jitted-function compile counts (monitor/compile_ledger.py) —
     # the fn segment is the jit wrapper's name
     "jax.compiles.*": "jax.compiles.<fn>",
+    # kernel cost ledger (monitor/device.py): XLA cost/memory analysis
+    # of each canonical jitted entry point, exported per (fn, field)
+    "jax.kernel.*.*": "jax.kernel.<fn>.<field>",
+    # per-device HBM gauges (monitor/device.py sample_hbm; absent on
+    # backends whose memory_stats() returns None — the CPU degradation)
+    "device.*.hbm_bytes_in_use": "device.<i>.hbm_bytes_in_use",
+    "device.*.hbm_peak_bytes": "device.<i>.hbm_peak_bytes",
+    "device.*.hbm_limit_bytes": "device.<i>.hbm_limit_bytes",
     # annotated profiling spans' wall durations (monitor/profiling.py
     # annotate(counters=...)) — the span segment is the annotation name
     "profile.*_ms": "profile.<span>_ms",

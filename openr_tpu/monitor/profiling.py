@@ -115,4 +115,15 @@ class _TimedSpan:
             f"profile.{self.name}_ms",
             (time.perf_counter() - self._t0) * 1e3,
         )
+        # annotate-boundary HBM sample (docs/Monitor.md "Device
+        # telemetry"): on backends with memory_stats this stamps the
+        # device.<i>.hbm_* gauges right after the device work the span
+        # wrapped; on CPU the first probe latches availability off and
+        # this is a single flag test per span
+        try:
+            from openr_tpu.monitor import device as _device
+
+            _device.sample_hbm(self.counters)
+        except Exception:  # noqa: BLE001 — profiling must never break prod
+            pass
         return False

@@ -93,15 +93,29 @@ def elect_multi_device(
             "rank": jnp.asarray(rank),
         }
         dev_cache[gen] = cached
+    from openr_tpu.monitor import device as device_telemetry
+
+    d_dev = jnp.asarray(d_vec.astype(np.int32))
+    reach_dev = jnp.asarray(reach_vec)
     best_r, min_igp, is_best, chosen, local = _elect_seg(
         cached["seg"],
         cached["adv"],
         cached["known"],
         cached["rank"],
-        jnp.asarray(d_vec.astype(np.int32)),
-        jnp.asarray(reach_vec),
+        d_dev,
+        reach_dev,
         jnp.int32(my_id),
         num_segments=mp,
+    )
+    # kernel cost ledger: recaptures only on a fresh compile (bucket
+    # outgrowth) — a steady-state election is one dict probe
+    device_telemetry.observe(
+        "_elect_seg",
+        lambda: _elect_seg.lower(
+            cached["seg"], cached["adv"], cached["known"], cached["rank"],
+            d_dev, reach_dev, jnp.int32(my_id), num_segments=mp,
+        ),
+        span="spf:election",
     )
     best_r = np.asarray(best_r)
     min_igp = np.asarray(min_igp)

@@ -264,15 +264,31 @@ def ksp_edge_disjoint_dense(
     array is coerced to its strong contract dtype here, once, so all
     equivalent call spellings share one compiled variant.
     """
-    return _ksp_edge_disjoint_dense_jit(
+    from openr_tpu.monitor import device as device_telemetry
+
+    args = (
         jnp.asarray(nbr, jnp.int32),
         jnp.asarray(wgt, jnp.int32),
         jnp.asarray(blocked, bool),
         jnp.asarray(root, jnp.int32),
         jnp.asarray(dests, jnp.int32),
-        k=k,
-        max_hops=max_hops,
-        dist0=None if dist0 is None else jnp.asarray(dist0, DIST_DTYPE),
+    )
+    d0 = None if dist0 is None else jnp.asarray(dist0, DIST_DTYPE)
+    # kernel cost ledger (docs/Monitor.md "Device telemetry"): lowers +
+    # AOT-compiles only when the compile ledger counted a fresh variant
+    # of this fn; the call below then reuses that executable (jit cache
+    # is shared with the AOT path — pinned by the telemetry smoke).
+    # Runs BEFORE the dispatch so the wrapper keeps its direct-return
+    # jit-delegation shape (the orlint jit registry follows it).
+    device_telemetry.observe(
+        "_ksp_edge_disjoint_dense_jit",
+        lambda: _ksp_edge_disjoint_dense_jit.lower(
+            *args, k=k, max_hops=max_hops, dist0=d0
+        ),
+        span="spf:ksp",
+    )
+    return _ksp_edge_disjoint_dense_jit(
+        *args, k=k, max_hops=max_hops, dist0=d0
     )
 
 

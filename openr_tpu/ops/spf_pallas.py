@@ -217,10 +217,26 @@ def batched_sssp_pallas(
         jnp.zeros_like(nbr, dtype=bool)
     )
 
-    for _ in range(vp):
+    from openr_tpu.monitor import device as device_telemetry
+
+    for sweep in range(vp):
         dist, changed = _relax_once(
             nbr, wgt, over_t, roots, dist, tile, has_overloads, interpret
         )
+        if sweep == 0:
+            # kernel cost ledger: one guarded capture per compiled
+            # variant, outside the (host-driven) sweep loop's hot part
+            device_telemetry.observe(
+                "_relax_once",
+                lambda: _relax_once.lower(
+                    nbr, wgt, over_t, roots, dist, tile, has_overloads,
+                    interpret,
+                ),
+                span="spf:batched_dist",
+                # one sweep's cost vs a whole-solve span: never join
+                # them into an achieved rate (review finding)
+                span_complete=False,
+            )
         # the per-sweep scalar readback IS this kernel's documented
         # design limitation (module docstring): interpreter-only
         # reference formulation; production solves use spf_split's

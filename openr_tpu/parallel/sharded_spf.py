@@ -270,4 +270,17 @@ def sharded_sssp_padded(
     dist = sharded_sssp(
         edge_src, edge_dst, edge_metric, edge_blocked, roots, mesh, num_nodes
     )
+    # kernel cost ledger (docs/Monitor.md "Device telemetry"): guarded
+    # capture of the sharded edge-list kernel's cost/memory analysis
+    from openr_tpu.monitor import device as device_telemetry
+
+    device_telemetry.observe(
+        "sharded_sssp",
+        lambda: sharded_sssp.lower(
+            edge_src, edge_dst, edge_metric, edge_blocked, roots, mesh,
+            num_nodes,
+        ),
+        span="spf:sharded_solve",
+        span_complete=False,  # dispatch-only span (async return)
+    )
     return dist[:, :b]

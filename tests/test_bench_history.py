@@ -1,0 +1,81 @@
+"""Bench-history sentinel tests (benchmarks/history.py): append/load
+roundtrip, same-fingerprint scoping, and the direction-aware >25%
+regression check."""
+
+from benchmarks.history import (
+    append_row,
+    check_history,
+    fingerprint_key,
+    host_fingerprint,
+    load_history,
+)
+
+
+def _rec(metric="m", value=10.0, fp_key="f1", **extra):
+    row = {"metric": metric, "value": value, **extra}
+    return {"ts": 0.0, "fp_key": fp_key, "fingerprint": {}, "row": row}
+
+
+def test_append_and_load_roundtrip(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    rec = append_row({"metric": "m", "value": 1.5}, path=p)
+    assert rec["fp_key"] == fingerprint_key(host_fingerprint())
+    append_row({"metric": "m", "value": 2.0}, compiles={"f": 3}, path=p)
+    loaded = load_history(p)
+    assert len(loaded) == 2
+    assert loaded[0]["row"]["value"] == 1.5
+    assert loaded[1]["compiles"] == {"f": 3}
+
+
+def test_load_skips_torn_tail(tmp_path):
+    p = tmp_path / "hist.jsonl"
+    append_row({"metric": "m", "value": 1.0}, path=p)
+    with open(p, "a") as f:
+        f.write('{"ts": 1, "row": {"met')  # torn write
+    assert len(load_history(p)) == 1
+
+
+def test_check_flags_latency_regression():
+    recs = [_rec(value=10.0), _rec(value=10.0), _rec(value=14.0)]
+    warnings = check_history(recs)
+    assert len(warnings) == 1
+    assert "value" in warnings[0]
+    # within tolerance: clean
+    assert check_history([_rec(value=10.0), _rec(value=12.0)]) == []
+
+
+def test_check_flags_throughput_drop():
+    recs = [
+        _rec(prefix_routes_per_sec=1000.0),
+        _rec(prefix_routes_per_sec=1000.0),
+        _rec(prefix_routes_per_sec=700.0),
+    ]
+    warnings = check_history(recs)
+    assert any("prefix_routes_per_sec" in w for w in warnings)
+    # a throughput RISE is not a regression
+    recs[-1]["row"]["prefix_routes_per_sec"] = 2000.0
+    assert check_history(recs) == []
+
+
+def test_check_scopes_to_fingerprint_and_metric():
+    # a different host's rows must never gate this host's run
+    recs = [_rec(value=1.0, fp_key="other"), _rec(value=100.0, fp_key="f1")]
+    assert check_history(recs) == []
+    # degraded runs rename the metric — cpu_fallback rows never compare
+    # against real rows even on the same host
+    recs = [
+        _rec(metric="m", value=1.0),
+        _rec(metric="m_cpu_fallback", value=100.0),
+    ]
+    assert check_history(recs) == []
+    # and fewer than 2 records is always clean
+    assert check_history([_rec()]) == []
+    assert check_history([]) == []
+
+
+def test_check_ignores_null_metrics():
+    recs = [
+        _rec(value=10.0, topo_churn_p50_ms=None),
+        _rec(value=10.0, topo_churn_p50_ms=5.0),
+    ]
+    assert check_history(recs) == []

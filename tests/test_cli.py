@@ -396,3 +396,26 @@ def test_perf_waterfall_unsampled_cluster(live):
     group default still renders ordinary traces."""
     out = invoke(live, "a", "perf", "waterfall")
     assert "no completed flood traces" in out
+
+
+def test_device_kernels_table(live):
+    """`breeze device kernels` renders the cost-ledger join: seed one
+    process-wide capture (the live cluster's nodes run the cpu oracle,
+    which never jits) and expect its row with flops/bytes columns."""
+    from openr_tpu.decision.spf_backend import TpuSpfSolver
+    from openr_tpu.monitor import device as device_telemetry
+    from openr_tpu.utils.topogen import erdos_renyi_lsdb
+
+    if "batched_sssp_split_rib" not in device_telemetry.kernel_rows():
+        ls, ps, _csr = erdos_renyi_lsdb(
+            64, avg_degree=5, seed=2, max_metric=8
+        )
+        TpuSpfSolver(native_rib="off").compute_routes(ls, ps, "node-0")
+    out = invoke(live, "a", "device", "kernels")
+    assert "batched_sssp_split_rib" in out
+    assert "GFLOP/s" in out  # header
+
+
+def test_device_hbm_degraded_on_cpu(live):
+    out = invoke(live, "a", "device", "hbm")
+    assert "unavailable" in out
