@@ -40,6 +40,15 @@ HEADLINE_METRICS: dict[str, str] = {
     "prefix_churn_p50_ms": "lower",
     "topo_churn_p50_ms": "lower",
     "prefix_routes_per_sec": "higher",
+    # steady-state work ledger (docs/Monitor.md "Work ledger"): a rising
+    # touched/delta ratio on a delta-proportional stage means someone
+    # reintroduced a full-table walk; merge/redistribute are honest
+    # O(routes) so their ratios drift with table size — still tracked,
+    # a jump at a FIXED fingerprint (same nodes/prefixes) is real work
+    "work_merge_ratio": "lower",
+    "work_redistribute_ratio": "lower",
+    "work_election_ratio": "lower",
+    "work_fib_ratio": "lower",
 }
 
 DEFAULT_TOLERANCE = 0.25

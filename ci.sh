@@ -49,7 +49,7 @@ if [ "$smoke_rc" -ne 1 ]; then
     exit 1
 fi
 for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
-            OR010 OR011 OR012; do
+            OR010 OR011 OR012 OR013; do
     if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
         echo "orlint smoke: rule $code produced no finding on the" \
              "known-bad fixture (rule deleted or broken?)"
@@ -57,7 +57,7 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
         exit 1
     fi
 done
-echo "ok: known-bad fixture trips all 12 rules"
+echo "ok: known-bad fixture trips all 13 rules"
 
 echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # the topology-delta acceptance gate (docs/Decision.md): single-link
@@ -79,6 +79,21 @@ echo "== prefix-churn smoke (scoped-path counters + compile ledger gate) =="
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --prefix-churn --nodes 80 --prefix-rounds 40 --smoke --backend cpu \
     2> >(smoke_log prefix_churn_smoke)
+
+echo "== work-ledger smoke (delta-proportionality attribution gates) =="
+# the steady-state work ledger gate (docs/Monitor.md "Work ledger"):
+# the full dataflow — two-area decision, real delta FIB, real ABR
+# redistribution — under prefix AND topo churn must show
+# work.fib.ratio pinned at 1, work.election.ratio bounded, the two
+# known O(routes) walks (cross-area merge fold, PrefixManager RIB
+# redistribution) reporting HONEST full-table touched counts, zero
+# post-warmup XLA compiles, and no delta-proportional stage breaching
+# k*delta+floor in any steady round — bench_churn --work-bench --smoke
+# exits 1 on any of those
+JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
+    --work-bench --nodes 36 --work-prefixes 2000 --work-rounds 12 \
+    --work-mode both --smoke --backend cpu \
+    2> >(smoke_log work_ledger_smoke)
 
 echo "== 100k-prefix data-plane smoke (vectorized election + delta FIB) =="
 # the million-prefix pipeline at CI scale: one 100k-prefix rung through

@@ -1130,6 +1130,55 @@ def monitor_fleet(ctx, endpoints, prefix, top):
     click.echo(_table(rows, FLEET_HEADERS))
 
 
+@monitor.command("work")
+@click.pass_context
+def monitor_work(ctx):
+    """Steady-state work ledger (docs/Monitor.md "Work ledger"):
+    per-pipeline-stage entities-touched vs delta-size with the
+    proportionality ratio — cumulative and since the warm mark — plus
+    the top offending stage. A stage whose steady ratio grows with
+    table size is an O(routes) walk hiding in the delta path."""
+    res = _run(ctx, "get_work_ledger")
+    stages = res.get("stages") or []
+    if not stages:
+        click.echo("work ledger empty (no scoped stage has run)")
+        return
+    def fmt(v):
+        return f"{v:g}"
+
+    rows = []
+    for s in stages:
+        st = s.get("steady")
+        rows.append(
+            [
+                s["stage"],
+                fmt(s["touched"]),
+                fmt(s["delta"]),
+                fmt(s["rounds"]),
+                fmt(s["ratio"]),
+                fmt(st["ratio"]) if st else "-",
+                fmt(st["worst_ratio"]) if st else "-",
+            ]
+        )
+    click.echo(
+        f"# node {res['node']}: warm_marked={res.get('warm_marked')}"
+    )
+    click.echo(
+        _table(
+            rows,
+            [
+                "stage", "touched", "delta", "rounds",
+                "ratio", "steady-ratio", "worst-round",
+            ],
+        )
+    )
+    top = res.get("top_offender")
+    if top:
+        click.echo(
+            f"# top offender: {top['stage']} (ratio {top['ratio']:g})"
+        )
+
+
 @monitor.command("flight")
 @click.option("--limit", default=50, show_default=True, type=int)
 @click.option("--kind", default=None, help="filter by event kind")

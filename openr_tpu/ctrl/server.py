@@ -144,7 +144,7 @@ class CtrlServer(OpenrModule):
             "set_rib_policy", "get_rib_policy", "get_event_logs",
             "get_perf_events", "get_counters_prometheus",
             "get_flood_traces", "get_flight_recorder",
-            "get_device_telemetry",
+            "get_device_telemetry", "get_work_ledger",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -248,6 +248,22 @@ class CtrlServer(OpenrModule):
             "shards": (
                 list(solver.last_shard_rows) if solver is not None else []
             ),
+        }
+
+    async def get_work_ledger(self, params: dict) -> dict:
+        """Steady-state work ledger (docs/Monitor.md "Work ledger"):
+        the process-wide per-stage touched/delta/ratio accounting,
+        joined server-side into per-stage rows (cumulative + since-warm
+        when a warm boundary was marked) plus the top offending stage —
+        same join shape as get_device_telemetry."""
+        from openr_tpu.monitor import work_ledger
+
+        led = work_ledger.ledger()
+        return {
+            "node": self.node.name,
+            "warm_marked": led.warm_marked,
+            "stages": led.rows(),
+            "top_offender": led.top_offender(),
         }
 
     async def get_counters_prometheus(self, params: dict) -> dict:

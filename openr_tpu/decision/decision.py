@@ -34,7 +34,7 @@ from openr_tpu.decision.oracle import (
 from openr_tpu.decision.oracle import compute_routes as oracle_compute_routes
 from openr_tpu.decision.oracle import metric_key
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
-from openr_tpu.monitor import compile_ledger, perf
+from openr_tpu.monitor import compile_ledger, perf, work_ledger
 from openr_tpu.monitor import device as device_telemetry
 from openr_tpu.types.kvstore import Publication, Value
 from openr_tpu.types.routes import (
@@ -135,18 +135,25 @@ def merge_area_ribs(
     if len(areas) == 1:
         return per_area[areas[0]]
     out = RouteDatabase(this_node_name=my_node)
-    for area in areas:
-        rdb = per_area[area]
-        for prefix, entry in rdb.unicast_routes.items():  # orlint: disable=OR012 — multi-area fold; the single-area fast path above bypasses it, and multi-area deployments fold per-area RIBs that the scoped merge keeps small
-            cur = out.unicast_routes.get(prefix)
-            out.unicast_routes[prefix] = (
-                entry if cur is None else _fold_unicast(cur, entry)
-            )
-        for label, mentry in rdb.mpls_routes.items():
-            cur = out.mpls_routes.get(label)
-            out.mpls_routes[label] = (
-                mentry if cur is None else _fold_mpls(cur, mentry)
-            )
+    # delta=0: the full fold has no delta to be proportional to — the
+    # work ledger reports its honest O(routes) ratio (ISSUE 16 names it
+    # as one of the two remaining full-table walks; BENCH_WORK.json
+    # quantifies its steady-state share against which a delta-native
+    # fold can be judged)
+    with work_ledger.scope("merge", 0) as ws:
+        for area in areas:
+            rdb = per_area[area]
+            ws.add(len(rdb.unicast_routes) + len(rdb.mpls_routes))
+            for prefix, entry in rdb.unicast_routes.items():  # orlint: disable=OR012 — multi-area fold inside the `merge` WorkScope; the single-area fast path above bypasses it, and multi-area deployments fold per-area RIBs that the scoped merge keeps small
+                cur = out.unicast_routes.get(prefix)
+                out.unicast_routes[prefix] = (
+                    entry if cur is None else _fold_unicast(cur, entry)
+                )
+            for label, mentry in rdb.mpls_routes.items():
+                cur = out.mpls_routes.get(label)
+                out.mpls_routes[label] = (
+                    mentry if cur is None else _fold_mpls(cur, mentry)
+                )
     return out
 
 
@@ -167,6 +174,17 @@ def merge_area_ribs_scoped(
     re-merge restricted to the scopes."""
     areas = sorted(per_area)
     out = RouteDatabase(this_node_name=my_node)
+    # the base-table dict copies are counted: they are this path's
+    # real remaining O(routes) term in multi-area steady state (the
+    # per-prefix re-selection below is delta-proportional)
+    delta = len(scope) + len(label_scope)
+    work_ledger.commit(
+        "merge",
+        len(base.unicast_routes)
+        + len(base.mpls_routes)
+        + delta * len(areas),
+        delta,
+    )
     out.unicast_routes = dict(base.unicast_routes)
     out.mpls_routes = dict(base.mpls_routes)
     for prefix in scope:
@@ -509,6 +527,9 @@ class Decision(OpenrModule):
             return False
         batch, self._pending_kvs = self._pending_kvs, {}
         changed = False
+        # dirt classification is per applied KEY, never per route —
+        # one batched add, ratio pinned at 1 by construction
+        work_ledger.commit("dirt", len(batch), len(batch))
         for (area, key), val in batch.items():
             ls, ps = self._get_area(area)
             rev0 = ps.rev
@@ -892,15 +913,23 @@ class Decision(OpenrModule):
         returns (rdb, SolveArtifact | None) for the dirty-scoped cache."""
         self._area_solves += 1
         if self._tpu is not None:
-            return self._tpu.compute_routes(
+            res = self._tpu.compute_routes(
                 ls, ps, self.node_name, return_artifact=want_artifact
             )
-        return oracle_compute_routes(
-            ls, ps, self.node_name,
-            enable_lfa=self.config.node.decision.enable_lfa,
-            ksp_k=self.config.node.decision.ksp_paths,
-            return_artifact=want_artifact,
+        else:
+            res = oracle_compute_routes(
+                ls, ps, self.node_name,
+                enable_lfa=self.config.node.decision.enable_lfa,
+                ksp_k=self.config.node.decision.ksp_paths,
+                return_artifact=want_artifact,
+            )
+        # a full solve's "delta" is the solve itself (1): touched is
+        # honestly O(area routes) — pre-warm only in steady-state lanes
+        rdb = res[0] if want_artifact else res
+        work_ledger.commit(
+            "spf_full", len(rdb.unicast_routes) + len(rdb.mpls_routes), 1
         )
+        return res
 
     def _reassemble_area(
         self, cache: dict, ps: PrefixState, prefixes: set
@@ -916,6 +945,11 @@ class Decision(OpenrModule):
         rdb = RouteDatabase(this_node_name=self.node_name)
         rdb.unicast_routes = dict(old.unicast_routes)
         rdb.mpls_routes = dict(old.mpls_routes)
+        # touched = the reassembled prefixes only; the verbatim-reuse
+        # dict copy above is a bulk C op, not per-entity assembly work
+        # (the merge stage owns the copy accounting where it is the
+        # honest steady-state O(routes) term)
+        work_ledger.commit("assembly", len(prefixes), len(prefixes))
         if self._tpu is not None:
             entries = self._tpu.assemble_prefix_routes(art, ps, prefixes)
         else:
@@ -1045,6 +1079,13 @@ class Decision(OpenrModule):
                     res = self._warm_area(ls, ps, cache, d)
                     if res is not None:
                         rdb, art, t_pfx, t_lbl, region = res
+                        # warm solve: delta = dirty edges + prefixes,
+                        # touched = warm region + reassembled routes
+                        work_ledger.commit(
+                            "spf_warm",
+                            region + len(t_pfx) + len(t_lbl),
+                            len(d.edges) + len(d.prefixes),
+                        )
                         self._area_cache[a] = {
                             "rdb": rdb, "art": art,
                             "ls_rev": ls.rev, "ps_rev": ps.rev,
@@ -1093,6 +1134,23 @@ class Decision(OpenrModule):
                         per_area, self.node_name, self.rib, scope, lscope
                     )
         tr = time.perf_counter()
+        if scope is not None:
+            # scoped diff examines exactly the scope — ratio 1
+            work_ledger.commit(
+                "diff",
+                len(scope) + len(lscope or ()),
+                len(scope) + len(lscope or ()),
+            )
+        else:
+            # full sweep walks both tables; no delta to credit
+            work_ledger.commit(
+                "diff",
+                len(self.rib.unicast_routes)
+                + len(self.rib.mpls_routes)
+                + len(new_rib.unicast_routes)
+                + len(new_rib.mpls_routes),
+                0,
+            )
         update = diff_route_dbs(
             self.rib, new_rib,
             prefix_scope=scope,
@@ -1257,6 +1315,11 @@ class Decision(OpenrModule):
                 ),
             )
             self.counters.add_value("decision.rebuild_ms", self._last_spf_ms)
+            # steady-state work ledger (monitor/work_ledger.py): per-
+            # stage touched/delta/ratio gauges. Host accounting — NOT
+            # TPU-branch-gated like the compile/device ledgers: every
+            # engine walks the same dataflow stages
+            work_ledger.export_to(self.counters)
             with self._decode_stats_lock:
                 for tier, n in self.decode_stats.items():
                     self.counters.set(f"decision.decode.{tier}", n)
@@ -1348,7 +1411,7 @@ class Decision(OpenrModule):
         total = 0
         for ps in self._prefix_states.values():
             total += sys.getsizeof(ps.prefixes)
-            for per in ps.prefixes.values():  # orlint: disable=OR012 — soak sampler, once per round, never on a rebuild/program path
+            for per in ps.prefixes.values():  # orlint: disable=OR012,OR013 — soak sampler, once per round, never on a rebuild/program path; not a ledger stage
                 # per-advertiser dict + a rough constant per frozen
                 # PrefixEntry (slots=True: no instance dict)
                 total += sys.getsizeof(per) + 96 * len(per)
@@ -1462,7 +1525,7 @@ class Decision(OpenrModule):
 
     def get_received_routes(self) -> dict[str, dict]:
         return {
-            area: {  # orlint: disable=OR012 — operator accessor (breeze received-routes dump), not a rebuild path
+            area: {  # orlint: disable=OR012,OR013 — operator accessor (breeze received-routes dump), not a rebuild path or ledger stage
                 str(p.prefix): sorted(per_node)
                 for p, per_node in ps.prefixes.items()
             }

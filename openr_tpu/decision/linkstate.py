@@ -609,7 +609,7 @@ class PrefixState:
     def snapshot(self) -> "PrefixState":
         """Consistent copy for off-thread solves (entries are frozen)."""
         snap = PrefixState(self.area)
-        snap._entries = {p: dict(per) for p, per in self._entries.items()}
+        snap._entries = {p: dict(per) for p, per in self._entries.items()}  # orlint: disable=OR013 — LSDB snapshot copy for the off-thread solve, measured by decision.rebuild_ms; not a dataflow stage
         snap._rev = self._rev
         snap._view_cell = self._view_cell  # shared cell, rev-keyed
         snap._lineage = self._lineage  # same lineage: gen stays stable
@@ -672,7 +672,7 @@ class PrefixState:
     def withdraw_node(self, node: str) -> set[IpPrefix]:
         """Remove everything `node` advertises (node left the topology)."""
         changed: set[IpPrefix] = set()
-        for prefix in list(self._entries):
+        for prefix in list(self._entries):  # orlint: disable=OR013 — structural node-withdraw sweep (node left the topology), event-driven, not steady-state churn
             if self.withdraw(node, prefix):
                 changed.add(prefix)
         return changed

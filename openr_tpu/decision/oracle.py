@@ -39,6 +39,7 @@ from openr_tpu.decision.ksp import (
     ucmp_weights,
 )
 from openr_tpu.decision.linkstate import LinkState, PrefixState
+from openr_tpu.monitor import work_ledger
 from openr_tpu.types.network import (
     MplsAction,
     MplsActionType,
@@ -444,13 +445,16 @@ def assemble_prefix_routes(
     `prefixes` only, with zero SPF work. A prefix absent from the result
     has no route (withdrawn/unreachable/local) — the caller deletes it."""
     out: dict = {}
-    for prefix in sorted(prefixes):
-        per_node = ps.prefixes.get(prefix)
-        if not per_node:
-            continue  # fully withdrawn
-        entry = _unicast_route(art, prefix, per_node)
-        if entry is not None:
-            out[prefix] = entry
+    # scoped election accounting: candidates examined per touched prefix
+    with work_ledger.scope("election", len(prefixes)) as ws:
+        for prefix in sorted(prefixes):
+            per_node = ps.prefixes.get(prefix)
+            if not per_node:
+                continue  # fully withdrawn
+            ws.add(len(per_node))
+            entry = _unicast_route(art, prefix, per_node)
+            if entry is not None:
+                out[prefix] = entry
     return out
 
 
@@ -573,6 +577,18 @@ def compute_routes(
     if use_elect:
         csr = ls.to_csr()
         view = ps.election_view(csr.name_to_id, csr.base_version)
+        # full-solve election: delta = electable prefixes, touched =
+        # candidate advertiser slots (same accounting as the TPU
+        # backend's elect site — parity extends to the work ledger)
+        work_ledger.commit(
+            "election",
+            len(view.plain_p)
+            + (len(view.multi.adv) if view.multi is not None else 0)
+            + sum(len(pn) for _p, pn in view.complex_items),
+            len(view.plain_p)
+            + (len(view.multi.prefixes) if view.multi is not None else 0)
+            + len(view.complex_items),
+        )
         _elect_assemble(art, csr, view, rdb.unicast_routes)
         for prefix, per_node in view.complex_items:
             entry = _unicast_route(art, prefix, per_node)
@@ -580,11 +596,14 @@ def compute_routes(
                 rdb.unicast_routes[prefix] = entry
     else:
         # scalar reference seam: the loop the batched election is
-        # parity-gated against (and the LFA path)
-        for prefix, per_node in sorted(ps.prefixes.items()):  # orlint: disable=OR012 — scalar reference/fallback seam (LFA + parity gates)
-            entry = _unicast_route(art, prefix, per_node)
-            if entry is not None:
-                rdb.unicast_routes[prefix] = entry
+        # parity-gated against (and the LFA path); the WorkScope keeps
+        # its honest O(prefixes) ratio visible in `work.election.*`
+        with work_ledger.scope("election", len(ps.prefixes)) as ws:
+            for prefix, per_node in sorted(ps.prefixes.items()):  # orlint: disable=OR012 — scalar reference/fallback seam (LFA + parity gates), inside the `election` WorkScope
+                ws.add(len(per_node))
+                entry = _unicast_route(art, prefix, per_node)
+                if entry is not None:
+                    rdb.unicast_routes[prefix] = entry
 
     # ---- MPLS node-segment routes ----------------------------------------
     # reference: SpfSolver::createMplsRoutes † — for every remote node with a

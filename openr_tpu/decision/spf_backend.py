@@ -36,7 +36,7 @@ from openr_tpu.decision.ksp import (
 )
 from openr_tpu.decision.linkstate import CsrGraph, LinkState, PrefixState
 from openr_tpu.decision.oracle import SolveArtifact, metric_key
-from openr_tpu.monitor import compile_ledger, profiling
+from openr_tpu.monitor import compile_ledger, profiling, work_ledger
 from openr_tpu.monitor import device as device_telemetry
 from openr_tpu.types.topology import ForwardingAlgorithm
 from openr_tpu.ops.spf import (
@@ -945,6 +945,12 @@ class TpuSpfSolver:
             per_node = ps.prefixes.get(p)
             if per_node:
                 items.append((p, dict(per_node)))
+        # scoped election: candidates examined vs touched prefixes
+        work_ledger.commit(
+            "election",
+            sum(len(pn) for _p, pn in items),
+            len(prefixes),
+        )
         out: dict = {}
         ksp_jobs = self._unicast_general(
             csr, ls, my_node, my_id, d_root, fh, fh_any, nbr_ids, lfa,
@@ -1316,6 +1322,21 @@ class TpuSpfSolver:
             len(multi.prefixes) if multi is not None else 0
         )
         self.elect_stats["complex"] = len(complex_items)
+        # work ledger election stage (full solve): delta = electable
+        # prefixes, touched = candidate advertiser slots — the ratio is
+        # the mean advertisers-per-prefix, bounded by topology fanout
+        n_elect = (
+            len(plain_p)
+            + self.elect_stats["multi"]
+            + len(complex_items)
+        )
+        work_ledger.commit(
+            "election",
+            len(plain_p)
+            + (len(multi.adv) if multi is not None else 0)
+            + sum(len(pn) for _p, pn in complex_items),
+            n_elect,
+        )
         # multi-advertiser election: the masked argmax/argmin over the
         # prefix→advertiser matrix (device-side segmented reductions
         # past elect_device_min slots, NumPy below — byte-equal)

@@ -1,6 +1,6 @@
 """Post-quiescence cluster invariant checker for the emulator.
 
-Five invariant classes over a quiesced Cluster (storm over, rate faults
+Six invariant classes over a quiesced Cluster (storm over, rate faults
 off, structural faults healed):
 
   1. **KvStore consistency** — every node's KvStoreDb in an area is
@@ -21,8 +21,15 @@ off, structural faults healed):
      absorbed every burst at the bound); the long-horizon memory
      watermark lives in the soak runner (emulator/soak.py), which needs
      cross-round state this single-shot checker doesn't have.
+  6. **Work proportionality** — once the soak marks the work ledger
+     warm (after its round-0 baseline), every delta-proportional
+     dataflow stage (dirt / election / assembly / fib) must keep each
+     steady round's touched-entity count within k*delta + floor
+     (docs/Monitor.md "Work ledger"); a breach means a full-table walk
+     crept back into a scoped path, and lands a ``work.ratio_breach``
+     flight-recorder event on every node for the post-mortem dump.
 
-`wait_quiescent` polls until all four hold (twice consecutively, so a
+`wait_quiescent` polls until all of these hold (twice consecutively, so a
 mid-flight sample can't pass by luck) or raises with the chaos replay
 hint — a failing soak always prints the seed needed to reproduce it.
 """
@@ -328,6 +335,66 @@ def check_queue_bounds(cluster) -> list[Violation]:
     return out
 
 
+# ------------------------------------------------- 6. work proportionality
+
+
+#: stages that are honestly super-delta by design and therefore exempt
+#: from the soak proportionality gate (docs/Monitor.md "Work ledger"):
+#: merge + redistribute are O(routes), spf_full is O(area), spf_warm is
+#: O(region), full_sync is O(store) — and under storm-driven topology
+#: dirt the full-table route diff is honestly O(tables) too (a metric
+#: change can move any route), so diff is only gated in prefix-only
+#: regimes the soak never is.
+WORK_EXEMPT_STAGES = (
+    "merge",
+    "redistribute",
+    "spf_full",
+    "spf_warm",
+    "full_sync",
+    "diff",
+)
+
+
+def check_work_ratios(cluster) -> list[Violation]:
+    """Delta-proportionality gate over the process-global work ledger
+    (openr_tpu/monitor/work_ledger.py): once a soak round has marked the
+    ledger warm, no delta-proportional stage (dirt / election / assembly
+    / fib) may have a steady round whose touched-entity count exceeds
+    k*delta + floor. Inactive until ``mark_warm()`` — a single-shot
+    ``assert_invariants`` on a fresh cluster never trips on warmup work.
+    The ledger is per-process, so in the emulator a breach is a
+    cluster-wide fact (node=None); the flight-recorder event lands on
+    every node so any post-mortem dump carries it."""
+    from openr_tpu.monitor import work_ledger
+
+    if not work_ledger.ledger().warm_marked:
+        return []
+    out: list[Violation] = []
+    for v in work_ledger.steady_violations(exempt=WORK_EXEMPT_STAGES):
+        out.append(
+            Violation(
+                "work.ratio_breach",
+                None,
+                f"stage {v['stage']}: worst steady round touched "
+                f"{v['touched']} entities for delta {v['delta']} "
+                f"(ratio {v['ratio']:.1f}, bound {v['bound']:.0f}) — "
+                "a full-table walk crept into a delta-proportional stage",
+            )
+        )
+        for node in cluster.nodes.values():
+            fr = getattr(node.counters, "flight_record", None)
+            if fr is not None:
+                fr(
+                    "work.ratio_breach",
+                    stage=v["stage"],
+                    touched=v["touched"],
+                    delta=v["delta"],
+                    ratio=round(v["ratio"], 2),
+                    bound=v["bound"],
+                )
+    return out
+
+
 # ------------------------------------------------- flight-recorder dumps
 
 
@@ -386,10 +453,11 @@ def _flight_hint(cluster, violations, label: str) -> str:
 
 
 def check_cluster(cluster) -> list[Violation]:
-    """All five invariant classes; cheap checks first so the poll loop
+    """All six invariant classes; cheap checks first so the poll loop
     fails fast while the cluster is still settling."""
     out = check_no_stuck_state(cluster)
     out += check_queue_bounds(cluster)
+    out += check_work_ratios(cluster)
     out += check_kvstore_consistency(cluster)
     out += check_counter_sanity(cluster)
     out += check_fib_oracle_parity(cluster)

@@ -38,6 +38,7 @@ from openr_tpu.emulator.chaos import (
 )
 from openr_tpu.emulator.cluster import Cluster
 from openr_tpu.emulator.invariants import wait_quiescent
+from openr_tpu.monitor import work_ledger
 from openr_tpu.watchdog.watchdog import _current_rss_mb
 
 log = logging.getLogger(__name__)
@@ -266,6 +267,9 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
     # rate faults gate on the per-round storms — initial bring-up is
     # clean so round boundaries always start from a converged baseline
     plan.active = False
+    # the work ledger is process-global: clear anything a previous soak
+    # or bench left behind so round attribution starts from zero
+    work_ledger.reset()
     await cluster.start()
     try:
         await cluster.wait_converged(timeout=cfg.quiesce_timeout_s)
@@ -347,8 +351,13 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
             )
             if rnd == 0:
                 # round 1 is the warmup baseline (JIT caches, interned
-                # bytes); monotone growth is judged from here on
+                # bytes); monotone growth is judged from here on —
+                # and the same boundary arms the work-proportionality
+                # invariant (invariants.check_work_ratios): from here
+                # every storm round's per-stage touched-entity counts
+                # are judged against their deltas
                 baseline = (rss_mb, objects, warm_mb, prefix_mb, hbm_mb)
+                work_ledger.mark_warm()
                 continue
             base_rss, base_obj, base_warm, base_prefix, base_hbm = baseline
             if (
@@ -397,4 +406,8 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                 )
         return report
     finally:
+        # disarm the process-global proportionality gate so later
+        # single-shot assert_invariants calls in the same process
+        # (tests) don't inherit this soak's warm window
+        work_ledger.reset_warm()
         await cluster.stop()

@@ -20,7 +20,7 @@ from openr_tpu.common.backoff import ExponentialBackoff, stable_rng
 from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue, RQueue
-from openr_tpu.monitor import perf
+from openr_tpu.monitor import perf, work_ledger
 from openr_tpu.types.network import IpPrefix, MplsRoute, UnicastRoute
 from openr_tpu.types.routes import (
     RibEntry,
@@ -364,6 +364,10 @@ class Fib(OpenrModule):
                             "fib.program_ms",
                             (time.perf_counter() - t0) * 1e3,
                         )
+                    # refresh work.* gauges at the program edge too —
+                    # a fib-only process (no Decision rebuilds) still
+                    # exports its ledger view
+                    work_ledger.export_to(self.counters)
                 self._complete_traces(n_covered)
             except asyncio.CancelledError:
                 raise
@@ -432,6 +436,10 @@ class Fib(OpenrModule):
         scanned = len(u_upd) + len(u_del_set) + len(m_upd) + len(m_del_set)
         if self.counters and scanned:
             self.counters.increment("fib.program_scan_routes", scanned)
+        if scanned:
+            # delta-native cycle touches exactly the popped delta book —
+            # work.fib.ratio is pinned at 1 (the ci smoke lane gates it)
+            work_ledger.commit("fib", scanned, scanned)
         u_add = []
         for p, e in u_upd.items():
             r = e.to_unicast_route()
@@ -513,6 +521,9 @@ class Fib(OpenrModule):
         snap_u = dict(self.desired_unicast)
         snap_m = dict(self.desired_mpls)
         self._clear_pending()
+        # honest O(table) accounting, delta 0: resync/dry-run/warm-boot
+        # are full-table by design and must read that way in work.fib.*
+        work_ledger.commit("fib", len(snap_u) + len(snap_m), 0)
         desired_u = {p: e.to_unicast_route() for p, e in snap_u.items()}  # orlint: disable=OR012 — full-table resync seam (O(P) by design)
         desired_m = {l: e.to_mpls_route() for l, e in snap_m.items()}
         if self.dry_run:
@@ -550,12 +561,12 @@ class Fib(OpenrModule):
             r for p, r in desired_u.items()
             if not same_u(self.programmed_unicast.get(p), r)
         ]
-        u_del = [p for p in self.programmed_unicast if p not in desired_u]  # orlint: disable=OR012 — one-shot warm-boot table diff (O(P) by design)
+        u_del = [p for p in self.programmed_unicast if p not in desired_u]  # orlint: disable=OR012,OR013 — one-shot warm-boot table diff (O(P) by design; accounted by the fib-stage commit above)
         m_add = [
             r for l, r in desired_m.items()
             if not same_m(self.programmed_mpls.get(l), r)
         ]
-        m_del = [l for l in self.programmed_mpls if l not in desired_m]  # orlint: disable=OR012 — one-shot warm-boot table diff
+        m_del = [l for l in self.programmed_mpls if l not in desired_m]  # orlint: disable=OR012,OR013 — one-shot warm-boot table diff; accounted by the fib-stage commit above
         if u_add:
             await self.handler.add_unicast_routes(CLIENT_ID_OPENR, u_add)
         if u_del:
@@ -633,18 +644,18 @@ class Fib(OpenrModule):
         """Desired-vs-programmed delta counts + examples (single source
         of truth for convergence checks — validate uses this instead of
         re-deriving the diff)."""
-        desired_u = {p: e.to_unicast_route() for p, e in self.desired_unicast.items()}  # orlint: disable=OR012 — convergence accessor (validate/invariants), not the program cycle
-        desired_m = {l: e.to_mpls_route() for l, e in self.desired_mpls.items()}  # orlint: disable=OR012 — convergence accessor
+        desired_u = {p: e.to_unicast_route() for p, e in self.desired_unicast.items()}  # orlint: disable=OR012,OR013 — convergence accessor (validate/invariants), not the program cycle or a ledger stage
+        desired_m = {l: e.to_mpls_route() for l, e in self.desired_mpls.items()}  # orlint: disable=OR012,OR013 — convergence accessor
         u_stale = [
             str(p) for p, r in desired_u.items()
             if self.programmed_unicast.get(p) != r
         ]
-        u_del = [str(p) for p in self.programmed_unicast if p not in desired_u]  # orlint: disable=OR012 — convergence accessor
+        u_del = [str(p) for p in self.programmed_unicast if p not in desired_u]  # orlint: disable=OR012,OR013 — convergence accessor
         m_stale = [
             l for l, r in desired_m.items()
             if self.programmed_mpls.get(l) != r
         ]
-        m_del = [l for l in self.programmed_mpls if l not in desired_m]  # orlint: disable=OR012 — convergence accessor
+        m_del = [l for l in self.programmed_mpls if l not in desired_m]  # orlint: disable=OR012,OR013 — convergence accessor
         return {
             "converged": not (u_stale or u_del or m_stale or m_del),
             "desired_unicast": len(desired_u),

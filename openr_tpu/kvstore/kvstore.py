@@ -28,7 +28,7 @@ from openr_tpu.kvstore.transport import (
     pub_wire_bin,
 )
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue
-from openr_tpu.monitor import perf
+from openr_tpu.monitor import perf, work_ledger
 from openr_tpu.rpc import RpcError
 from openr_tpu.types.kvstore import KeyDumpParams, Publication, Value
 
@@ -859,22 +859,28 @@ class KvStore(OpenrModule):
         to_send: dict[str, Value] = {}
         they_need: list[str] = []
         ours = db.kv
-        for k, v in db.dump().items():
-            t = theirs.get(k)
-            if t is None:
-                to_send[k] = v
-                continue
-            have = (ours[k].version, ours[k].originator_id, ours[k].with_hash().hash)
-            if have > t:
-                to_send[k] = v
-        for k, t in theirs.items():
-            cur = ours.get(k)
-            if cur is None:
-                they_need.append(k)
-            else:
-                have = (cur.version, cur.originator_id, cur.with_hash().hash)
-                if t > have:
+        # work ledger `full_sync` stage: the anti-entropy compare walks
+        # both digests (touched); the delta is what actually moves — set
+        # once the two walks below have decided it
+        with work_ledger.scope("full_sync") as ws:
+            ws.add(len(ours) + len(theirs))
+            for k, v in db.dump().items():
+                t = theirs.get(k)
+                if t is None:
+                    to_send[k] = v
+                    continue
+                have = (ours[k].version, ours[k].originator_id, ours[k].with_hash().hash)
+                if have > t:
+                    to_send[k] = v
+            for k, t in theirs.items():
+                cur = ours.get(k)
+                if cur is None:
                     they_need.append(k)
+                else:
+                    have = (cur.version, cur.originator_id, cur.with_hash().hash)
+                    if t > have:
+                        they_need.append(k)
+            ws.set_delta(len(to_send) + len(they_need))
         pub = Publication(
             area=area,
             key_vals=to_send,
@@ -886,6 +892,7 @@ class KvStore(OpenrModule):
             self.counters.increment(
                 "kvstore.full_sync_keys_sent", len(to_send)
             )
+            work_ledger.export_to(self.counters)
         out = pub_to_json(pub)
         out["store_hash"] = own_hash
         return out

@@ -4,7 +4,7 @@ reference: openr/monitor/ † + the fb303 counter surface every module uses
 (`fb303::fbData->setCounter/addStatValue` †).
 """
 
-from openr_tpu.monitor import compile_ledger, device  # noqa: F401
+from openr_tpu.monitor import compile_ledger, device, work_ledger  # noqa: F401
 from openr_tpu.monitor.counters import (  # noqa: F401
     Counters,
     render_prometheus,

@@ -41,7 +41,12 @@ def aggregate_counters(
     Each distribution: ``{"nodes", "min", "p50", "p99", "max", "mean",
     "sum", "max_node"}``. Keys missing on a node simply don't
     contribute (a key present on 3 of 64 nodes aggregates over 3 —
-    ``nodes`` says so)."""
+    ``nodes`` says so).
+
+    Ratio-type gauges (any ``*.ratio`` key, e.g. the work ledger's
+    ``work.<stage>.ratio``) aggregate by distribution ONLY: a sum of
+    per-node ratios is dimensionally meaningless, so their ``sum`` is
+    ``None`` rather than a number a dashboard might graph."""
     per_key: dict[str, list[tuple[float, str]]] = {}
     for node, snap in snapshots.items():
         for k, v in snap.items():
@@ -59,7 +64,7 @@ def aggregate_counters(
             "p99": _percentile(vals, 0.99),
             "max": vmax,
             "mean": sum(vals) / len(vals),
-            "sum": sum(vals),
+            "sum": None if k.endswith(".ratio") else sum(vals),
             "max_node": max_node,
         }
     return out

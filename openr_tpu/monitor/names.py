@@ -183,6 +183,14 @@ TEMPLATES: dict[str, str | None] = {
     # per-jitted-function compile counts (monitor/compile_ledger.py) —
     # the fn segment is the jit wrapper's name
     "jax.compiles.*": "jax.compiles.<fn>",
+    # steady-state work ledger (monitor/work_ledger.py): per-pipeline-
+    # stage entities-touched / delta-size / proportionality-ratio
+    # gauges; the stage segment is a work_ledger.STAGES name. `.ratio`
+    # is a ratio-type gauge — fleet aggregation must never sum it
+    # (monitor/fleet.py).
+    "work.*.touched": "work.<stage>.touched",
+    "work.*.delta": "work.<stage>.delta",
+    "work.*.ratio": "work.<stage>.ratio",
     # kernel cost ledger (monitor/device.py): XLA cost/memory analysis
     # of each canonical jitted entry point, exported per (fn, field)
     "jax.kernel.*.*": "jax.kernel.<fn>.<field>",

@@ -1,0 +1,428 @@
+"""Steady-state work ledger: delta-proportionality accounting across
+the route dataflow.
+
+The ROADMAP's "end-to-end dataflow deltas" goal is that a publication
+delta flows as a *delta* through every pipeline stage. Before this
+module only two stages were counter-asserted (``fib.program_scan_routes``
+and the jit compile ledger); the remaining O(routes) walks — the
+cross-area merge fold and the PrefixManager RIB redistribution — were
+known only as orlint suppressions, not measured numbers. The ledger is
+the measurement surface: every stage reports *entities touched* against
+*delta size*, so steady state is provably delta-proportional or visibly
+not (the Bounded Dijkstra work-bound framing from PAPERS.md applied as
+a runtime accounting discipline).
+
+Surfaces (same plumbing lineage as the compile ledger / device
+telemetry planes):
+
+  * :class:`WorkScope` — a cheap accounting context for hot paths:
+    integer adds only, one slotted object per stage entry, **no
+    per-entity allocation**. ``with work_ledger.scope("fib", n) as ws:
+    ws.add(k)``.
+  * ``work.<stage>.touched / .delta / .ratio`` counters exported
+    through the existing Counters → Prometheus → fleet surface
+    (registered in monitor/names.py, documented in docs/Monitor.md;
+    ``*.ratio`` aggregates by distribution only — never summed —
+    in monitor/fleet.py).
+  * ``ctrl get_work_ledger`` + ``breeze monitor work`` — joined
+    per-stage rows with the top offending stage, the same server-side
+    join shape as ``get_device_telemetry``.
+  * ``@pytest.mark.work_proportional`` — the third conftest sanitizer
+    (after the asyncio and jit-compile ones): a marked test calls
+    :func:`mark_warm` after warmup; the fixture fails it if any
+    steady-state round touched more than ``k·delta + floor`` entities
+    in any scoped stage.
+  * an emulator soak invariant (emulator/invariants.py
+    ``check_work_ratios``) + a ``work.ratio_breach`` flight-recorder
+    event, so chaos runs catch full-table regressions with a replay
+    seed attached.
+
+Like the compile ledger, the ledger is process-global: stages are a
+process-wide resource (the emulator shares one ledger across in-process
+nodes, exactly as the compile ledger shares jit caches). Thread-safe:
+Decision's compute runs in ``asyncio.to_thread`` workers while Fib
+commits from the event loop.
+
+Stage vocabulary (STAGES): ``dirt`` (publication classification),
+``spf_full`` / ``spf_warm`` (full / topology-delta solves),
+``election`` (best-prefix election), ``assembly`` (scoped prefix route
+assembly), ``merge`` (cross-area RIB fold), ``diff`` (route-db diff),
+``fib`` (FIB programming), ``redistribute`` (PrefixManager RIB
+redistribution), ``full_sync`` (KvStore anti-entropy compare).
+``merge`` and ``redistribute`` are the two *known* O(routes) stages —
+the ledger's job is to report their honest ratios, not hide them
+(BENCH_WORK.json quantifies exactly how much steady-state work they
+own, so the next change can kill them against a measured baseline).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+#: the pipeline stage vocabulary (docs/Monitor.md "Work ledger")
+STAGES: tuple[str, ...] = (
+    "dirt",
+    "spf_full",
+    "spf_warm",
+    "election",
+    "assembly",
+    "merge",
+    "diff",
+    "fib",
+    "redistribute",
+    "full_sync",
+)
+
+#: sanitizer default: a steady-state round may touch up to
+#: ``k * delta + floor`` entities per stage. The floor absorbs
+#: per-round constants (bounded warm-start cones, fixed-size auxiliary
+#: walks) that are not per-entity work.
+DEFAULT_K = 8.0
+DEFAULT_FLOOR = 64
+
+
+@dataclass
+class _StageAcct:
+    """Cumulative + since-warm accounting for one stage."""
+
+    __slots__ = (
+        "touched", "delta", "rounds",
+        "warm_touched", "warm_delta", "warm_rounds",
+        "worst_touched", "worst_delta",
+    )
+
+    touched: int
+    delta: int
+    rounds: int
+    # snapshot taken at mark_warm(); since-warm = current - warm_*
+    warm_touched: int
+    warm_delta: int
+    warm_rounds: int
+    # the worst single round since mark_warm(), by touched/max(delta,1)
+    worst_touched: int
+    worst_delta: int
+
+    def __init__(self) -> None:
+        self.touched = 0
+        self.delta = 0
+        self.rounds = 0
+        self.warm_touched = 0
+        self.warm_delta = 0
+        self.warm_rounds = 0
+        self.worst_touched = 0
+        self.worst_delta = 0
+
+
+def _ratio(touched: int | float, delta: int | float) -> float:
+    return touched / max(delta, 1)
+
+
+class WorkScope:
+    """One stage entry's accounting context.
+
+    Steady-state cheap by contract: entering allocates ONE slotted
+    object; inside the scope the only operations are integer adds
+    (``add`` batches — never call it per entity when a batch count is
+    available). Exiting commits (touched, delta) to the process ledger
+    under its lock. Exceptions still commit (the work happened) and
+    propagate.
+    """
+
+    __slots__ = ("stage", "delta", "touched", "_ledger")
+
+    def __init__(self, stage: str, delta_size: int = 0, ledger=None):
+        self.stage = stage
+        self.delta = int(delta_size)
+        self.touched = 0
+        self._ledger = ledger if ledger is not None else _LEDGER
+
+    def add(self, n: int = 1) -> None:
+        self.touched += n
+
+    def set_delta(self, n: int) -> None:
+        """For stages whose delta is only known mid-scope (e.g. the
+        full_sync compare computes what it will ship)."""
+        self.delta = int(n)
+
+    def __enter__(self) -> "WorkScope":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._ledger.commit(self.stage, self.touched, self.delta)
+        return False
+
+
+class _NullScope:
+    """Shared no-op scope returned while the ledger is disabled (the
+    bench overhead control): zero allocation, zero lock traffic."""
+
+    __slots__ = ()
+
+    def add(self, n: int = 1) -> None:
+        pass
+
+    def set_delta(self, n: int) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL_SCOPE = _NullScope()
+
+
+class WorkLedger:
+    """Process-wide per-stage work accounting (see module docstring)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stages: dict[str, _StageAcct] = {s: _StageAcct() for s in STAGES}
+        self.enabled = True
+        self.warm_marked = False
+
+    # ----------------------------------------------------------- record
+
+    def scope(self, stage: str, delta_size: int = 0):
+        if not self.enabled:
+            return _NULL_SCOPE
+        return WorkScope(stage, delta_size, ledger=self)
+
+    def commit(self, stage: str, touched: int, delta: int) -> None:
+        """Record one completed stage round. Integer adds under the
+        lock; called once per scope exit, never per entity."""
+        if not self.enabled:
+            return
+        with self._lock:
+            acct = self._stages.get(stage)
+            if acct is None:
+                acct = self._stages.setdefault(stage, _StageAcct())
+            acct.touched += touched
+            acct.delta += delta
+            acct.rounds += 1
+            if self.warm_marked and _ratio(touched, delta) > _ratio(
+                acct.worst_touched, acct.worst_delta
+            ):
+                acct.worst_touched = touched
+                acct.worst_delta = delta
+
+    # ------------------------------------------------------- warm marks
+
+    def mark_warm(self) -> None:
+        """Declare the warmup boundary: rounds committed after this are
+        steady state — tracked per stage (since-warm totals + the worst
+        single round) and judged by :meth:`steady_violations`. Same
+        contract as ``compile_ledger.mark_warm()``."""
+        with self._lock:
+            self.warm_marked = True
+            for acct in self._stages.values():
+                acct.warm_touched = acct.touched
+                acct.warm_delta = acct.delta
+                acct.warm_rounds = acct.rounds
+                acct.worst_touched = 0
+                acct.worst_delta = 0
+
+    def reset_warm(self) -> None:
+        with self._lock:
+            self.warm_marked = False
+            for acct in self._stages.values():
+                acct.warm_touched = acct.touched
+                acct.warm_delta = acct.delta
+                acct.warm_rounds = acct.rounds
+                acct.worst_touched = 0
+                acct.worst_delta = 0
+
+    def since_warm(self) -> dict[str, dict]:
+        """{stage: {touched, delta, rounds, ratio, worst_ratio}} for
+        stages with steady-state rounds; empty when never marked."""
+        if not self.warm_marked:
+            return {}
+        out: dict[str, dict] = {}
+        with self._lock:
+            for stage, a in self._stages.items():
+                rounds = a.rounds - a.warm_rounds
+                if rounds <= 0:
+                    continue
+                touched = a.touched - a.warm_touched
+                delta = a.delta - a.warm_delta
+                out[stage] = {
+                    "touched": touched,
+                    "delta": delta,
+                    "rounds": rounds,
+                    "ratio": round(_ratio(touched, delta), 3),
+                    "worst_ratio": round(
+                        _ratio(a.worst_touched, a.worst_delta), 3
+                    ),
+                    "worst_touched": a.worst_touched,
+                    "worst_delta": a.worst_delta,
+                }
+        return out
+
+    def steady_violations(
+        self,
+        k: float = DEFAULT_K,
+        floor: int = DEFAULT_FLOOR,
+        exempt: tuple[str, ...] = (),
+    ) -> list[dict]:
+        """Stages whose worst steady-state round touched more than
+        ``k * delta + floor`` entities — the delta-proportionality
+        contract the ``work_proportional`` sanitizer enforces. Exempt
+        the stages a test legitimately drives O(routes) (today: merge
+        and redistribute, until their walks are killed)."""
+        out: list[dict] = []
+        for stage, row in self.since_warm().items():
+            if stage in exempt:
+                continue
+            t, d = row["worst_touched"], row["worst_delta"]
+            if t > k * d + floor:
+                out.append(
+                    {
+                        "stage": stage,
+                        "touched": t,
+                        "delta": d,
+                        "ratio": round(_ratio(t, d), 2),
+                        "bound": round(k * d + floor, 1),
+                    }
+                )
+        out.sort(key=lambda r: -r["ratio"])
+        return out
+
+    # ---------------------------------------------------------- queries
+
+    def rows(self) -> list[dict]:
+        """Per-stage joined rows (cumulative + since-warm), the ctrl /
+        breeze table. Stages with zero rounds are omitted."""
+        steady = self.since_warm()
+        out: list[dict] = []
+        with self._lock:
+            for stage in self._stages:
+                a = self._stages[stage]
+                if a.rounds == 0:
+                    continue
+                row = {
+                    "stage": stage,
+                    "touched": a.touched,
+                    "delta": a.delta,
+                    "rounds": a.rounds,
+                    "ratio": round(_ratio(a.touched, a.delta), 3),
+                }
+                s = steady.get(stage)
+                row["steady"] = s
+                out.append(row)
+        # pipeline order, not alphabetical: the table reads as dataflow
+        order = {s: i for i, s in enumerate(STAGES)}
+        out.sort(key=lambda r: order.get(r["stage"], len(order)))
+        return out
+
+    def top_offender(self) -> dict | None:
+        """The stage with the worst proportionality ratio (steady-state
+        ratio when warm was marked, cumulative otherwise) — the 'where
+        is my steady-state time going' headline."""
+        rows = self.rows()
+        if not rows:
+            return None
+
+        def key(r: dict) -> float:
+            s = r.get("steady")
+            return s["ratio"] if s else r["ratio"]
+
+        worst = max(rows, key=key)
+        return {"stage": worst["stage"], "ratio": key(worst)}
+
+    def reset(self) -> None:
+        """Drop all accounting (tests/benches)."""
+        with self._lock:
+            self._stages = {s: _StageAcct() for s in STAGES}
+            self.warm_marked = False
+
+    # ----------------------------------------------------------- export
+
+    def export_to(self, counters) -> None:
+        """Stamp every active stage into a Counters registry as
+        ``work.<stage>.touched/delta/ratio`` gauges (monitor/names.py).
+        Values are process-wide, like the compile ledger's."""
+        for row in self.rows():
+            stage = row["stage"]
+            counters.set(f"work.{stage}.touched", float(row["touched"]))
+            counters.set(f"work.{stage}.delta", float(row["delta"]))
+            counters.set(f"work.{stage}.ratio", float(row["ratio"]))
+
+
+#: the process ledger every consumer shares
+_LEDGER = WorkLedger()
+
+
+def ledger() -> WorkLedger:
+    return _LEDGER
+
+
+def scope(stage: str, delta_size: int = 0):
+    """``with work_ledger.scope("merge", len(scope_set)) as ws: ...`` —
+    the hot-path entry point (orlint OR013's structural contract)."""
+    return _LEDGER.scope(stage, delta_size)
+
+
+def commit(stage: str, touched: int, delta: int) -> None:
+    """Scope-free commit for sites whose counts are already computed
+    (e.g. Fib's delta-book scan)."""
+    _LEDGER.commit(stage, touched, delta)
+
+
+def mark_warm() -> None:
+    _LEDGER.mark_warm()
+
+
+def reset_warm() -> None:
+    _LEDGER.reset_warm()
+
+
+def since_warm() -> dict[str, dict]:
+    return _LEDGER.since_warm()
+
+
+def rows() -> list[dict]:
+    return _LEDGER.rows()
+
+
+def export_to(counters) -> None:
+    _LEDGER.export_to(counters)
+
+
+def reset() -> None:
+    _LEDGER.reset()
+
+
+def steady_violations(
+    k: float = DEFAULT_K,
+    floor: int = DEFAULT_FLOOR,
+    exempt: tuple[str, ...] = (),
+) -> list[dict]:
+    return _LEDGER.steady_violations(k=k, floor=floor, exempt=exempt)
+
+
+def set_enabled(on: bool) -> None:
+    """Bench control: the overhead comparison runs the same workload
+    with scopes no-op'd (shared null scope, zero lock traffic)."""
+    _LEDGER.enabled = bool(on)
+
+
+def steady_violation_report(
+    k: float = DEFAULT_K,
+    floor: int = DEFAULT_FLOOR,
+    exempt: tuple[str, ...] = (),
+) -> str | None:
+    """Human-readable violation detail for the conftest sanitizer and
+    the soak invariant, or None when every scoped stage stayed
+    delta-proportional."""
+    bad = _LEDGER.steady_violations(k=k, floor=floor, exempt=exempt)
+    if not bad:
+        return None
+    parts = [
+        f"{r['stage']}: touched {r['touched']} vs delta {r['delta']} "
+        f"(ratio {r['ratio']}, bound {r['bound']})"
+        for r in bad
+    ]
+    return "; ".join(parts)

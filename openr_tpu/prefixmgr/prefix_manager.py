@@ -22,6 +22,7 @@ from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.config import Config, OriginatedPrefix
 from openr_tpu.kvstore.client import KvStoreClient
 from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.monitor import work_ledger
 from openr_tpu.types.network import IpPrefix
 from openr_tpu.types.routes import RouteUpdate, RouteUpdateType
 from openr_tpu.types.serde import to_wire
@@ -156,7 +157,7 @@ class PrefixManager(OpenrModule):
             for r in ev.ranges:
                 self._range_entries.pop((ev.source, r.key()), None)
         elif ev.type == PrefixEventType.WITHDRAW_SOURCE:
-            for key in [k for k in self._entries if k[0] == ev.source]:
+            for key in [k for k in self._entries if k[0] == ev.source]:  # orlint: disable=OR013 — config-event withdraw-all sweep, not the steady-state churn dataflow
                 del self._entries[key]
             for key in [k for k in self._range_entries if k[0] == ev.source]:
                 del self._range_entries[key]
@@ -213,16 +214,29 @@ class PrefixManager(OpenrModule):
         import dataclasses
 
         all_areas = set(self.config.area_ids())
-        if upd.type == RouteUpdateType.FULL_SYNC:
-            for key in [
-                k for k in self._entries if k[0] == PrefixSource.RIB
-            ]:
-                del self._entries[key]
-        # prefixes this node originates itself (hoisted: a per-prefix
-        # scan of the entry book would make full syncs quadratic)
-        owned = {
-            k[1] for k in self._entries if k[0] != PrefixSource.RIB
-        }
+        # work ledger `redistribute` stage: delta = the RouteUpdate's
+        # churn, touched = the entry-book walks + per-update work. The
+        # `owned` rebuild below is O(entries) EVERY round — this is one
+        # of the two known steady-state O(routes) walks ISSUE 16 asks
+        # the ledger to quantify honestly (BENCH_WORK.json), not hide.
+        with work_ledger.scope(
+            "redistribute",
+            len(upd.unicast_to_update) + len(upd.unicast_to_delete),
+        ) as ws:
+            if upd.type == RouteUpdateType.FULL_SYNC:
+                ws.add(len(self._entries))
+                for key in [
+                    k for k in self._entries if k[0] == PrefixSource.RIB
+                ]:
+                    del self._entries[key]
+            # prefixes this node originates itself (hoisted: a
+            # per-prefix scan of the entry book would make full syncs
+            # quadratic)
+            ws.add(len(self._entries))
+            owned = {
+                k[1] for k in self._entries if k[0] != PrefixSource.RIB
+            }
+            ws.add(len(upd.unicast_to_update) + len(upd.unicast_to_delete))
         for prefix, rib in upd.unicast_to_update.items():
             best = rib.best_entry
             if best is None:
@@ -282,10 +296,14 @@ class PrefixManager(OpenrModule):
 
     def _best_entries(self) -> dict[IpPrefix, tuple[PrefixEntry, tuple[str, ...]]]:
         best: dict[IpPrefix, tuple[PrefixSource, PrefixEntry, tuple[str, ...]]] = {}
-        for (source, prefix), (entry, areas) in self._entries.items():
-            cur = best.get(prefix)
-            if cur is None or source > cur[0]:
-                best[prefix] = (source, entry, areas)
+        # the advertisement-side O(entries) walk of the redistribution
+        # pass (runs per _sync_advertisements; no delta to credit)
+        with work_ledger.scope("redistribute", 0) as ws:
+            ws.add(len(self._entries))
+            for (source, prefix), (entry, areas) in self._entries.items():
+                cur = best.get(prefix)
+                if cur is None or source > cur[0]:
+                    best[prefix] = (source, entry, areas)
         return {p: (e, a) for p, (_s, e, a) in best.items()}
 
     def _sync_ranges(self) -> None:
@@ -404,6 +422,10 @@ class PrefixManager(OpenrModule):
                 del self._advertised[prefix]
         if self.counters:
             self.counters.set("prefixmgr.advertised", len(self._advertised))
+            # work.redistribute.* gauges refresh at the sync edge — the
+            # redistribution pass's own export point (a PrefixManager
+            # without a local Decision still reports its walks)
+            work_ledger.export_to(self.counters)
 
     # ------------------------------------------------------------ accessors
 

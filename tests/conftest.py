@@ -195,6 +195,52 @@ def jit_compile_sanitizer(request):
         )
 
 
+# --------------------------------------------------------------------------
+# work-proportionality sanitizer: the third sanitizer in the PR 5/PR 7
+# lineage (asyncio, jit compiles, now dataflow work). A test marked
+# @pytest.mark.work_proportional declares its warmup boundary by calling
+# work_ledger.mark_warm(); the autouse fixture then FAILS the test if any
+# steady-state round touched more than k*delta + floor entities in any
+# scoped pipeline stage (openr_tpu/monitor/work_ledger.py) — the delta-
+# proportionality contract the scoped-rebuild paths exist to uphold.
+# Marker kwargs: k= (slope, default work_ledger.DEFAULT_K), floor=
+# (per-round constant allowance), exempt= (stage names allowed to stay
+# O(routes) — e.g. ("merge", "redistribute") for multi-area/ABR tests
+# until those walks are killed). Unmarked tests are unaffected.
+
+from openr_tpu.monitor import work_ledger  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def work_proportional_sanitizer(request):
+    marked = request.node.get_closest_marker("work_proportional")
+    led = work_ledger.ledger()
+    led.reset_warm()
+    yield
+    if not marked:
+        led.reset_warm()
+        return
+    if not led.warm_marked:
+        pytest.fail(
+            "@pytest.mark.work_proportional test never called "
+            "work_ledger.mark_warm() — mark the end of warmup so the "
+            "steady-state rounds can be checked"
+        )
+    report = work_ledger.steady_violation_report(
+        k=marked.kwargs.get("k", work_ledger.DEFAULT_K),
+        floor=marked.kwargs.get("floor", work_ledger.DEFAULT_FLOOR),
+        exempt=tuple(marked.kwargs.get("exempt", ())),
+    )
+    led.reset_warm()
+    if report:
+        pytest.fail(
+            f"work-proportionality sanitizer: steady-state round did "
+            f"O(table) work in a scoped stage ({report}) — a full-table "
+            f"walk leaked into the delta path (docs/Monitor.md "
+            f"\"Work ledger\")"
+        )
+
+
 @pytest.fixture(autouse=True)
 def asyncio_sanitizer(request):
     """Fail any test that leaks pending tasks or never-retrieved task

@@ -7,7 +7,7 @@ OR006 determinism) apply; the engine's directory walker skips
 explicit argument (``python -m tools.orlint
 tests/fixtures/orlint/decision/known_bad.py``).
 
-EXPECTED: exactly one finding per rule, OR001..OR011 (asserted by
+EXPECTED: exactly one finding per rule, OR001..OR013 (asserted by
 tests/test_orlint.py::test_known_bad_fixture_covers_every_rule and the
 ci.sh smoke lane).
 """
@@ -32,7 +32,12 @@ class Bad:
         await asyncio.sleep(jitter)
         self._pending = pending + [1]  # OR003: stale read across await
         self.counters.increment("bogus.counter.name")  # OR007: unregistered
-        for _p, _per in self.ps.prefixes.items():  # OR012: per-prefix loop
+        # the WorkScope satisfies OR013 (the walk is accounted) while
+        # OR012 still fires on the per-prefix loop itself
+        with WorkScope("election", 1):
+            for _p, _per in self.ps.prefixes.items():  # OR012: per-prefix loop
+                pass
+        for _k in self._entries:  # OR013: unscoped full-table walk
             pass
         return json.dumps({"pub": 1})  # OR011: text frame on a wire seam
 

@@ -140,6 +140,29 @@ def test_aggregate_counters_distributions():
     assert len(rows) == 1 and rows[0][0] == "kvstore.floods_sent"
 
 
+def test_aggregate_counters_never_sums_ratio_gauges():
+    """`*.ratio` keys (the work ledger's `work.<stage>.ratio`) are
+    intensive gauges: the fleet surface must publish their distribution
+    but refuse the sum — 18 nodes each at ratio 1.0 is NOT ratio 18
+    (docs/Monitor.md "Work ledger"). Extensive counters keep summing."""
+    snaps = {
+        f"n{i}": {
+            "work.fib.ratio": 1.0 + i / 10,
+            "work.fib.touched": 100.0 * i,
+        }
+        for i in range(4)
+    }
+    agg = aggregate_counters(snaps)
+    r = agg["work.fib.ratio"]
+    assert r["sum"] is None
+    assert r["nodes"] == 4 and r["min"] == 1.0 and r["max"] == 1.3
+    assert r["max_node"] == "n3"
+    assert agg["work.fib.touched"]["sum"] == 600.0
+    # the breeze fleet table renders distributions only, so a None sum
+    # must not break row formatting
+    assert fleet_rows(agg)
+
+
 def test_cluster_fleet_counters():
     async def body():
         c = Cluster.from_edges([("a", "b"), ("b", "c")], solver="cpu")

@@ -24,7 +24,7 @@ KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
 
 ALL_CODES = {
     "OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007",
-    "OR008", "OR009", "OR010", "OR011", "OR012",
+    "OR008", "OR009", "OR010", "OR011", "OR012", "OR013",
 }
 
 
@@ -622,6 +622,66 @@ def test_or012_prefix_loop_scope(tmp_path):
         select={"OR012"},
     )
     assert codes_of(scoped) == []
+
+
+def test_or013_work_scope(tmp_path):
+    """Full-table loops in decision/fib/prefixmgr must sit inside a
+    WorkScope; prefixmgr's `_entries` book is in scope too, and a
+    nested def resets the lexical scope."""
+    snippet = """
+    def fold(self, ps):
+        for p in ps.prefixes:
+            pass
+        walked = [e for e in self._entries.values()]
+        return walked
+    """
+    for rel in (
+        "openr_tpu/decision/m.py",
+        "openr_tpu/fib/m.py",
+        "openr_tpu/prefixmgr/m.py",
+    ):
+        hit = lint_snippet(tmp_path, snippet, rel=rel, select={"OR013"})
+        assert codes_of(hit) == ["OR013", "OR013"], rel
+    out = lint_snippet(
+        tmp_path, snippet, rel="openr_tpu/kvstore/m.py", select={"OR013"}
+    )
+    assert codes_of(out) == []
+    scoped = lint_snippet(
+        tmp_path,
+        """
+        from openr_tpu.monitor import work_ledger
+        from openr_tpu.monitor.work_ledger import WorkScope
+
+        def fold(self, ps, delta):
+            with work_ledger.scope("merge", len(delta)) as ws:
+                for p in ps.prefixes:
+                    ws.add()
+            with WorkScope("redistribute", 1):
+                walked = [e for e in self._entries.values()]
+            return walked
+        """,
+        rel="openr_tpu/prefixmgr/m.py",
+        select={"OR013"},
+    )
+    assert codes_of(scoped) == []
+    # a nested def inside the with starts a fresh accounting context:
+    # the enclosing scope can't cover calls made later through it
+    nested = lint_snippet(
+        tmp_path,
+        """
+        from openr_tpu.monitor import work_ledger
+
+        def fold(self, ps):
+            with work_ledger.scope("merge", 1):
+                def later():
+                    for p in ps.prefixes:
+                        pass
+                return later
+        """,
+        rel="openr_tpu/decision/m.py",
+        select={"OR013"},
+    )
+    assert codes_of(nested) == ["OR013"]
 
 
 # ------------------------------------------- suppression + baseline plumbing

@@ -7,6 +7,8 @@ learned area appended to area_stack; the stack prevents loops.
 
 import asyncio
 
+import pytest
+
 from openr_tpu.config import (
     AreaConfig,
     Config,
@@ -20,6 +22,7 @@ from openr_tpu.emulator.cluster import (
     FAST_SPARK,
     LinkSpec,
 )
+from openr_tpu.monitor import Counters, work_ledger
 from openr_tpu.prefixmgr.prefix_manager import PrefixManager, PrefixSource
 from openr_tpu.types.network import IpPrefix, NextHop
 from openr_tpu.types.routes import RibEntry, RouteUpdate, RouteUpdateType
@@ -127,6 +130,83 @@ def test_full_sync_replaces_rib_entries():
     )
     assert (PrefixSource.RIB, p1) not in pm._entries
     assert (PrefixSource.RIB, p2) in pm._entries
+
+
+# redistribute is one of the two known O(routes) walks (docs/Monitor.md
+# "Work ledger") — exempted from the proportionality gate, pinned by
+# the explicit baseline assertions below instead
+@pytest.mark.work_proportional(exempt=("redistribute",))
+def test_redistribution_work_under_churn():
+    """Redistribution-under-churn work accounting with a PINNED ratio
+    baseline: every churn round's fold + advertisement pass walks the
+    whole entry book, so `work.redistribute` must report touched ≈ book
+    per commit — honest O(routes). The pins cut both ways: the walk
+    cannot silently get worse (per-update re-walks would go quadratic),
+    and the day redistribution becomes delta-proportional this test
+    fails loudly and the baseline moves down with the fix."""
+    work_ledger.reset()
+    cfg = Config(
+        NodeConfig(
+            node_name="abr",
+            areas=(AreaConfig(area_id="A"), AreaConfig(area_id="B")),
+        )
+    )
+    kv = _RecordingKv()
+    pm = PrefixManager(cfg, kv, counters=Counters())
+
+    book = 1500
+    seed = {
+        IpPrefix.make(f"10.{40 + (i >> 8)}.{i & 0xFF}.0/24"): _rib_entry(
+            f"10.{40 + (i >> 8)}.{i & 0xFF}.0/24", "A"
+        )
+        for i in range(book)
+    }
+    pm.fold_rib_update(RouteUpdate(unicast_to_update=seed))
+    pm._sync_advertisements()
+    assert len(pm._entries) == book
+    work_ledger.mark_warm()
+
+    rounds = 10
+    for i in range(rounds):
+        pstr = f"10.99.{i}.0/24"
+        p = IpPrefix.make(pstr)
+        pm.fold_rib_update(
+            RouteUpdate(unicast_to_update={p: _rib_entry(pstr, "A")})
+        )
+        pm._sync_advertisements()
+        pm.fold_rib_update(RouteUpdate(unicast_to_delete=[p]))
+        pm._sync_advertisements()
+
+    sw = work_ledger.since_warm()["redistribute"]
+    # 2 commits per fold+sync pair (the fold scope + the _best_entries
+    # advertisement walk), 2 pairs per round
+    commits = rounds * 4
+    assert sw["rounds"] == commits
+    assert sw["delta"] == rounds * 2  # one prefix in, one out, per round
+    # PINNED: each commit walks the book once — no more, no less.
+    # Lower bound = honest reporting; upper bound = the quadratic guard
+    # (a per-update re-walk of the book would blow straight through it).
+    per_commit = sw["touched"] / commits
+    assert book * 0.95 <= per_commit <= book * 1.1, sw
+    assert sw["worst_touched"] <= book + 8, sw
+
+    # a burst fold (32 updates in one RouteUpdate) still walks the book
+    # ONCE — per-round cost, not per-update cost
+    burst = {
+        IpPrefix.make(f"10.98.{j}.0/24"): _rib_entry(f"10.98.{j}.0/24", "A")
+        for j in range(32)
+    }
+    before = work_ledger.since_warm()["redistribute"]["touched"]
+    pm.fold_rib_update(RouteUpdate(unicast_to_update=burst))
+    fold_touched = (
+        work_ledger.since_warm()["redistribute"]["touched"] - before
+    )
+    assert fold_touched <= book + 3 * 32, fold_touched
+
+    # the sync edge exported the honest gauges through Counters
+    assert pm.counters.get("work.redistribute.touched") > 0
+    ratio = pm.counters.get("work.redistribute.ratio")
+    assert ratio > 1.0  # visibly super-proportional, as documented
 
 
 def test_abr_end_to_end():

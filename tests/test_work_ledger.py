@@ -1,0 +1,366 @@
+"""Work ledger tests (openr_tpu/monitor/work_ledger.py, docs/Monitor.md
+"Work ledger"): WorkScope/WorkLedger accounting, warm-mark semantics,
+the k*delta+floor violation predicate, counter export, the ctrl export
+surface, the soak invariant (emulator/invariants.check_work_ratios),
+and the sanitizer trip-proof — a deliberate full-table walk after
+mark_warm MUST be caught by the exact predicate the conftest
+``work_proportional`` fixture runs."""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from openr_tpu.monitor import work_ledger
+from openr_tpu.monitor.work_ledger import (
+    DEFAULT_FLOOR,
+    DEFAULT_K,
+    STAGES,
+    WorkLedger,
+    WorkScope,
+    _NULL_SCOPE,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------ accounting
+
+
+def test_scope_commits_on_exit():
+    led = WorkLedger()
+    with WorkScope("fib", 3, ledger=led) as ws:
+        ws.add(2)
+        ws.add()
+    (row,) = led.rows()
+    assert row["stage"] == "fib"
+    assert row["touched"] == 3 and row["delta"] == 3 and row["rounds"] == 1
+    assert row["ratio"] == 1.0
+    assert row["steady"] is None  # never marked warm
+
+
+def test_set_delta_mid_scope():
+    """full_sync only knows what it will ship after the compare."""
+    led = WorkLedger()
+    with led.scope("full_sync", 0) as ws:
+        ws.add(100)
+        ws.set_delta(7)
+    (row,) = led.rows()
+    assert row["delta"] == 7 and row["touched"] == 100
+
+
+def test_scope_commits_even_on_exception():
+    led = WorkLedger()
+    with pytest.raises(RuntimeError):
+        with led.scope("merge", 5) as ws:
+            ws.add(40)
+            raise RuntimeError("solve blew up")
+    (row,) = led.rows()
+    assert row["touched"] == 40  # the work happened; it is accounted
+
+
+def test_disabled_ledger_is_null_scope():
+    """The bench overhead control: disabling returns the shared no-op
+    scope (zero allocation) and drops commits entirely."""
+    led = WorkLedger()
+    led.enabled = False
+    s = led.scope("election", 9)
+    assert s is _NULL_SCOPE
+    with s as ws:
+        ws.add(1000)
+        ws.set_delta(1)
+    led.commit("election", 1000, 1)
+    assert led.rows() == []
+    led.enabled = True
+    with led.scope("election", 1) as ws:
+        ws.add(1)
+    assert len(led.rows()) == 1
+
+
+def test_ratio_guards_zero_delta():
+    """A delta-0 round (e.g. merge re-fold triggered by topology dirt)
+    must report touched/1, not divide by zero."""
+    led = WorkLedger()
+    led.commit("merge", 500, 0)
+    (row,) = led.rows()
+    assert row["ratio"] == 500.0
+
+
+# ------------------------------------------------------------ warm marks
+
+
+def test_since_warm_separates_warmup_from_steady():
+    led = WorkLedger()
+    led.commit("election", 10_000, 1)  # warmup full build: not judged
+    led.mark_warm()
+    led.commit("election", 4, 2)
+    led.commit("election", 6, 2)
+    sw = led.since_warm()
+    assert set(sw) == {"election"}
+    row = sw["election"]
+    assert row["touched"] == 10 and row["delta"] == 4 and row["rounds"] == 2
+    assert row["ratio"] == 2.5
+    # worst single round (the 6/2 one) is tracked, not the aggregate
+    assert row["worst_touched"] == 6 and row["worst_delta"] == 2
+    # cumulative rows still include the warmup
+    (full,) = led.rows()
+    assert full["touched"] == 10_010
+    assert full["steady"] == row
+
+
+def test_since_warm_empty_until_marked():
+    led = WorkLedger()
+    led.commit("fib", 5, 5)
+    assert led.since_warm() == {}
+    assert led.steady_violations() == []
+
+
+def test_reset_warm_disarms():
+    led = WorkLedger()
+    led.mark_warm()
+    led.commit("dirt", 10_000, 1)
+    assert led.steady_violations()
+    led.reset_warm()
+    assert not led.warm_marked
+    assert led.steady_violations() == []
+
+
+def test_worst_round_tracks_single_round_not_aggregate():
+    """One bad O(table) round must not be averaged away by many good
+    rounds — the violation predicate judges the WORST round."""
+    led = WorkLedger()
+    led.mark_warm()
+    for _ in range(100):
+        led.commit("fib", 2, 2)  # perfectly proportional
+    led.commit("fib", 50_000, 1)  # the one full-table walk
+    sw = led.since_warm()["fib"]
+    assert sw["worst_touched"] == 50_000 and sw["worst_delta"] == 1
+    (v,) = led.steady_violations()
+    assert v["stage"] == "fib" and v["touched"] == 50_000
+
+
+# ----------------------------------------------------------- violations
+
+
+def test_steady_violations_bound_and_exempt():
+    led = WorkLedger()
+    led.mark_warm()
+    led.commit("election", 1000, 2)  # 1000 > 8*2+64 → violation
+    led.commit("assembly", 70, 2)  # 70 <= 8*2+64=80 → within bound
+    led.commit("merge", 90_000, 2)  # exempt below
+    bad = led.steady_violations(exempt=("merge",))
+    assert [v["stage"] for v in bad] == ["election"]
+    v = bad[0]
+    assert v["bound"] == DEFAULT_K * 2 + DEFAULT_FLOOR
+    assert v["ratio"] == 500.0
+    # without the exemption merge appears too, sorted worst-ratio first
+    bad2 = led.steady_violations()
+    assert [v["stage"] for v in bad2] == ["merge", "election"]
+
+
+def test_violation_knobs():
+    led = WorkLedger()
+    led.mark_warm()
+    led.commit("dirt", 50, 1)
+    assert led.steady_violations(k=1.0, floor=10)
+    assert not led.steady_violations(k=1.0, floor=64)
+    assert not led.steady_violations(k=50.0, floor=0)
+
+
+def test_steady_violation_report_strings():
+    work_ledger.reset()
+    try:
+        work_ledger.mark_warm()
+        assert work_ledger.steady_violation_report() is None
+        work_ledger.commit("election", 9_999, 1)
+        report = work_ledger.steady_violation_report()
+        assert report is not None
+        assert "election" in report and "9999" in report
+    finally:
+        work_ledger.reset()
+
+
+# ------------------------------------------------------- queries/export
+
+
+def test_rows_in_pipeline_order():
+    led = WorkLedger()
+    for stage in ("fib", "dirt", "merge", "election"):
+        led.commit(stage, 1, 1)
+    got = [r["stage"] for r in led.rows()]
+    order = {s: i for i, s in enumerate(STAGES)}
+    assert got == sorted(got, key=order.__getitem__)
+    assert got[0] == "dirt" and got[-1] == "fib"
+
+
+def test_top_offender_prefers_steady_ratio():
+    led = WorkLedger()
+    led.commit("merge", 100_000, 1)  # warmup: huge cumulative ratio
+    led.mark_warm()
+    led.commit("merge", 2, 2)
+    led.commit("election", 90, 3)
+    top = led.top_offender()
+    # merge's cumulative ratio is 50k+, but steady-state it behaved;
+    # the offender headline judges the steady window when armed
+    assert top == {"stage": "election", "ratio": 30.0}
+    assert WorkLedger().top_offender() is None
+
+
+def test_export_to_counters():
+    class _Reg:
+        def __init__(self):
+            self.gauges = {}
+
+        def set(self, key, val):
+            self.gauges[key] = val
+
+    led = WorkLedger()
+    led.commit("fib", 6, 6)
+    led.commit("merge", 30, 3)
+    reg = _Reg()
+    led.export_to(reg)
+    assert reg.gauges["work.fib.touched"] == 6.0
+    assert reg.gauges["work.fib.ratio"] == 1.0
+    assert reg.gauges["work.merge.ratio"] == 10.0
+    # only active stages export — no zero-round placeholder keys
+    assert "work.spf_full.ratio" not in reg.gauges
+
+
+# ------------------------------------------------- sanitizer trip-proof
+
+
+def test_sanitizer_predicate_trips_on_deliberate_full_table_walk():
+    """The acceptance proof for @pytest.mark.work_proportional: drive
+    the REAL process ledger through the real scope API with a steady
+    round that walks a full table for a tiny delta, and assert the
+    exact predicate the conftest fixture evaluates
+    (steady_violation_report) comes back non-None naming the stage.
+    The walk is deliberate — a 1-entry delta touching a 5000-entry
+    table is precisely the regression the sanitizer exists to stop."""
+    work_ledger.reset()
+    try:
+        table = [object()] * 5000
+        # warmup round: full walks before mark_warm are legitimate
+        with work_ledger.scope("election", len(table)) as ws:
+            ws.add(len(table))
+        work_ledger.mark_warm()
+        # steady round: delta of 1, but the loop visits EVERY entry
+        with work_ledger.scope("election", 1) as ws:
+            for _ in table:
+                ws.add()
+        report = work_ledger.steady_violation_report(
+            k=DEFAULT_K, floor=DEFAULT_FLOOR
+        )
+        assert report is not None and "election" in report
+        assert "5000" in report
+        # the same walk under an exemption (how merge/redistribute ride
+        # today) is allowed through
+        assert (
+            work_ledger.steady_violation_report(exempt=("election",)) is None
+        )
+    finally:
+        work_ledger.reset()
+
+
+@pytest.mark.work_proportional
+def test_sanitizer_passes_proportional_work():
+    """The positive arm: a marked test whose steady rounds stay inside
+    k*delta+floor must pass the autouse fixture's teardown check."""
+    work_ledger.reset()
+    with work_ledger.scope("fib", 4096) as ws:
+        ws.add(4096)  # warm boot
+    work_ledger.mark_warm()
+    for _ in range(5):
+        with work_ledger.scope("fib", 2) as ws:
+            ws.add(2)
+
+
+# ------------------------------------------------------- soak invariant
+
+
+class _FlightCounters:
+    def __init__(self):
+        self.events = []
+
+    def flight_record(self, kind, **attrs):
+        self.events.append((kind, attrs))
+
+
+def test_check_work_ratios_invariant():
+    from openr_tpu.emulator.invariants import (
+        WORK_EXEMPT_STAGES,
+        check_work_ratios,
+    )
+
+    cluster = SimpleNamespace(
+        nodes={"a": SimpleNamespace(counters=_FlightCounters())}
+    )
+    work_ledger.reset()
+    try:
+        # disarmed until a soak marks the warm boundary
+        work_ledger.commit("fib", 99_999, 1)
+        assert check_work_ratios(cluster) == []
+
+        work_ledger.mark_warm()
+        # exempt stages may stay O(routes) — including diff, which is
+        # honestly O(tables) under the storm-driven topology dirt a
+        # soak round always contains
+        for stage in WORK_EXEMPT_STAGES:
+            work_ledger.commit(stage, 50_000, 0)
+        assert check_work_ratios(cluster) == []
+
+        work_ledger.commit("election", 50_000, 1)
+        (v,) = check_work_ratios(cluster)
+        assert v.kind == "work.ratio_breach" and v.node is None
+        assert "election" in v.detail and "50000" in v.detail
+        # the breach landed a flight-recorder event for the post-mortem
+        (ev,) = [
+            e
+            for n in cluster.nodes.values()
+            for e in n.counters.events
+        ]
+        assert ev[0] == "work.ratio_breach"
+        assert ev[1]["stage"] == "election" and ev[1]["touched"] == 50_000
+    finally:
+        work_ledger.reset()
+
+
+# ---------------------------------------------------------- ctrl export
+
+
+def test_ctrl_get_work_ledger():
+    from openr_tpu.emulator import Cluster
+    from openr_tpu.rpc import RpcClient
+
+    work_ledger.reset()
+
+    async def body():
+        c = Cluster.from_edges([("a", "b")], enable_ctrl=True)
+        await c.start()
+        try:
+            await c.wait_converged(timeout=30)
+            cli = RpcClient(port=c.nodes["a"].ctrl.port)
+            await cli.connect()
+            try:
+                return await cli.call("get_work_ledger", {})
+            finally:
+                await cli.close()
+        finally:
+            await c.stop()
+
+    res = run(body())
+    assert res["node"] == "a"
+    assert res["warm_marked"] is False
+    stages = {r["stage"] for r in res["stages"]}
+    # bring-up drove the real dataflow: classification, election and
+    # the route-db diff all ran at least once
+    assert {"dirt", "election", "diff"} <= stages
+    assert stages <= set(STAGES)
+    for row in res["stages"]:
+        assert row["rounds"] >= 1
+        assert row["ratio"] == pytest.approx(
+            row["touched"] / max(row["delta"], 1), abs=1e-3
+        )
+    assert res["top_offender"]["stage"] in stages

@@ -1,0 +1,142 @@
+"""OR013: full-route-table loop outside a WorkScope in the dataflow
+hot paths.
+
+Scope: ``decision/``, ``fib/``, and ``prefixmgr/``. ISSUE 16's work
+ledger (openr_tpu/monitor/work_ledger.py) makes every pipeline stage
+account entities-touched against delta-size; the contract only holds
+if full-table walks are *visible* to it. Any ``for`` loop or
+comprehension iterating a whole-table attribute —
+
+  * OR012's set (``prefixes``, ``unicast_routes``, the Fib books), plus
+  * PrefixManager's ``_entries`` redistribution book —
+
+must sit lexically inside a ``with WorkScope(...)`` /
+``with work_ledger.scope(...)`` block (so its cost lands in
+``work.<stage>.*``) or carry a justified inline suppression. OR012
+still polices *that the loop exists* in decision/fib; OR013 polices
+*that it is accounted* — a suppressed OR012 seam without a scope is an
+unmeasured O(routes) walk, exactly what BENCH_WORK.json exists to make
+impossible to miss.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+
+SCOPE_DIRS = ("decision", "fib", "prefixmgr")
+
+#: whole-table attribute names whose iteration is O(table)
+HOT_ATTRS = frozenset(
+    {
+        "prefixes",
+        "unicast_routes",
+        "desired_unicast",
+        "programmed_unicast",
+        "desired_mpls",
+        "programmed_mpls",
+        "_entries",
+    }
+)
+
+#: call wrappers that keep the iterable O(table)
+_WRAPPERS = frozenset({"sorted", "list", "tuple", "set", "reversed"})
+_VIEWS = frozenset({"items", "values", "keys"})
+
+
+def _hot_attr(node: ast.AST) -> str | None:
+    """The HOT_ATTRS name an iterable expression ultimately walks, or
+    None — same unwrapping as OR012."""
+    while True:
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _WRAPPERS and node.args:
+                node = node.args[0]
+                continue
+            if isinstance(f, ast.Attribute) and f.attr in _VIEWS:
+                node = f.value
+                continue
+            return None
+        if isinstance(node, ast.Attribute):
+            return node.attr if node.attr in HOT_ATTRS else None
+        return None
+
+
+def _is_work_scope(item: ast.withitem) -> bool:
+    """True for ``with WorkScope(...)`` and ``with <x>.scope(...)``
+    (module fn ``work_ledger.scope`` or a ledger method)."""
+    e = item.context_expr
+    if not isinstance(e, ast.Call):
+        return False
+    f = e.func
+    if isinstance(f, ast.Name) and f.id == "WorkScope":
+        return True
+    return isinstance(f, ast.Attribute) and f.attr == "scope"
+
+
+class WorkScopeRule(Rule):
+    code = "OR013"
+    name = "unscoped-table-loop"
+    description = (
+        "full-route-table loop in decision/fib/prefixmgr outside a "
+        "WorkScope — the work ledger can't account it"
+    )
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        if not (ctx.part_set() & set(SCOPE_DIRS)):
+            return
+        func = "<module>"
+        # (node, enclosing function name, inside-a-WorkScope-with flag)
+        stack: list[tuple[ast.AST, str, bool]] = [(ctx.tree, func, False)]
+        while stack:
+            node, func, scoped = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                func = node.name
+                # a nested def starts a fresh lexical accounting
+                # context: an enclosing scope doesn't cover calls made
+                # later through the inner function
+                scoped = False
+            if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                _is_work_scope(i) for i in node.items
+            ):
+                for child in node.body:
+                    stack.append((child, func, True))
+                for i in node.items:
+                    stack.append((i.context_expr, func, scoped))
+                continue
+            if not scoped:
+                iters: list[tuple[ast.AST, ast.AST]] = []
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append((node, node.iter))
+                elif isinstance(
+                    node,
+                    (
+                        ast.ListComp,
+                        ast.SetComp,
+                        ast.DictComp,
+                        ast.GeneratorExp,
+                    ),
+                ):
+                    iters.extend((node, g.iter) for g in node.generators)
+                for owner, it in iters:
+                    attr = _hot_attr(it)
+                    if attr is None:
+                        continue
+                    yield self.finding(
+                        ctx,
+                        owner,
+                        f"full-table loop over `.{attr}` outside a "
+                        f"WorkScope — wrap it in `with work_ledger."
+                        f"scope(<stage>, delta)` so the walk lands in "
+                        f"work.<stage>.* (or justify an inline "
+                        f"suppression; docs/Monitor.md \"Work ledger\")",
+                        scope=func,
+                        subject=f"{attr}:{func}",
+                    )
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, func, scoped))
+        return
