@@ -85,11 +85,12 @@ echo "== work-ledger smoke (delta-proportionality attribution gates) =="
 # the full dataflow — two-area decision, real delta FIB, real ABR
 # redistribution — under prefix AND topo churn must show
 # work.fib.ratio pinned at 1, work.election.ratio bounded, the two
-# known O(routes) walks (cross-area merge fold, PrefixManager RIB
-# redistribution) reporting HONEST full-table touched counts, zero
-# post-warmup XLA compiles, and no delta-proportional stage breaching
-# k*delta+floor in any steady round — bench_churn --work-bench --smoke
-# exits 1 on any of those
+# formerly-O(routes) walks (cross-area merge, RIB redistribution)
+# holding their ISSUE 17 delta-native bounds (ratios <= 8,
+# oroutes_share ~0 of the full-table budget), zero post-warmup XLA
+# compiles, and no delta-proportional stage — merge and redistribute
+# now included — breaching k*delta+floor in any steady round —
+# bench_churn --work-bench --smoke exits 1 on any of those
 JAX_PLATFORMS=cpu python benchmarks/bench_churn.py \
     --work-bench --nodes 36 --work-prefixes 2000 --work-rounds 12 \
     --work-mode both --smoke --backend cpu \

@@ -135,58 +135,75 @@ def merge_area_ribs(
     if len(areas) == 1:
         return per_area[areas[0]]
     out = RouteDatabase(this_node_name=my_node)
-    # delta=0: the full fold has no delta to be proportional to — the
-    # work ledger reports its honest O(routes) ratio (ISSUE 16 names it
-    # as one of the two remaining full-table walks; BENCH_WORK.json
-    # quantifies its steady-state share against which a delta-native
-    # fold can be judged)
-    with work_ledger.scope("merge", 0) as ws:
+    # `merge_full` stage, delta=0: the full fold is the fallback arm of
+    # the delta merge book (first build / policy / revision mismatch /
+    # solved areas) — honest O(routes) like spf_full, counter-asserted
+    # via decision.merge.full, never the steady state. The per-entity
+    # Python work below is conflicts-only: non-overlapping entries land
+    # through bulk C dict ops, and only prefixes present in BOTH the
+    # accumulator and the incoming area run the fold step — same sorted
+    # fold order and outcomes as the historical per-prefix loop.
+    with work_ledger.scope("merge_full", 0) as ws:
         for area in areas:
             rdb = per_area[area]
             ws.add(len(rdb.unicast_routes) + len(rdb.mpls_routes))
-            for prefix, entry in rdb.unicast_routes.items():  # orlint: disable=OR012 — multi-area fold inside the `merge` WorkScope; the single-area fast path above bypasses it, and multi-area deployments fold per-area RIBs that the scoped merge keeps small
-                cur = out.unicast_routes.get(prefix)
-                out.unicast_routes[prefix] = (
-                    entry if cur is None else _fold_unicast(cur, entry)
-                )
-            for label, mentry in rdb.mpls_routes.items():
-                cur = out.mpls_routes.get(label)
-                out.mpls_routes[label] = (
-                    mentry if cur is None else _fold_mpls(cur, mentry)
-                )
+            src_u = rdb.unicast_routes
+            dst_u = out.unicast_routes
+            if not dst_u:
+                out.unicast_routes = dict(src_u)
+            else:
+                folded = {
+                    p: _fold_unicast(dst_u[p], src_u[p])
+                    for p in dst_u.keys() & src_u.keys()
+                }
+                dst_u.update(src_u)
+                dst_u.update(folded)
+            src_m = rdb.mpls_routes
+            dst_m = out.mpls_routes
+            if not dst_m:
+                out.mpls_routes = dict(src_m)
+            else:
+                folded_m = {
+                    lbl: _fold_mpls(dst_m[lbl], src_m[lbl])
+                    for lbl in dst_m.keys() & src_m.keys()
+                }
+                dst_m.update(src_m)
+                dst_m.update(folded_m)
     return out
 
 
-def merge_area_ribs_scoped(
+def merge_scope_delta(
     per_area: dict[str, RouteDatabase],
-    my_node: str,
     base: RouteDatabase,
     scope,
     label_scope=(),
-) -> RouteDatabase:
-    """Cross-area re-selection for the `scope` prefixes (and, for
-    topology-delta rounds, the `label_scope` MPLS labels) only, against
-    the previous merged RIB `base` (valid because the scoped rounds
-    cannot change any out-of-scope route: prefix-only rounds touch no
-    MPLS route at all, topology-delta rounds report every label whose
-    distance class moved). Folds areas in the same sorted order as
-    `merge_area_ribs`, so the scoped result is byte-equal to a full
-    re-merge restricted to the scopes."""
+) -> "RouteUpdate":
+    """Delta merge book fold: cross-area re-selection for the `scope`
+    prefixes (and, for topology-delta rounds, the `label_scope` MPLS
+    labels) only, expressed as the RouteUpdate that turns the previous
+    merged RIB `base` (the live merge book) into the new merged state.
+    Valid because scoped rounds cannot change any out-of-scope route:
+    prefix-only rounds touch no MPLS route at all, topology-delta
+    rounds report every label whose distance class moved. Folds areas
+    in the same sorted order as `merge_area_ribs`, so applying the
+    returned update to `base` is byte-equal to a full re-merge.
+
+    The update IS the application delta: an in-scope prefix whose fold
+    result equals the book entry (same identity-first compare as
+    `diff_route_dbs`) ships nothing; changed entries land in
+    `unicast_to_update` / `mpls_to_update`, vanished ones in the delete
+    lists. The caller applies it to the book dicts on the event loop —
+    O(delta) there, and no O(routes) base-table copy anywhere."""
+    from openr_tpu.types.routes import RouteUpdate
+
     areas = sorted(per_area)
-    out = RouteDatabase(this_node_name=my_node)
-    # the base-table dict copies are counted: they are this path's
-    # real remaining O(routes) term in multi-area steady state (the
-    # per-prefix re-selection below is delta-proportional)
     delta = len(scope) + len(label_scope)
-    work_ledger.commit(
-        "merge",
-        len(base.unicast_routes)
-        + len(base.mpls_routes)
-        + delta * len(areas),
-        delta,
-    )
-    out.unicast_routes = dict(base.unicast_routes)
-    out.mpls_routes = dict(base.mpls_routes)
+    # touched = one per-area probe per scoped key; ratio ≈ area count
+    work_ledger.commit("merge", delta * len(areas), delta)
+    uni_up: dict = {}
+    uni_del: list = []
+    mpls_up: dict = {}
+    mpls_del: list = []
     for prefix in scope:
         merged = None
         for a in areas:
@@ -194,10 +211,12 @@ def merge_area_ribs_scoped(
             if entry is None:
                 continue
             merged = entry if merged is None else _fold_unicast(merged, entry)
+        prev = base.unicast_routes.get(prefix)
         if merged is None:
-            out.unicast_routes.pop(prefix, None)
-        else:
-            out.unicast_routes[prefix] = merged
+            if prev is not None:
+                uni_del.append(prefix)
+        elif prev is not merged and prev != merged:
+            uni_up[prefix] = merged
     for label in label_scope:
         mmerged = None
         for a in areas:
@@ -207,11 +226,18 @@ def merge_area_ribs_scoped(
             mmerged = (
                 mentry if mmerged is None else _fold_mpls(mmerged, mentry)
             )
+        prev = base.mpls_routes.get(label)
         if mmerged is None:
-            out.mpls_routes.pop(label, None)
-        else:
-            out.mpls_routes[label] = mmerged
-    return out
+            if prev is not None:
+                mpls_del.append(label)
+        elif prev is not mmerged and prev != mmerged:
+            mpls_up[label] = mmerged
+    return RouteUpdate(
+        unicast_to_update=uni_up,
+        unicast_to_delete=uni_del,
+        mpls_to_update=mpls_up,
+        mpls_to_delete=mpls_del,
+    )
 
 
 def _mpls_igp(entry) -> int:
@@ -399,6 +425,16 @@ class Decision(OpenrModule):
         self._area_solves = 0  # _compute_area invocations (SPF solves)
         self._rebuild_path = "full"  # path the last rebuild took
         self._rebuild_cached_areas = 0
+        # ---- delta merge book -----------------------------------------
+        # self.rib IS the merge book: a persistent merged RIB that
+        # scoped rebuilds patch in place with the RouteUpdate produced
+        # by merge_scope_delta (thread-side fold, on-loop application).
+        # Full-fold rounds (first build / policy / revision mismatch /
+        # solved areas) re-arm it wholesale via merge_area_ribs — and
+        # the book never aliases a per-area cache rdb (see the detach
+        # in _compute_and_diff). "scoped" vs "full" rounds are
+        # counter-asserted as decision.merge.scoped / decision.merge.full.
+        self._merge_mode = "full"
         # ---- topology-delta warm-start state -------------------------
         # last rebuild's warm-started area count + bounded-region size,
         # and cumulative fallback count (warm attempt that demanded a
@@ -940,15 +976,13 @@ class Decision(OpenrModule):
         every MPLS route, which cannot change without topology dirt) is
         reused from the cached per-area RIB verbatim, so the downstream
         diff short-circuits on identity outside the scope."""
-        old = cache["rdb"]
+        rdb = cache["rdb"]
         art = cache["art"]
-        rdb = RouteDatabase(this_node_name=self.node_name)
-        rdb.unicast_routes = dict(old.unicast_routes)
-        rdb.mpls_routes = dict(old.mpls_routes)
-        # touched = the reassembled prefixes only; the verbatim-reuse
-        # dict copy above is a bulk C op, not per-entity assembly work
-        # (the merge stage owns the copy accounting where it is the
-        # honest steady-state O(routes) term)
+        # in-place: the cached per-area RIB is thread-private during a
+        # rebuild (the merge book never aliases it — see the detach in
+        # _compute_and_diff's full path), so the touched prefixes are
+        # patched directly instead of copying the whole table first.
+        # touched = the reassembled prefixes only; O(delta) end to end.
         work_ledger.commit("assembly", len(prefixes), len(prefixes))
         if self._tpu is not None:
             entries = self._tpu.assemble_prefix_routes(art, ps, prefixes)
@@ -1026,12 +1060,15 @@ class Decision(OpenrModule):
           * no dirt (revision-verified) → cached RIB reused, ZERO work;
           * prefix-only dirt → scoped reassembly of just the touched
             prefixes against the cached artifact, zero SPF solves.
-        When no area needed a solve, the final diff is scoped to the
-        union of touched prefixes (and no MPLS walk at all) instead of
-        the full O(routes) sweep. Fallback-to-full triggers: installed
-        RibPolicy, force_full_rebuild, first build (empty cache),
-        revision mismatch (out-of-band LSDB mutation), artifact absent
-        (node not in topology at solve time).
+        When no area needed a solve, the cross-area merge runs as the
+        delta book fold (merge_scope_delta): only the touched prefix /
+        label scope is re-selected against the live merge book, and the
+        resulting RouteUpdate doubles as the diff — no full O(routes)
+        merge or sweep anywhere. Fallback-to-full triggers (all of
+        which re-arm the book via the full fold): installed RibPolicy,
+        force_full_rebuild, first build (empty cache), revision
+        mismatch (out-of-band LSDB mutation), artifact absent (node not
+        in topology at solve time).
         """
         ts = time.perf_counter()
         if dirt is None:
@@ -1123,23 +1160,39 @@ class Decision(OpenrModule):
             if solved_any:
                 path = "full"
                 new_rib = merge_area_ribs(per_area, self.node_name)
+                if len(per_area) == 1:
+                    # detach the merge book from the per-area cache:
+                    # the single-area fast path returns the cached rdb
+                    # itself, and the book must never alias it (scoped
+                    # rounds patch cache rdbs in place off-loop, while
+                    # ctrl readers hold self.rib on the event loop).
+                    # Bulk C dict copy, full-rebuild rounds only.
+                    detached = RouteDatabase(this_node_name=self.node_name)
+                    detached.unicast_routes = dict(new_rib.unicast_routes)
+                    detached.mpls_routes = dict(new_rib.mpls_routes)
+                    new_rib = detached
             else:
                 path = "topo_delta" if warm_areas else "prefix_only"
                 scope = prefix_scope
                 lscope = tuple(sorted(label_scope_set))
-                if len(per_area) == 1:
-                    new_rib = next(iter(per_area.values()))
-                else:
-                    new_rib = merge_area_ribs_scoped(
-                        per_area, self.node_name, self.rib, scope, lscope
-                    )
+                # delta merge book: fold ONLY the scoped keys across
+                # the per-area RIBs and express the result as the
+                # RouteUpdate that patches the live book. self.rib is
+                # read-only in this worker thread; _rebuild_routes
+                # applies the update in place on the event loop. No
+                # base-table copy — the round is O(delta × areas).
+                update = merge_scope_delta(per_area, self.rib, scope, lscope)
+                new_rib = self.rib
         tr = time.perf_counter()
+        self._merge_mode = "scoped" if scope is not None else "full"
         if scope is not None:
-            # scoped diff examines exactly the scope — ratio 1
+            # the book fold above already produced the exact delta with
+            # diff semantics (identity-first compare); the diff stage
+            # records the scoped comparisons it performed — ratio 1
             work_ledger.commit(
                 "diff",
-                len(scope) + len(lscope or ()),
-                len(scope) + len(lscope or ()),
+                len(scope) + len(lscope),
+                len(scope) + len(lscope),
             )
         else:
             # full sweep walks both tables; no delta to credit
@@ -1151,11 +1204,7 @@ class Decision(OpenrModule):
                 + len(new_rib.mpls_routes),
                 0,
             )
-        update = diff_route_dbs(
-            self.rib, new_rib,
-            prefix_scope=scope,
-            label_scope=lscope if scope is not None else None,
-        )
+            update = diff_route_dbs(self.rib, new_rib)
         self._rebuild_path = path
         self._rebuild_cached_areas = cached_areas
         self._rebuild_warm_areas = warm_areas
@@ -1238,7 +1287,11 @@ class Decision(OpenrModule):
             log.exception("%s: route rebuild failed", self.name)
             # the dirt describing this batch was consumed but its routes
             # never landed: drop the per-area caches so the next rebuild
-            # is a from-scratch one instead of trusting a stale artifact
+            # is a from-scratch one instead of trusting a stale artifact.
+            # The merge book (self.rib) is still consistent with the
+            # published routes — scoped updates are only applied after a
+            # successful thread return — and the forced full round
+            # re-arms it wholesale.
             self._area_cache.clear()
             # re-queue the already-dequeued traces so the retrying
             # rebuild (which WILL contain these publications' route
@@ -1291,6 +1344,13 @@ class Decision(OpenrModule):
                     "decision.rebuild.cached_areas",
                     self._rebuild_cached_areas,
                 )
+            # merge-book path counters: the fallback-matrix assertion
+            # surface (docs/Decision.md) — steady state increments only
+            # .scoped; any .full increment names a fallback round
+            if self._merge_mode == "scoped":
+                self.counters.increment("decision.merge.scoped")
+            else:
+                self.counters.increment("decision.merge.full")
             if self._rebuild_warm_areas:
                 self.counters.increment(
                     "decision.spf.warm_starts", self._rebuild_warm_areas
@@ -1366,7 +1426,21 @@ class Decision(OpenrModule):
                     ),
                 )
         first = not self.rib_computed.is_set()
-        self.rib = new_rib
+        if new_rib is self.rib:
+            # delta merge book: apply the scoped update to the live
+            # book in place — on the event loop with no awaits between
+            # here and the push, so ctrl readers never observe a torn
+            # table and downstream consumers see exactly the update we
+            # ship. O(delta) application; bulk C dict ops.
+            rib = self.rib
+            rib.unicast_routes.update(update.unicast_to_update)
+            for p in update.unicast_to_delete:
+                rib.unicast_routes.pop(p, None)
+            rib.mpls_routes.update(update.mpls_to_update)
+            for lbl in update.mpls_to_delete:
+                rib.mpls_routes.pop(lbl, None)
+        else:
+            self.rib = new_rib
         self._last_completed_snapshot_t0 = t0
         if first or not update.empty():
             self._last_emitted_snapshot_t0 = t0

@@ -340,16 +340,19 @@ def check_queue_bounds(cluster) -> list[Violation]:
 
 #: stages that are honestly super-delta by design and therefore exempt
 #: from the soak proportionality gate (docs/Monitor.md "Work ledger"):
-#: merge + redistribute are O(routes), spf_full is O(area), spf_warm is
-#: O(region), full_sync is O(store) — and under storm-driven topology
-#: dirt the full-table route diff is honestly O(tables) too (a metric
-#: change can move any route), so diff is only gated in prefix-only
-#: regimes the soak never is.
+#: spf_full is O(area), spf_warm is O(region), merge_full is the
+#: counter-asserted fallback fold (first build / policy / revision
+#: mismatch — honest O(routes), like spf_full), full_sync is O(store)
+#: — and under storm-driven topology dirt the full-table route diff is
+#: honestly O(tables) too (a metric change can move any route), so diff
+#: is only gated in prefix-only regimes the soak never is. `merge` and
+#: `redistribute` are delta-native since ISSUE 17 (merge book + entry
+#: books) and are deliberately NOT exempt: a full-table walk creeping
+#: back into either trips this gate.
 WORK_EXEMPT_STAGES = (
-    "merge",
-    "redistribute",
     "spf_full",
     "spf_warm",
+    "merge_full",
     "full_sync",
     "diff",
 )
@@ -359,7 +362,8 @@ def check_work_ratios(cluster) -> list[Violation]:
     """Delta-proportionality gate over the process-global work ledger
     (openr_tpu/monitor/work_ledger.py): once a soak round has marked the
     ledger warm, no delta-proportional stage (dirt / election / assembly
-    / fib) may have a steady round whose touched-entity count exceeds
+    / merge / fib / redistribute) may have a steady round whose
+    touched-entity count exceeds
     k*delta + floor. Inactive until ``mark_warm()`` — a single-shot
     ``assert_invariants`` on a fresh cluster never trips on warmup work.
     The ledger is per-process, so in the emulator a breach is a

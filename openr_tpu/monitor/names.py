@@ -49,6 +49,11 @@ COUNTERS: frozenset[str] = frozenset(
         "decision.rebuild.topo_delta",
         "decision.rebuild.cached_areas",
         "decision.rebuild.area_solves",
+        # merge-book fallback matrix (docs/Decision.md): scoped = the
+        # delta fold patched the persistent merged RIB in place; full =
+        # a first-build/policy/mismatch round re-armed it from scratch
+        "decision.merge.scoped",
+        "decision.merge.full",
         "decision.rebuild_ms",
         "decision.spf.solves",
         "decision.spf.warm_starts",
@@ -149,6 +154,9 @@ COUNTERS: frozenset[str] = frozenset(
         "prefixmgr.range_chunks",
         "prefixmgr.range_prefixes",
         "prefixmgr.redistributed",
+        # entry-book footprint gauge at the advertisement-sync edge —
+        # a leak detector for the delta redistribution books
+        "prefixmgr.redistribute.book_size",
         # common/tasks guard_task default
         "task.uncaught_exceptions",
         # jax compile ledger (monitor/compile_ledger.py; process-wide)
@@ -219,7 +227,9 @@ QUEUE_FIELDS: frozenset[str] = frozenset(
 #: convention and only needs registry membership).
 DOCUMENTED: frozenset[str] = frozenset(
     {n for n in COUNTERS if n.startswith("decision.rebuild.")}
+    | {n for n in COUNTERS if n.startswith("decision.merge.")}
     | {n for n in COUNTERS if n.startswith("decision.spf.warm_")}
+    | {n for n in COUNTERS if n.startswith("prefixmgr.redistribute.")}
     | {n for n in COUNTERS if n.startswith("kvstore.flood")}
     | {n for n in COUNTERS if n.startswith("kvstore.full_sync")}
     | {n for n in COUNTERS if n.startswith("rpc.")}

@@ -46,13 +46,19 @@ commits from the event loop.
 Stage vocabulary (STAGES): ``dirt`` (publication classification),
 ``spf_full`` / ``spf_warm`` (full / topology-delta solves),
 ``election`` (best-prefix election), ``assembly`` (scoped prefix route
-assembly), ``merge`` (cross-area RIB fold), ``diff`` (route-db diff),
-``fib`` (FIB programming), ``redistribute`` (PrefixManager RIB
-redistribution), ``full_sync`` (KvStore anti-entropy compare).
-``merge`` and ``redistribute`` are the two *known* O(routes) stages —
-the ledger's job is to report their honest ratios, not hide them
-(BENCH_WORK.json quantifies exactly how much steady-state work they
-own, so the next change can kill them against a measured baseline).
+assembly), ``merge`` (the scoped cross-area book fold — delta-
+proportional by construction), ``merge_full`` (the full cross-area
+fold, a fallback reached only on first-build / policy / revision-
+mismatch rounds — honest O(routes) like ``spf_full``, and exempt for
+the same reason), ``diff`` (route-db diff), ``fib`` (FIB programming),
+``redistribute`` (PrefixManager RIB redistribution — delta-native:
+the fold consumes the RouteUpdate delta into the best-entries book and
+the advertisement sync ships only dirty prefixes), ``full_sync``
+(KvStore anti-entropy compare). ``merge`` and ``redistribute`` were
+the two known O(routes) stages BENCH_WORK.json quantified (ratios
+6565 / 13129 at 100k prefixes); both are now delta-proportional and
+gated — BENCH_WORK_r02.json pins the new baseline, and a reintroduced
+full-table walk trips the sanitizer/invariant instead of an exemption.
 """
 
 from __future__ import annotations
@@ -68,6 +74,7 @@ STAGES: tuple[str, ...] = (
     "election",
     "assembly",
     "merge",
+    "merge_full",
     "diff",
     "fib",
     "redistribute",
@@ -270,8 +277,10 @@ class WorkLedger:
         """Stages whose worst steady-state round touched more than
         ``k * delta + floor`` entities — the delta-proportionality
         contract the ``work_proportional`` sanitizer enforces. Exempt
-        the stages a test legitimately drives O(routes) (today: merge
-        and redistribute, until their walks are killed)."""
+        the stages a test legitimately drives O(routes)/O(area)
+        (``spf_full``, ``merge_full``, ``full_sync`` and the full diff
+        — the counter-asserted fallback class; ``merge`` and
+        ``redistribute`` are delta-native and no longer exempt)."""
         out: list[dict] = []
         for stage, row in self.since_warm().items():
             if stage in exempt:

@@ -103,6 +103,27 @@ class PrefixManager(OpenrModule):
         self._entries: dict[
             tuple[PrefixSource, IpPrefix], tuple[PrefixEntry, tuple[str, ...]]
         ] = {}
+        # ---- delta redistribution books ------------------------------
+        # All _entries mutations flow through _entry_set/_entry_del so
+        # these stay consistent; each makes a formerly O(entries) walk
+        # a book read (docs/Monitor.md "Work ledger"):
+        #   _best: prefix -> (source, entry, dest_areas) — the winning
+        #     advertisement per prefix, maintained incrementally (the
+        #     old _best_entries() full walk, as a book);
+        #   _owned_count: prefix -> count of non-RIB sources — the O(1)
+        #     "never shadow our own origination" probe fold_rib_update
+        #     previously rebuilt from the whole book every round;
+        #   _by_source: source -> set of prefixes — makes FULL_SYNC
+        #     purges and WITHDRAW_SOURCE sweeps O(dropped);
+        #   _dirty_adv: prefixes whose best entry (or dest areas) moved
+        #     since the last _sync_advertisements — the sync consumes
+        #     exactly this set, so advertisement work is O(changed).
+        self._best: dict[
+            IpPrefix, tuple[PrefixSource, PrefixEntry, tuple[str, ...]]
+        ] = {}
+        self._owned_count: dict[IpPrefix, int] = {}
+        self._by_source: dict[PrefixSource, set[IpPrefix]] = {}
+        self._dirty_adv: set[IpPrefix] = set()
         # (source, range key) -> (PrefixRange, dest_areas): the range
         # origination book — O(ranges), never O(prefixes)
         self._range_entries: dict[tuple, tuple] = {}
@@ -144,7 +165,7 @@ class PrefixManager(OpenrModule):
                         if self.counters:
                             self.counters.increment("prefixmgr.policy_denied")
                         continue
-                self._entries[(ev.source, e.prefix)] = (e, ev.dest_areas)
+                self._entry_set(ev.source, e.prefix, e, ev.dest_areas)
             # ranges bypass per-entry policy: the template is the only
             # entry shape, and expanding a million members through the
             # policy engine is exactly what range origination avoids —
@@ -153,17 +174,76 @@ class PrefixManager(OpenrModule):
                 self._range_entries[(ev.source, r.key())] = (r, ev.dest_areas)
         elif ev.type == PrefixEventType.WITHDRAW_PREFIXES:
             for e in ev.entries:
-                self._entries.pop((ev.source, e.prefix), None)
+                self._entry_del(ev.source, e.prefix)
             for r in ev.ranges:
                 self._range_entries.pop((ev.source, r.key()), None)
         elif ev.type == PrefixEventType.WITHDRAW_SOURCE:
-            for key in [k for k in self._entries if k[0] == ev.source]:  # orlint: disable=OR013 — config-event withdraw-all sweep, not the steady-state churn dataflow
-                del self._entries[key]
+            # O(dropped) via the per-source book — no full-table sweep
+            for p in list(self._by_source.get(ev.source, ())):
+                self._entry_del(ev.source, p)
             for key in [k for k in self._range_entries if k[0] == ev.source]:
                 del self._range_entries[key]
         self._sync_advertisements()
         if self.counters:
             self.counters.increment("prefixmgr.events")
+
+    # ------------------------------------------------------- entry books
+
+    def _entry_set(
+        self,
+        source: PrefixSource,
+        prefix: IpPrefix,
+        entry: PrefixEntry,
+        areas: tuple[str, ...],
+    ) -> None:
+        """Insert/replace one (source, prefix) advertisement, keeping
+        every derived book consistent. O(1): the best-entry update is a
+        single compare against the current winner."""
+        key = (source, prefix)
+        prev = self._entries.get(key)
+        if prev is not None and prev[0] == entry and prev[1] == areas:
+            return  # steady re-fold: nothing moved, nothing dirtied
+        self._entries[key] = (entry, areas)
+        if prev is None:
+            self._by_source.setdefault(source, set()).add(prefix)
+            if source != PrefixSource.RIB:
+                self._owned_count[prefix] = (
+                    self._owned_count.get(prefix, 0) + 1
+                )
+        cur = self._best.get(prefix)
+        if cur is None or source >= cur[0]:
+            if cur != (source, entry, areas):
+                self._best[prefix] = (source, entry, areas)
+                self._dirty_adv.add(prefix)
+
+    def _entry_del(self, source: PrefixSource, prefix: IpPrefix) -> None:
+        """Remove one (source, prefix) advertisement. Best re-election
+        on losing the winner probes the remaining sources in descending
+        preference order — a constant ≤ len(PrefixSource) probes, never
+        a book walk."""
+        key = (source, prefix)
+        if self._entries.pop(key, None) is None:
+            return
+        srcs = self._by_source.get(source)
+        if srcs is not None:
+            srcs.discard(prefix)
+        if source != PrefixSource.RIB:
+            n = self._owned_count.get(prefix, 0) - 1
+            if n > 0:
+                self._owned_count[prefix] = n
+            else:
+                self._owned_count.pop(prefix, None)
+        cur = self._best.get(prefix)
+        if cur is None or cur[0] != source:
+            return  # a shadowed source left: the winner is unchanged
+        for s in sorted(PrefixSource, reverse=True):
+            nxt = self._entries.get((s, prefix))
+            if nxt is not None:
+                self._best[prefix] = (s, nxt[0], nxt[1])
+                break
+        else:
+            del self._best[prefix]
+        self._dirty_adv.add(prefix)
 
     # ---------------------------------------------------------- fib gating
 
@@ -214,70 +294,70 @@ class PrefixManager(OpenrModule):
         import dataclasses
 
         all_areas = set(self.config.area_ids())
-        # work ledger `redistribute` stage: delta = the RouteUpdate's
-        # churn, touched = the entry-book walks + per-update work. The
-        # `owned` rebuild below is O(entries) EVERY round — this is one
-        # of the two known steady-state O(routes) walks ISSUE 16 asks
-        # the ledger to quantify honestly (BENCH_WORK.json), not hide.
-        with work_ledger.scope(
-            "redistribute",
-            len(upd.unicast_to_update) + len(upd.unicast_to_delete),
-        ) as ws:
+        # work ledger `redistribute` stage: delta-native (ISSUE 17).
+        # Touched = the update's own add/delete churn plus the
+        # O(previously-redistributed) FULL_SYNC purge; the per-round
+        # O(entries) `owned` rebuild and the per-sync `_best_entries`
+        # election walk are gone — the _owned_count and _best books
+        # carry them incrementally, so the ratio pins at ~1 instead of
+        # the ~10^4 PR 16's BENCH_WORK.json measured for this stage.
+        delta = len(upd.unicast_to_update) + len(upd.unicast_to_delete)
+        with work_ledger.scope("redistribute", delta) as ws:
             if upd.type == RouteUpdateType.FULL_SYNC:
-                ws.add(len(self._entries))
-                for key in [
-                    k for k in self._entries if k[0] == PrefixSource.RIB
-                ]:
-                    del self._entries[key]
-            # prefixes this node originates itself (hoisted: a
-            # per-prefix scan of the entry book would make full syncs
-            # quadratic)
-            ws.add(len(self._entries))
-            owned = {
-                k[1] for k in self._entries if k[0] != PrefixSource.RIB
-            }
-            ws.add(len(upd.unicast_to_update) + len(upd.unicast_to_delete))
-        for prefix, rib in upd.unicast_to_update.items():
-            best = rib.best_entry
-            if best is None:
-                continue
-            if prefix in owned:  # never shadow our own origination
-                continue
-            learned = {nh.area for nh in rib.nexthops if nh.area}
-            dest = tuple(
-                sorted(
-                    all_areas - learned - set(best.area_stack)
+                # drop the RIB slice and re-fold from the update:
+                # O(dropped) via the per-source book, not O(entries)
+                rib_prefixes = list(
+                    self._by_source.get(PrefixSource.RIB, ())
                 )
-            )
-            if not dest:
-                self._entries.pop((PrefixSource.RIB, prefix), None)
-                continue
-            entry = dataclasses.replace(
-                best,
-                metrics=dataclasses.replace(
-                    best.metrics, distance=best.metrics.distance + 1
-                ),
-                area_stack=tuple(best.area_stack) + tuple(sorted(learned)),
-            )
-            if self.policy is not None:
-                entry = self.policy.apply(entry)
-                if entry is None:
-                    if self.counters:
-                        self.counters.increment("prefixmgr.policy_denied")
-                    # a previously-accepted version must not linger with
-                    # stale attributes once the policy rejects the update
-                    self._entries.pop((PrefixSource.RIB, prefix), None)
+                ws.add(len(rib_prefixes))
+                for p in rib_prefixes:
+                    self._entry_del(PrefixSource.RIB, p)
+            ws.add(delta)
+            for prefix, rib in upd.unicast_to_update.items():
+                best = rib.best_entry
+                if best is None:
                     continue
-            self._entries[(PrefixSource.RIB, prefix)] = (entry, dest)
-            if self.counters:
-                self.counters.increment("prefixmgr.redistributed")
-        for prefix in upd.unicast_to_delete:
-            self._entries.pop((PrefixSource.RIB, prefix), None)
+                # never shadow our own origination — O(1) book probe
+                if prefix in self._owned_count:
+                    continue
+                learned = {nh.area for nh in rib.nexthops if nh.area}
+                dest = tuple(
+                    sorted(
+                        all_areas - learned - set(best.area_stack)
+                    )
+                )
+                if not dest:
+                    self._entry_del(PrefixSource.RIB, prefix)
+                    continue
+                entry = dataclasses.replace(
+                    best,
+                    metrics=dataclasses.replace(
+                        best.metrics, distance=best.metrics.distance + 1
+                    ),
+                    area_stack=tuple(best.area_stack)
+                    + tuple(sorted(learned)),
+                )
+                if self.policy is not None:
+                    entry = self.policy.apply(entry)
+                    if entry is None:
+                        if self.counters:
+                            self.counters.increment(
+                                "prefixmgr.policy_denied"
+                            )
+                        # a previously-accepted version must not linger
+                        # with stale attributes once the policy rejects
+                        # the update
+                        self._entry_del(PrefixSource.RIB, prefix)
+                        continue
+                self._entry_set(PrefixSource.RIB, prefix, entry, dest)
+                if self.counters:
+                    self.counters.increment("prefixmgr.redistributed")
+            for prefix in upd.unicast_to_delete:
+                self._entry_del(PrefixSource.RIB, prefix)
 
     def _sync_originations(self) -> None:
         """Fold ready config originations into the entry book."""
         for orig in self._originations:
-            key = (PrefixSource.CONFIG, orig.prefix)
             if orig.ready():
                 entry = PrefixEntry(
                     prefix=orig.prefix,
@@ -286,25 +366,21 @@ class PrefixManager(OpenrModule):
                     forwarding_algorithm=orig.cfg.forwarding_algorithm,
                     tags=tuple(orig.cfg.tags),
                 )
-                self._entries[key] = (entry, ())
+                self._entry_set(PrefixSource.CONFIG, orig.prefix, entry, ())
                 orig.advertised = True
             elif orig.advertised:
-                self._entries.pop(key, None)
+                self._entry_del(PrefixSource.CONFIG, orig.prefix)
                 orig.advertised = False
 
     # -------------------------------------------------------- advertisement
 
     def _best_entries(self) -> dict[IpPrefix, tuple[PrefixEntry, tuple[str, ...]]]:
-        best: dict[IpPrefix, tuple[PrefixSource, PrefixEntry, tuple[str, ...]]] = {}
-        # the advertisement-side O(entries) walk of the redistribution
-        # pass (runs per _sync_advertisements; no delta to credit)
-        with work_ledger.scope("redistribute", 0) as ws:
-            ws.add(len(self._entries))
-            for (source, prefix), (entry, areas) in self._entries.items():
-                cur = best.get(prefix)
-                if cur is None or source > cur[0]:
-                    best[prefix] = (source, entry, areas)
-        return {p: (e, a) for p, (_s, e, a) in best.items()}
+        """Winner per prefix — a read of the incrementally-maintained
+        `_best` book. The per-sync O(entries) election walk this used
+        to be is gone (ISSUE 17); _entry_set/_entry_del keep the book
+        exact, so this is O(prefixes-with-a-winner) dict comprehension
+        with no work-ledger scope to charge."""
+        return {p: (e, a) for p, (_s, e, a) in self._best.items()}
 
     def _sync_ranges(self) -> None:
         """Make the KvStore reflect the range origination book: each
@@ -378,50 +454,73 @@ class PrefixManager(OpenrModule):
                 self.kv_client.unset_key(area, key)
 
     def _sync_advertisements(self) -> None:
-        """Make the KvStore reflect the current entry book exactly."""
+        """Make the KvStore reflect the current entry book.
+
+        Delta-native (ISSUE 17): only prefixes dirtied since the last
+        sync — best entry changed, winner withdrawn, dest areas moved —
+        are (re)advertised or tombstoned. Skipping the unchanged rest
+        is semantically a no-op: persist_key registered them once and
+        KvStoreClient owns TTL refresh and flood self-healing from
+        there, so a steady-state sync pass touches nothing.
+        """
         self._sync_ranges()
-        want = self._best_entries()
         all_areas = tuple(self.config.area_ids())
-        # advertise / update
-        for prefix, (entry, dest_areas) in want.items():
-            areas = dest_areas or all_areas
-            adv = self._advertised.setdefault(prefix, set())
-            for area in areas:
-                key = C.prefix_key(self.node_name, area, str(prefix.prefix))
-                db = PrefixDatabase(
-                    this_node_name=self.node_name,
-                    prefix_entries=(entry,),
-                    area=area,
+        dirty = self._dirty_adv
+        self._dirty_adv = set()
+        with work_ledger.scope("redistribute", len(dirty)) as ws:
+            ws.add(len(dirty))
+            for prefix in dirty:
+                best = self._best.get(prefix)
+                want_areas = (
+                    set(best[2] or all_areas) if best is not None else set()
                 )
-                self.kv_client.persist_key(
-                    area, key, to_wire(db), ttl_ms=self.ttl_ms
-                )
-                adv.add(area)
-        # withdraw
-        for prefix in list(self._advertised):
-            stale_areas = self._advertised[prefix] - (
-                set(want[prefix][1] or all_areas) if prefix in want else set()
-            )
-            for area in stale_areas:
-                key = C.prefix_key(self.node_name, area, str(prefix.prefix))
-                tombstone = PrefixDatabase(
-                    this_node_name=self.node_name,
-                    prefix_entries=(PrefixEntry(prefix=prefix),),
-                    area=area,
-                    delete_prefix=True,
-                )
-                # advertise the tombstone once (version bump beats the old
-                # value everywhere), then stop refreshing: it dies by TTL
-                # (reference: PrefixManager deleted-entry advertisement †)
-                self.kv_client.persist_key(
-                    area, key, to_wire(tombstone), ttl_ms=self.ttl_ms
-                )
-                self.kv_client.unset_key(area, key)
-                self._advertised[prefix].discard(area)
-            if not self._advertised[prefix]:
-                del self._advertised[prefix]
+                adv = self._advertised.get(prefix, set())
+                if best is not None:
+                    # (re)advertise into every wanted area — a changed
+                    # entry must re-persist everywhere it lives (the
+                    # version bump supersedes the old value)
+                    entry = best[1]
+                    for area in want_areas:
+                        key = C.prefix_key(
+                            self.node_name, area, str(prefix.prefix)
+                        )
+                        db = PrefixDatabase(
+                            this_node_name=self.node_name,
+                            prefix_entries=(entry,),
+                            area=area,
+                        )
+                        self.kv_client.persist_key(
+                            area, key, to_wire(db), ttl_ms=self.ttl_ms
+                        )
+                for area in adv - want_areas:
+                    key = C.prefix_key(
+                        self.node_name, area, str(prefix.prefix)
+                    )
+                    tombstone = PrefixDatabase(
+                        this_node_name=self.node_name,
+                        prefix_entries=(PrefixEntry(prefix=prefix),),
+                        area=area,
+                        delete_prefix=True,
+                    )
+                    # advertise the tombstone once (version bump beats
+                    # the old value everywhere), then stop refreshing:
+                    # it dies by TTL (reference: PrefixManager
+                    # deleted-entry advertisement †)
+                    self.kv_client.persist_key(
+                        area, key, to_wire(tombstone), ttl_ms=self.ttl_ms
+                    )
+                    self.kv_client.unset_key(area, key)
+                if want_areas:
+                    self._advertised[prefix] = want_areas
+                else:
+                    self._advertised.pop(prefix, None)
         if self.counters:
             self.counters.set("prefixmgr.advertised", len(self._advertised))
+            # entry-book footprint at the sync edge — trips if the book
+            # leaks entries the delta path should have retired
+            self.counters.set(
+                "prefixmgr.redistribute.book_size", len(self._entries)
+            )
             # work.redistribute.* gauges refresh at the sync edge — the
             # redistribution pass's own export point (a PrefixManager
             # without a local Decision still reports its walks)

@@ -205,8 +205,10 @@ def jit_compile_sanitizer(request):
 # proportionality contract the scoped-rebuild paths exist to uphold.
 # Marker kwargs: k= (slope, default work_ledger.DEFAULT_K), floor=
 # (per-round constant allowance), exempt= (stage names allowed to stay
-# O(routes) — e.g. ("merge", "redistribute") for multi-area/ABR tests
-# until those walks are killed). Unmarked tests are unaffected.
+# O(routes) — e.g. ("spf_full", "diff") for tests whose steady rounds
+# legitimately take full solves; merge and redistribute are delta-
+# native since ISSUE 17 and no longer belong in any exempt list).
+# Unmarked tests are unaffected.
 
 from openr_tpu.monitor import work_ledger  # noqa: E402
 

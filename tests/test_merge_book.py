@@ -1,0 +1,301 @@
+"""Delta merge book parity tests (ISSUE 17, docs/Decision.md).
+
+The contract under test: `Decision.rib` is a persistent merge book.
+Scoped rounds patch it in place via `merge_scope_delta` (O(delta ×
+areas)); fallback rounds (first build, policy, revision mismatch, any
+area solve) re-arm it with the full `merge_area_ribs` fold. After EVERY
+rebuild of a randomized multi-area churn sequence — prefix churn with
+cross-area conflicts, metric flaps (MPLS label scopes), overload
+toggles, area add/remove — the book must be byte-equal to a fresh
+from-scratch fold over the same LSDB, on both engines, and the two
+paths must be visible in the decision.merge.scoped/full counters.
+"""
+
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+
+from openr_tpu.common.constants import DEFAULT_AREA, adj_key, prefix_key
+from openr_tpu.config import Config, NodeConfig
+from openr_tpu.decision.decision import (
+    Decision,
+    merge_area_ribs,
+    merge_scope_delta,
+)
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Counters, work_ledger
+from openr_tpu.types.kvstore import Publication, Value
+from openr_tpu.types.network import IpPrefix, NextHop
+from openr_tpu.types.routes import RibEntry, RibMplsEntry, RouteDatabase
+from openr_tpu.types.serde import to_wire
+from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
+from openr_tpu.utils import topogen
+
+
+def run(coro):
+    # asyncio.run: closes the loop, cancels leftovers, shuts down
+    # async generators — the teardown hygiene the sanitizer checks
+    return asyncio.run(coro)
+
+
+def mk_decision(backend="cpu", name="node-0"):
+    cfg = Config(NodeConfig(node_name=name))
+    pubs = ReplicateQueue(name="pubs")
+    routes = ReplicateQueue(name="routes")
+    return Decision(
+        cfg, pubs.get_reader(), routes, solver=backend, counters=Counters()
+    )
+
+
+def adj_pub(adj_dbs, area=DEFAULT_AREA, version=1):
+    return Publication(
+        area=area,
+        key_vals={
+            adj_key(db.this_node_name): Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(db),
+            ).with_hash()
+            for db in adj_dbs
+        },
+    )
+
+
+def prefix_pub(prefix_dbs, area=DEFAULT_AREA, version=1):
+    kv = {}
+    for db in prefix_dbs:
+        for e in db.prefix_entries:
+            key = prefix_key(db.this_node_name, area, str(e.prefix.prefix))
+            kv[key] = Value(
+                version=version,
+                originator_id=db.this_node_name,
+                value=to_wire(
+                    PrefixDatabase(
+                        this_node_name=db.this_node_name,
+                        prefix_entries=(e,),
+                        area=area,
+                    )
+                ),
+            ).with_hash()
+    return Publication(area=area, key_vals=kv)
+
+
+def one_prefix_pub(node, pstr, area=DEFAULT_AREA, version=1):
+    return prefix_pub(
+        [
+            PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=(PrefixEntry(prefix=IpPrefix(prefix=pstr)),),
+                area=area,
+            )
+        ],
+        area=area,
+        version=version,
+    )
+
+
+def assert_book_parity(d, step=None):
+    """The live merge book must be byte-equal to a from-scratch compute
+    over the same LSDB, and must never alias a per-area cache rdb
+    (scoped rounds patch those in place off-loop). The reference
+    compute is test instrumentation — excluded from the work ledger."""
+    work_ledger.set_enabled(False)
+    try:
+        ref = d.compute_rib()
+    finally:
+        work_ledger.set_enabled(True)
+    assert d.rib.unicast_routes == ref.unicast_routes, step
+    assert d.rib.mpls_routes == ref.mpls_routes, step
+    for cache in d._area_cache.values():
+        assert cache["rdb"] is not d.rib, step
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+# steady rounds legitimately include full solves (overload toggles,
+# area add/remove → spf_full + merge_full + full diff) and warm solves
+# (metric flaps → spf_warm); the delta stages the book exists for —
+# merge above all — stay under the k*delta+floor gate for all rounds
+@pytest.mark.work_proportional(
+    exempt=("spf_full", "spf_warm", "merge_full", "diff")
+)
+def test_multi_area_randomized_churn_book_parity(backend):
+    """Randomized cross-area churn: after every rebuild the merge book
+    equals a fresh full fold — through scoped patches, warm-start label
+    scopes, fallback re-arms, and a third area appearing/vanishing."""
+
+    async def body():
+        d = mk_decision(backend)
+        a_adj, a_pfx = topogen.ring(4)
+        b_adj, b_pfx = topogen.grid(2, 3)
+        d.process_publication(adj_pub(a_adj, area="a"))
+        d.process_publication(prefix_pub(a_pfx, area="a"))
+        d.process_publication(adj_pub(b_adj, area="b"))
+        d.process_publication(prefix_pub(b_pfx, area="b"))
+        await d._rebuild_routes()
+        assert_book_parity(d, "initial")
+        # ring and grid loopbacks overlap (both start at 10.0.0.0), so
+        # the initial fold already resolved cross-area conflicts
+        assert d.rib.unicast_routes and d.rib.mpls_routes
+        work_ledger.mark_warm()
+
+        rng = np.random.default_rng(1717)
+        areas = ["a", "b"]
+        names = {
+            "a": [db.this_node_name for db in a_adj],
+            "b": [db.this_node_name for db in b_adj],
+        }
+        adj_cur = {("a", db.this_node_name): db for db in a_adj}
+        adj_cur.update({("b", db.this_node_name): db for db in b_adj})
+        c_added = False
+        for step in range(24):
+            area = areas[int(rng.integers(0, len(areas)))]
+            nlist = names[area]
+            op = int(rng.integers(0, 10))
+            if op < 5:
+                # prefix advertise / withdraw — the scoped book patch,
+                # with deliberate cross-area conflicts (both areas
+                # advertise into the same 10.77.* space)
+                i = int(rng.integers(0, 4))
+                pstr = f"10.77.{i}.0/24"
+                node = nlist[int(rng.integers(0, len(nlist)))]
+                if rng.integers(0, 2):
+                    pub = one_prefix_pub(
+                        node, pstr, area=area, version=step + 2
+                    )
+                else:
+                    pub = Publication(
+                        area=area,
+                        expired_keys=[prefix_key(node, area, pstr)],
+                    )
+            elif op < 8:
+                # metric flap: warm topology delta → scoped merge with
+                # a non-empty MPLS label scope
+                key = (area, nlist[int(rng.integers(1, len(nlist)))])
+                db = adj_cur[key]
+                adjs = list(db.adjacencies)
+                k = int(rng.integers(0, len(adjs)))
+                adjs[k] = dataclasses.replace(
+                    adjs[k], metric=int(rng.integers(1, 16))
+                )
+                db = dataclasses.replace(db, adjacencies=tuple(adjs))
+                adj_cur[key] = db
+                pub = adj_pub([db], area=area, version=step + 2)
+            elif op < 9:
+                # area add / remove: a third area appears with its own
+                # ring, later vanishes by expiring its adjacency keys —
+                # both directions re-arm the book via the full fold
+                if not c_added:
+                    c_adj, c_pfx = topogen.ring(3, metric=5)
+                    d.process_publication(
+                        adj_pub(c_adj, area="c", version=step + 2)
+                    )
+                    pub = prefix_pub(c_pfx, area="c", version=step + 2)
+                    c_added = True
+                else:
+                    pub = Publication(
+                        area="c",
+                        expired_keys=[
+                            adj_key(db.this_node_name)
+                            for db in topogen.ring(3)[0]
+                        ],
+                    )
+                    c_added = False
+            else:
+                # overload toggle: structural topology dirt → fallback
+                # full fold re-arms the book
+                key = (area, nlist[int(rng.integers(1, len(nlist)))])
+                db = dataclasses.replace(
+                    adj_cur[key],
+                    is_overloaded=not adj_cur[key].is_overloaded,
+                )
+                adj_cur[key] = db
+                pub = adj_pub([db], area=area, version=step + 2)
+            d.process_publication(pub)
+            await d._rebuild_routes()
+            assert_book_parity(d, f"step {step}")
+
+        # both merge paths must have genuinely run (fallback matrix)
+        assert d.counters.get("decision.merge.scoped") > 0
+        assert d.counters.get("decision.merge.full") > 0
+
+    run(body())
+
+
+def _uni(pstr, nbr, area, igp=10):
+    p = IpPrefix.make(pstr)
+    return p, RibEntry(
+        prefix=p,
+        nexthops=(NextHop(address=nbr, if_name="if1", area=area),),
+        best_node=nbr,
+        best_entry=PrefixEntry(prefix=p),
+        igp_cost=igp,
+    )
+
+
+def _mpls(label, nbr, area, metric=10):
+    return RibMplsEntry(
+        label=label,
+        nexthops=(
+            NextHop(address=nbr, if_name="if1", area=area, metric=metric),
+        ),
+    )
+
+
+def test_merge_scope_delta_matches_full_fold():
+    """Unit parity: applying merge_scope_delta's RouteUpdate to the old
+    merged book yields byte-for-byte the full merge_area_ribs fold of
+    the new per-area state — across adds, changes, deletes, label
+    scopes, and untouched out-of-scope keys."""
+    p1, e1a = _uni("10.1.0.0/24", "n1", "a")
+    _, e1b = _uni("10.1.0.0/24", "n2", "b", igp=5)  # b wins p1 on cost
+    p2, e2a = _uni("10.2.0.0/24", "n1", "a")
+    p3, e3b = _uni("10.3.0.0/24", "n2", "b")
+    old_a = RouteDatabase(
+        this_node_name="me",
+        unicast_routes={p1: e1a, p2: e2a},
+        mpls_routes={100: _mpls(100, "n1", "a"), 101: _mpls(101, "n1", "a")},
+    )
+    old_b = RouteDatabase(
+        this_node_name="me",
+        unicast_routes={p1: e1b, p3: e3b},
+        mpls_routes={100: _mpls(100, "n2", "b")},  # tie with a: union
+    )
+    book = merge_area_ribs({"a": old_a, "b": old_b}, "me")
+
+    # churn: p1 vanishes from b (a's entry takes over), p2 changes in
+    # a, p4 appears in b; label 100 loses b's leg, 102 appears in b
+    p4, e4b = _uni("10.4.0.0/24", "n2", "b")
+    _, e2a2 = _uni("10.2.0.0/24", "n3", "a", igp=7)
+    new_a = RouteDatabase(
+        this_node_name="me",
+        unicast_routes={p1: e1a, p2: e2a2},
+        mpls_routes={100: _mpls(100, "n1", "a"), 101: _mpls(101, "n1", "a")},
+    )
+    new_b = RouteDatabase(
+        this_node_name="me",
+        unicast_routes={p4: e4b},
+        mpls_routes={102: _mpls(102, "n2", "b")},
+    )
+    scope = {p1, p2, p3, p4}
+    lscope = (100, 102)
+    upd = merge_scope_delta(
+        {"a": new_a, "b": new_b}, book, scope, lscope
+    )
+    book.unicast_routes.update(upd.unicast_to_update)
+    for p in upd.unicast_to_delete:
+        book.unicast_routes.pop(p, None)
+    book.mpls_routes.update(upd.mpls_to_update)
+    for lbl in upd.mpls_to_delete:
+        book.mpls_routes.pop(lbl, None)
+
+    ref = merge_area_ribs({"a": new_a, "b": new_b}, "me")
+    assert book.unicast_routes == ref.unicast_routes
+    assert book.mpls_routes == ref.mpls_routes
+    # unchanged in-scope keys ship nothing (identity-first compare) —
+    # p1's winner flips to a's object, so only genuinely-moved keys
+    # appear in the update
+    assert p1 in upd.unicast_to_update
+    assert p3 in upd.unicast_to_delete
+    assert 101 not in upd.mpls_to_update  # out of scope, untouched

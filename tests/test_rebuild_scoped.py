@@ -101,17 +101,24 @@ def one_prefix_pub(node, pstr, area=DEFAULT_AREA, version=1):
 
 def assert_parity(d, step=None):
     """The incremental pipeline's published RIB must be byte-equal to a
-    from-scratch compute over the same LSDB."""
-    ref = d.compute_rib()
+    from-scratch compute over the same LSDB. The reference compute is
+    test instrumentation, not product dataflow — its full solves and
+    folds are excluded from the work ledger so the proportionality
+    sanitizer only sees what the pipeline under test actually did."""
+    work_ledger.set_enabled(False)
+    try:
+        ref = d.compute_rib()
+    finally:
+        work_ledger.set_enabled(True)
     assert d.rib.unicast_routes == ref.unicast_routes, step
     assert d.rib.mpls_routes == ref.mpls_routes, step
 
 
 @pytest.mark.parametrize("backend", ["cpu", "tpu"])
 # spf_full + the full-table diff are exempt because the test's FINAL
-# round is deliberate adjacency churn (topology dirt → full path) and
-# assert_parity runs from-scratch computes; the scoped stages the test
-# exists to protect (dirt/election/assembly) stay gated
+# round is deliberate adjacency churn (topology dirt → full path); the
+# scoped stages the test exists to protect (dirt/election/assembly/
+# merge) stay gated
 @pytest.mark.work_proportional(exempt=("spf_full", "diff"))
 def test_prefix_only_round_zero_solves(backend):
     """A prefix advertise / withdraw round must not run ANY SPF solve:
@@ -248,10 +255,11 @@ def test_randomized_churn_parity(backend):
     run(body())
 
 
-# merge is the known multi-area O(routes) walk (the scoped fold still
-# copies the base tables — docs/Architecture.md "Per-stage work
-# bounds"); spf_full covers assert_parity's from-scratch computes
-@pytest.mark.work_proportional(exempt=("merge", "spf_full"))
+# NO exemptions: since ISSUE 17 the scoped round's cross-area merge is
+# a delta book fold (touched = scope × areas), so even the multi-area
+# path rides the full proportionality gate — the strongest form of the
+# contract this test protects
+@pytest.mark.work_proportional()
 def test_multi_area_cached_reuse():
     """Prefix dirt in one area must not touch the other: the clean
     area's RIB is reused (decision.rebuild.cached_areas) with zero
@@ -279,6 +287,10 @@ def test_multi_area_cached_reuse():
         assert d.counters.get("decision.rebuild.cached_areas") == 2
         assert d._area_solves == solves0
         assert IpPrefix(prefix="10.88.0.0/24") in d.rib.unicast_routes
+        # merge-book fallback matrix: the initial build armed the book
+        # (full fold), the scoped round patched it in place
+        assert d.counters.get("decision.merge.full") == 1
+        assert d.counters.get("decision.merge.scoped") == 1
         assert_parity(d, "after scoped")
 
     run(body())
@@ -313,6 +325,11 @@ def test_policy_forces_full_rebuild():
         d.process_publication(one_prefix_pub("node-1", "10.66.3.0/24"))
         await d._rebuild_routes()
         assert d.counters.get("decision.rebuild.prefix_only") == 1
+        # fallback matrix: every policy/first-build round re-armed the
+        # merge book via the full fold; only the last round was a
+        # scoped book patch
+        assert d.counters.get("decision.merge.full") == 3
+        assert d.counters.get("decision.merge.scoped") == 1
         assert_parity(d)
 
     run(body())
@@ -358,6 +375,10 @@ def test_out_of_band_mutation_falls_back_to_full():
         await d._rebuild_routes()
         assert d.counters.get("decision.rebuild.full") == 3
         assert d.counters.get("decision.rebuild.prefix_only") == 0
+        # every revision-mismatch round fell back to the full fold —
+        # the merge book never took a scoped patch on doubted state
+        assert d.counters.get("decision.merge.full") == 3
+        assert d.counters.get("decision.merge.scoped") == 0
         assert IpPrefix(prefix="10.70.0.0/24") in d.rib.unicast_routes
         assert_parity(d)
 

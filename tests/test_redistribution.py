@@ -39,8 +39,10 @@ class _RecordingKv:
     def __init__(self):
         self.persisted = {}  # (area, key) -> payload
         self.unset = []
+        self.persist_calls = 0
 
     def persist_key(self, area, key, value, ttl_ms=0):
+        self.persist_calls += 1
         self.persisted[(area, key)] = value
 
     def unset_key(self, area, key):
@@ -132,18 +134,21 @@ def test_full_sync_replaces_rib_entries():
     assert (PrefixSource.RIB, p2) in pm._entries
 
 
-# redistribute is one of the two known O(routes) walks (docs/Monitor.md
-# "Work ledger") — exempted from the proportionality gate, pinned by
-# the explicit baseline assertions below instead
-@pytest.mark.work_proportional(exempt=("redistribute",))
+# redistribute went delta-native in ISSUE 17 (incremental _best /
+# _owned_count / _by_source books + dirty-set advertisement sync), so
+# it now rides the proportionality gate UN-exempted — a full-table
+# walk creeping back in trips the sanitizer, and the pinned baseline
+# below moved down from ≈book to ≈delta per commit.
+@pytest.mark.work_proportional()
 def test_redistribution_work_under_churn():
-    """Redistribution-under-churn work accounting with a PINNED ratio
-    baseline: every churn round's fold + advertisement pass walks the
-    whole entry book, so `work.redistribute` must report touched ≈ book
-    per commit — honest O(routes). The pins cut both ways: the walk
-    cannot silently get worse (per-update re-walks would go quadratic),
-    and the day redistribution becomes delta-proportional this test
-    fails loudly and the baseline moves down with the fix."""
+    """Redistribution-under-churn work accounting with a PINNED
+    delta-proportional baseline: the fold touches the RouteUpdate's own
+    prefixes plus O(1) book probes, and the advertisement sync ships
+    only the dirtied prefixes — never the 1500-entry book. PR 16 pinned
+    this stage at [0.95, 1.1]×book, noting the baseline would move down
+    the day redistribution goes delta-proportional; this is that day,
+    and the new pins guard the other direction (one stray book walk
+    adds ~1500 touched and fails loudly)."""
     work_ledger.reset()
     cfg = Config(
         NodeConfig(
@@ -167,6 +172,7 @@ def test_redistribution_work_under_churn():
     work_ledger.mark_warm()
 
     rounds = 10
+    persist_before = kv.persist_calls
     for i in range(rounds):
         pstr = f"10.99.{i}.0/24"
         p = IpPrefix.make(pstr)
@@ -178,20 +184,26 @@ def test_redistribution_work_under_churn():
         pm._sync_advertisements()
 
     sw = work_ledger.since_warm()["redistribute"]
-    # 2 commits per fold+sync pair (the fold scope + the _best_entries
-    # advertisement walk), 2 pairs per round
+    # 2 commits per fold+sync pair (the fold scope + the dirty-set
+    # advertisement sync), 2 pairs per round
     commits = rounds * 4
     assert sw["rounds"] == commits
-    assert sw["delta"] == rounds * 2  # one prefix in, one out, per round
-    # PINNED: each commit walks the book once — no more, no less.
-    # Lower bound = honest reporting; upper bound = the quadratic guard
-    # (a per-update re-walk of the book would blow straight through it).
-    per_commit = sw["touched"] / commits
-    assert book * 0.95 <= per_commit <= book * 1.1, sw
-    assert sw["worst_touched"] <= book + 8, sw
+    # one prefix in, one out, per round — credited at the fold AND at
+    # the sync edge (each sync ships exactly the one dirty prefix)
+    assert sw["delta"] == rounds * 4
+    # PINNED: touched ≈ delta per commit. Lower bound = honest
+    # reporting; upper bound = the regression guard (a single book walk
+    # would add ~1500 and blow straight through it).
+    assert rounds * 4 <= sw["touched"] <= rounds * 4 + 8, sw
+    assert sw["worst_touched"] <= 4, sw
 
-    # a burst fold (32 updates in one RouteUpdate) still walks the book
-    # ONCE — per-round cost, not per-update cost
+    # the KvStore side is delta-proportional too: one advertisement per
+    # add, one tombstone per delete — the 1500 steady keys are never
+    # re-persisted (KvStoreClient owns their TTL refresh)
+    assert kv.persist_calls - persist_before == rounds * 2
+
+    # a burst fold (32 updates in one RouteUpdate) costs O(32), not
+    # O(book) — per-update cost, with no per-round table scan
     burst = {
         IpPrefix.make(f"10.98.{j}.0/24"): _rib_entry(f"10.98.{j}.0/24", "A")
         for j in range(32)
@@ -201,12 +213,121 @@ def test_redistribution_work_under_churn():
     fold_touched = (
         work_ledger.since_warm()["redistribute"]["touched"] - before
     )
-    assert fold_touched <= book + 3 * 32, fold_touched
+    assert fold_touched <= 3 * 32, fold_touched
 
     # the sync edge exported the honest gauges through Counters
+    pm._sync_advertisements()
     assert pm.counters.get("work.redistribute.touched") > 0
     ratio = pm.counters.get("work.redistribute.ratio")
-    assert ratio > 1.0  # visibly super-proportional, as documented
+    assert 0 < ratio <= 1.5  # delta-proportional, as now documented
+    # and the book-size gauge reflects the entry-book footprint
+    assert (
+        pm.counters.get("prefixmgr.redistribute.book_size")
+        == len(pm._entries)
+    )
+
+
+def _best_walk(pm):
+    """From-scratch winner election — the pre-ISSUE-17 O(entries)
+    reference walk, kept here as the parity oracle for the incremental
+    `_best` book."""
+    best = {}
+    for (source, prefix), (entry, areas) in pm._entries.items():
+        cur = best.get(prefix)
+        if cur is None or source > cur[0]:
+            best[prefix] = (source, entry, areas)
+    return {p: (e, a) for p, (_s, e, a) in best.items()}
+
+
+def test_best_book_parity_under_churn():
+    """The incrementally-maintained books must equal a from-scratch
+    walk after EVERY mutation: RIB adds/deletes with area-stack cycles,
+    higher-preference source shadowing (API > CONFIG > RIB) and
+    un-shadowing, WITHDRAW_SOURCE sweeps, and FULL_SYNC purges."""
+    import random
+
+    from openr_tpu.prefixmgr.prefix_manager import (
+        PrefixEvent,
+        PrefixEventType,
+    )
+
+    rng = random.Random(1717)
+    pm, _ = _mk_pm(areas=("A", "B", "C"))
+    prefixes = [f"10.{i >> 8}.{i & 0xFF}.0/24" for i in range(120)]
+
+    def check():
+        assert pm._best_entries() == _best_walk(pm)
+        owned = {k[1] for k in pm._entries if k[0] != PrefixSource.RIB}
+        assert set(pm._owned_count) == owned
+        for s in PrefixSource:
+            assert pm._by_source.get(s, set()) == {
+                k[1] for k in pm._entries if k[0] == s
+            }
+
+    for _step in range(400):
+        pstr = rng.choice(prefixes)
+        p = IpPrefix.make(pstr)
+        op = rng.randrange(6)
+        if op == 0:
+            pm.fold_rib_update(
+                RouteUpdate(
+                    unicast_to_update={
+                        p: _rib_entry(
+                            pstr,
+                            rng.choice("ABC"),
+                            area_stack=rng.choice(
+                                [(), ("B",), ("A", "C")]
+                            ),
+                            distance=rng.randrange(3),
+                        )
+                    }
+                )
+            )
+        elif op == 1:
+            pm.fold_rib_update(RouteUpdate(unicast_to_delete=[p]))
+        elif op == 2:
+            pm.process_event(
+                PrefixEvent(
+                    type=PrefixEventType.ADD_PREFIXES,
+                    source=rng.choice(
+                        [PrefixSource.API, PrefixSource.CONFIG]
+                    ),
+                    entries=(PrefixEntry(prefix=p),),
+                )
+            )
+        elif op == 3:
+            pm.process_event(
+                PrefixEvent(
+                    type=PrefixEventType.WITHDRAW_PREFIXES,
+                    source=rng.choice(
+                        [PrefixSource.API, PrefixSource.CONFIG]
+                    ),
+                    entries=(PrefixEntry(prefix=p),),
+                )
+            )
+        elif op == 4:
+            pm.process_event(
+                PrefixEvent(
+                    type=PrefixEventType.WITHDRAW_SOURCE,
+                    source=rng.choice(list(PrefixSource)),
+                )
+            )
+        else:
+            pm.fold_rib_update(
+                RouteUpdate(
+                    type=RouteUpdateType.FULL_SYNC,
+                    unicast_to_update={p: _rib_entry(pstr, "A")},
+                )
+            )
+        check()
+
+    # drain every source and confirm the books empty cleanly
+    for s in PrefixSource:
+        pm.process_event(
+            PrefixEvent(type=PrefixEventType.WITHDRAW_SOURCE, source=s)
+        )
+    assert pm._best == {} and pm._owned_count == {}
+    assert not any(pm._by_source.values())
 
 
 def test_abr_end_to_end():

@@ -255,8 +255,9 @@ def test_sanitizer_predicate_trips_on_deliberate_full_table_walk():
         )
         assert report is not None and "election" in report
         assert "5000" in report
-        # the same walk under an exemption (how merge/redistribute ride
-        # today) is allowed through
+        # the same walk under an exemption (how the counter-asserted
+        # fallbacks — spf_full, merge_full, full_sync — ride) is
+        # allowed through
         assert (
             work_ledger.steady_violation_report(exempt=("election",)) is None
         )
