@@ -1,0 +1,354 @@
+"""Multi-process cluster scaling bench — real sockets, real crashes.
+
+Every node is its own ``python -m openr_tpu`` interpreter (emulator/
+procs.py): Spark discovery over real UDP, KvStore flooding over real
+TCP with the negotiated binary codec, all observation over ctrl RPC.
+Each rung of the curve runs a SEEDED kill-storm (hard SIGKILL + real
+re-exec restarts) and one partition/heal round (socket-level drop
+rules), then must pass the full cross-process invariant suite
+(emulator/proc_invariants.py) — the numbers only count if the fleet
+is provably coherent afterwards. Any failure message embeds the
+ChaosPlan replay seed and a flight-recorder gather from every
+surviving process.
+
+Modes:
+  --smoke   16-node fat-tree pod, one SIGKILL + restart, one
+            partition/heal, invariants + zero-steady-compile counter
+            assert over ctrl. CI lane; exit 0/1.
+  --curve   sizes x topology families -> BENCH_CLUSTER.json with
+            convergence_p50_ms and floods/sec per rung.
+
+Run: python benchmarks/bench_cluster.py --smoke
+     python benchmarks/bench_cluster.py --curve --sizes 8,16,32 \
+         --families fat_tree_pod,wan_like --prefixes-total 100000
+
+Prints one JSON document (bench.py contract: metric/value/unit/
+vs_baseline/detail).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+#: (k, pods) per fat-tree rung — exact node counts only, so the curve's
+#: x axis is honest: n = (k/2)^2 cores + pods*(k/2 agg + k/2 tor)
+_FAT_TREE_RUNGS = {
+    8: (4, 1),
+    16: (4, 3),
+    24: (8, 1),
+    32: (8, 2),
+    64: (8, 6),
+}
+
+
+def _family_links(family: str, n: int, seed: int):
+    """Topology-family edges for an n-node rung, as LinkSpec list."""
+    from openr_tpu.emulator.cluster import LinkSpec
+    from openr_tpu.utils import topogen
+
+    if family == "fat_tree_pod":
+        if n not in _FAT_TREE_RUNGS:
+            raise SystemExit(
+                f"fat_tree_pod has no exact {n}-node shape; "
+                f"pick from {sorted(_FAT_TREE_RUNGS)}"
+            )
+        k, pods = _FAT_TREE_RUNGS[n]
+        adj, _ = topogen.fat_tree_pod(k=k, pods=pods)
+    elif family == "wan_like":
+        adj, _ = topogen.wan_like(n, seed=seed)
+    elif family == "hub_and_spoke":
+        hubs = max(2, n // 8)
+        adj, _ = topogen.hub_and_spoke(hubs=hubs, spokes=n - hubs)
+    else:
+        raise SystemExit(f"unknown topology family {family!r}")
+    return [LinkSpec(a=a, b=b) for a, b in topogen.edges_of(adj)]
+
+
+async def _fleet_sum(cluster, key: str) -> float:
+    agg = await cluster.fleet_counters(key)
+    row = agg.get(key)
+    return row["sum"] if row else 0.0
+
+
+async def _fleet_p50(cluster, key: str) -> float | None:
+    agg = await cluster.fleet_counters(key)
+    row = agg.get(key)
+    return round(row["p50"], 3) if row else None
+
+
+async def _run_rung(
+    family: str,
+    n: int,
+    *,
+    seed: int,
+    prefixes_per_node: int,
+    workdir: str,
+    storm_s: float,
+    quiesce_s: float,
+) -> dict:
+    """One curve rung: spawn n processes, converge, seeded kill-storm +
+    partition/heal, quiesce through the full invariant suite, report."""
+    from openr_tpu.emulator import chaos, proc_invariants
+    from openr_tpu.emulator.procs import ProcCluster
+
+    links = _family_links(family, n, seed)
+    cluster = ProcCluster(
+        links, workdir, prefixes_per_node=prefixes_per_node
+    )
+    plan = chaos.ChaosPlan(seed=seed)
+    replay = (
+        f"bench_cluster --curve family={family} n={n} seed={seed} "
+        f"({plan.replay_hint()})"
+    )
+    try:
+        t0 = time.monotonic()
+        await cluster.start()
+        spawn_s = time.monotonic() - t0
+        await cluster.wait_converged(timeout=60 + 3 * n)
+        cold_converge_s = time.monotonic() - t0
+        await proc_invariants.mark_fleet_warm(cluster)
+
+        floods0 = await _fleet_sum(cluster, "kvstore.floods_sent")
+        compiles0 = await _fleet_sum(cluster, "jax.compiles.total")
+
+        # seeded storm: flaps + >=1 hard kill (with scheduled restart)
+        # + >=1 partition/heal, all over real process boundaries
+        events = cluster.make_storm(
+            plan,
+            duration_s=storm_s,
+            n_flaps=max(2, n // 8),
+            n_crashes=max(1, n // 16),
+            n_partitions=1,
+            heal_after_s=min(2.0, storm_s / 3),
+        )
+        t1 = time.monotonic()
+        await chaos.run_schedule(cluster, plan, events)
+        await proc_invariants.wait_quiescent(
+            cluster, timeout_s=quiesce_s + 2 * n, context=replay
+        )
+        churn_elapsed = time.monotonic() - t1
+
+        floods1 = await _fleet_sum(cluster, "kvstore.floods_sent")
+        compiles1 = await _fleet_sum(cluster, "jax.compiles.total")
+        if compiles1 != compiles0:
+            raise AssertionError(
+                f"steady-state churn compiled: jax.compiles.total "
+                f"{compiles0} -> {compiles1} (replay: {replay})"
+            )
+        reconnects = await _fleet_sum(cluster, "kvstore.peer_reconnects")
+        return {
+            "family": family,
+            "nodes": n,
+            "links": len(links),
+            "processes": n,
+            "prefixes_per_node": prefixes_per_node,
+            "prefixes_total": prefixes_per_node * n,
+            "spawn_s": round(spawn_s, 2),
+            "cold_converge_s": round(cold_converge_s, 2),
+            "storm_events": len(events),
+            "storm_kills": sum(1 for e in events if e.kind == "crash"),
+            "storm_partitions": sum(
+                1 for e in events if e.kind == "partition"
+            ),
+            "churn_elapsed_s": round(churn_elapsed, 2),
+            "floods_sent": int(floods1 - floods0),
+            "floods_per_sec": round(
+                (floods1 - floods0) / max(churn_elapsed, 1e-9), 1
+            ),
+            "convergence_p50_ms": await _fleet_p50(
+                cluster, "monitor.convergence_ms.p50"
+            ),
+            "convergence_p99_ms": await _fleet_p50(
+                cluster, "monitor.convergence_ms.p99"
+            ),
+            "peer_reconnects": int(reconnects),
+            "steady_compiles": int(compiles1 - compiles0),
+            "invariants": "ok",
+            "replay": replay,
+        }
+    finally:
+        await cluster.stop()
+
+
+async def run_curve(args) -> dict:
+    sizes = [int(s) for s in args.sizes.split(",")]
+    families = args.families.split(",")
+    base = args.workdir or tempfile.mkdtemp(prefix="openr-cluster-")
+    out: dict[str, dict] = {}
+    for family in families:
+        out[family] = {}
+        for n in sizes:
+            wd = os.path.join(base, f"{family}-{n}")
+            print(
+                f"== {family} n={n} "
+                f"({args.prefixes_total // n} prefixes/node)",
+                file=sys.stderr,
+            )
+            rung = await _run_rung(
+                family,
+                n,
+                seed=args.seed,
+                prefixes_per_node=args.prefixes_total // n,
+                workdir=wd,
+                storm_s=args.storm_s,
+                quiesce_s=args.quiesce_s,
+            )
+            out[family][str(n)] = rung
+            print(
+                f"   converge p50 {rung['convergence_p50_ms']} ms, "
+                f"{rung['floods_per_sec']} floods/s, "
+                f"{rung['storm_kills']} kills, invariants ok",
+                file=sys.stderr,
+            )
+            if not args.keep:
+                shutil.rmtree(wd, ignore_errors=True)
+    top_family = families[0]
+    top = out[top_family][str(max(sizes))]
+    return {
+        "metric": "cluster_convergence_p50_ms",
+        "value": top["convergence_p50_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "detail": {
+            "harness": "multi-process (one interpreter per node, real "
+            "UDP/TCP/ctrl sockets, SIGKILL crashes, re-exec restarts)",
+            "host_cores": os.cpu_count(),
+            "sizes": sizes,
+            "families": out,
+            "seed": args.seed,
+            "invariants": "ok",
+            "note": "per-rung seeded kill-storm + partition/heal, "
+            "then the full cross-process invariant suite (kvstore "
+            "digest identity, FIB/oracle parity, no stuck state, "
+            "counter identities, queue bounds, work ratios) before "
+            "any number is recorded; rung sizes are bounded by the "
+            f"host's {os.cpu_count()} core(s) — every added process "
+            "multiplies scheduler oversubscription, not network load",
+        },
+    }
+
+
+async def run_smoke(args) -> dict:
+    """CI lane: 16-node fat-tree pod over real sockets; one SIGKILL +
+    restart, one partition/heal, full invariants, zero-steady-compile.
+    Fails loudly with the flight-dump path on any violation."""
+    from openr_tpu.emulator import proc_invariants
+    from openr_tpu.emulator.procs import ProcCluster
+
+    base = args.workdir or tempfile.mkdtemp(prefix="openr-cluster-smoke-")
+    links = _family_links("fat_tree_pod", 16, args.seed)
+    cluster = ProcCluster(
+        links, base, prefixes_per_node=args.smoke_prefixes
+    )
+    victim = sorted(cluster.nodes)[-1]  # a ToR, not a core
+    replay = f"bench_cluster --smoke seed={args.seed}"
+    try:
+        t0 = time.monotonic()
+        await cluster.start()
+        await cluster.wait_converged(timeout=90)
+        cold = time.monotonic() - t0
+        await proc_invariants.mark_fleet_warm(cluster)
+        compiles0 = await _fleet_sum(cluster, "jax.compiles.total")
+
+        # 1. hard crash + real restart
+        await cluster.crash_node(victim)
+        await asyncio.sleep(2.0)
+        await cluster.restart_node(victim)
+        await proc_invariants.wait_quiescent(
+            cluster, timeout_s=90, context=f"{replay} kill={victim}"
+        )
+
+        # 2. partition core+pod0 from the rest, heal
+        names = sorted(cluster.nodes)
+        cut = len(names) // 2
+        await cluster.partition([names[:cut], names[cut:]])
+        await asyncio.sleep(2.0)
+        await cluster.heal_partition()
+        await proc_invariants.wait_quiescent(
+            cluster, timeout_s=90, context=f"{replay} partition"
+        )
+
+        compiles1 = await _fleet_sum(cluster, "jax.compiles.total")
+        if compiles1 != compiles0:
+            raise AssertionError(
+                f"steady-state chaos compiled: jax.compiles.total "
+                f"{compiles0} -> {compiles1} ({replay})"
+            )
+        floods = await _fleet_sum(cluster, "kvstore.floods_sent")
+        return {
+            "metric": "cluster_smoke",
+            "value": 1.0,
+            "unit": "pass",
+            "vs_baseline": None,
+            "detail": {
+                "nodes": len(cluster.nodes),
+                "links": len(links),
+                "cold_converge_s": round(cold, 2),
+                "sigkill_restart": victim,
+                "partition_heal": "halves",
+                "floods_sent": int(floods),
+                "steady_compiles": int(compiles1 - compiles0),
+                "invariants": "ok",
+                "replay": replay,
+            },
+        }
+    finally:
+        await cluster.stop()
+        if not args.keep:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="bench_cluster")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--smoke", action="store_true")
+    mode.add_argument("--curve", action="store_true")
+    ap.add_argument("--sizes", default="8,16,32")
+    ap.add_argument(
+        "--families", default="fat_tree_pod,wan_like",
+        help="comma list: fat_tree_pod | wan_like | hub_and_spoke",
+    )
+    ap.add_argument(
+        "--prefixes-total", type=int, default=100_000,
+        help="churn payload spread across the fleet (per-node share)",
+    )
+    ap.add_argument("--smoke-prefixes", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--storm-s", type=float, default=6.0)
+    ap.add_argument("--quiesce-s", type=float, default=60.0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument(
+        "--keep", action="store_true",
+        help="keep per-rung workdirs (configs + per-node logs)",
+    )
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        result = asyncio.run(
+            run_smoke(args) if args.smoke else run_curve(args)
+        )
+    except AssertionError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    doc = json.dumps(result, indent=2)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

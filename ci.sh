@@ -163,6 +163,25 @@ echo "== soak smoke (fixed seed, 2 rounds, 9-node grid) =="
 JAX_PLATFORMS=cpu python -m openr_tpu.emulator --soak \
     --topo grid --nodes 9 --seed 7 --rounds 2
 
+echo "== multi-process cluster smoke (real sockets, real crashes) =="
+# the process-boundary gate (docs/Emulator.md "Multi-process
+# clusters"): a 16-node fat-tree where every node is its own OS
+# process speaking real UDP spark discovery and TCP kvstore flooding,
+# observed only over per-process ctrl RPC. A ToR is SIGKILLed and
+# restarted (new ephemeral ports — the Spark GR re-handshake path),
+# the fabric is partitioned into halves and healed, and after each
+# fault the full cross-process invariant suite must come back clean
+# (kvstore digest convergence, FIB-vs-oracle parity, no stuck
+# backoff/queues, counter sanity, per-process work-ledger ratios) with
+# ZERO post-warmup XLA compiles counter-asserted via ctrl on every
+# surviving process. Flight-recorder rings are gathered over ctrl into
+# a dump dir on any violation; the replay seed is embedded in the
+# failure message. exits 1 on any of those
+rm -rf "$SMOKE_LOG_DIR/proc-smoke"
+JAX_PLATFORMS=cpu python benchmarks/bench_cluster.py --smoke \
+    --workdir "$SMOKE_LOG_DIR/proc-smoke" --keep \
+    2> >(smoke_log proc_cluster_smoke)
+
 echo "== pytest tier-1 (not slow) =="
 # the fast lane the PR driver gates on — observability (test_perf),
 # CLI/ctrl export, dirty-scoped rebuild parity (test_rebuild_scoped),

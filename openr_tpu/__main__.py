@@ -24,7 +24,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
+import os
 import signal
 import sys
 
@@ -39,11 +41,36 @@ from openr_tpu.types.events import InterfaceEvent, InterfaceInfo
 log = logging.getLogger("openr_tpu.main")
 
 
-async def run_node(config: Config, dataplane: str, store_path: str | None):
+def _write_ready(path: str, payload: dict) -> None:
+    """Atomic readiness handshake: the supervisor polls for this file,
+    so a partially written JSON must never be observable — write to a
+    sibling temp name, fsync, rename."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+async def run_node(
+    config: Config,
+    dataplane: str,
+    store_path: str | None,
+    ready_file: str | None = None,
+):
     io = UdpIoProvider()
+    # bound ports per interface: with local_port=0 in the config every
+    # interface binds an ephemeral port (co-hosted processes can never
+    # collide) and the readiness handshake tells the supervisor where
+    # each one landed; peers may be wired later over ctrl set_udp_peer
+    udp_ports: dict[str, int] = {}
     for u in config.node.udp_interfaces:
-        await io.add_interface(
-            u.if_name, u.local_port, (u.peer_host, u.peer_port)
+        peer = (
+            (u.peer_host, u.peer_port) if u.peer_port else None
+        )
+        udp_ports[u.if_name] = await io.add_interface(
+            u.if_name, u.local_port, peer
         )
 
     if dataplane == "netlink":
@@ -111,6 +138,21 @@ async def run_node(config: Config, dataplane: str, store_path: str | None):
         node.name, host, node.ctrl.port if node.ctrl else 0, dataplane,
     )
 
+    # readiness handshake (supervisor contract, docs/Emulator.md): every
+    # listener is bound and the node is serving ctrl — report where.
+    # The stdout line is the human/pipe channel; the ready file is the
+    # machine channel the multi-process supervisor polls.
+    ready = {
+        "node": node.name,
+        "pid": os.getpid(),
+        "ctrl_port": node.ctrl.port if node.ctrl else None,
+        "kvstore_port": kv_port,
+        "udp_ports": udp_ports,
+    }
+    print(f"OPENR_READY {json.dumps(ready, sort_keys=True)}", flush=True)
+    if ready_file:
+        _write_ready(ready_file, ready)
+
     stop_ev = asyncio.Event()
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -138,6 +180,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(
+        "--ready-file", default=None,
+        help="write a JSON readiness handshake (node, pid, bound ctrl/"
+        "kvstore/udp ports) here once all listeners are up — the"
+        " multi-process supervisor's port-discovery channel; on a bind"
+        " failure the file carries {'error': ...} instead so the"
+        " supervisor fails fast rather than hanging on wait_initialized",
+    )
+    ap.add_argument(
         "--jax-platform", default=None,
         help="force the jax backend (e.g. 'cpu'); needed where a"
         " sitecustomize pins a TPU plugin the host can't reach",
@@ -152,7 +202,32 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
     config = Config.from_file(args.config)
-    asyncio.run(run_node(config, args.dataplane, args.store_path))
+    try:
+        asyncio.run(
+            run_node(
+                config, args.dataplane, args.store_path,
+                ready_file=args.ready_file,
+            )
+        )
+    except OSError as e:
+        # bind collision / unroutable endpoint_host: a co-hosted process
+        # already owns a pinned port. Fail FAST and loudly — the old
+        # behavior (module task dies, process lingers, the supervisor's
+        # wait_initialized hangs forever) is exactly what the handshake
+        # exists to prevent
+        msg = (
+            f"FATAL: node {config.node_name!r} could not bind its"
+            f" listeners: {e} — pinned ctrl_port/kvstore_port/local_port"
+            " values collide with another process; use port 0 for"
+            " ephemeral allocation"
+        )
+        print(msg, file=sys.stderr, flush=True)
+        if args.ready_file:
+            _write_ready(
+                args.ready_file,
+                {"node": config.node_name, "error": str(e)},
+            )
+        return 1
     return 0
 
 

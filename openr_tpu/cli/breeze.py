@@ -1334,3 +1334,87 @@ def monitor_logs(ctx, limit, event):
         ts = datetime.datetime.fromtimestamp(s["ts"]).strftime("%H:%M:%S")
         attrs = " ".join(f"{k}={v}" for k, v in sorted(s["attrs"].items()))
         click.echo(f"{ts}  {s['event']:<22} {attrs}")
+
+
+# --------------------------------------------------------------------- cluster
+
+
+@cli.group()
+def cluster():
+    """Multi-process cluster views (docs/Emulator.md "Multi-process
+    clusters"): one row per node-process, scraped over each process's
+    own ctrl endpoint."""
+
+
+@cluster.command("status")
+@click.option("--endpoints", default="",
+              help="comma-separated host:port ctrl endpoints — one per "
+              "node-process (default: just the root --host/--port). "
+              "ProcCluster.endpoints() emits this string.")
+@click.pass_context
+def cluster_status(ctx, endpoints):
+    """Per-process liveness and health: initialized / programmed routes
+    / FIB backoff (flagging saturation) / peer sync + worst peer
+    backoff / worst queue highwater vs its bound. An endpoint that
+    refuses the connection renders as a DOWN row instead of vanishing,
+    so a crashed process is visible in the same table as its
+    survivors."""
+    eps = []
+    for raw in endpoints.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        host, _, port = raw.rpartition(":")
+        if not port.isdigit():
+            raise click.ClickException(
+                f"bad endpoint {raw!r}: expected host:port"
+            )
+        eps.append(f"{host or ctx.obj['host']}:{int(port)}")
+    if not eps:
+        eps = [f"{ctx.obj['host']}:{ctx.obj['port']}"]
+
+    per_node = _scrape_endpoints(ctx, endpoints, "get_convergence_state", {})
+    rows = []
+    saturated = []
+    for ep in eps:
+        st = per_node.get(ep)
+        if st is None:
+            rows.append([ep, "-", "DOWN", "-", "-", "-", "-", "-"])
+            continue
+        fib = st.get("fib") or {}
+        peers = st.get("peers") or []
+        synced = sum(1 for p in peers if p.get("synced"))
+        peer_boff = max((p.get("backoff_ms") or 0 for p in peers), default=0)
+        fib_boff = fib.get("backoff_ms") or 0
+        if fib.get("backoff_saturated"):
+            saturated.append(f"{st['node']} fib")
+        if any(p.get("backoff_error") for p in peers) and peer_boff >= 30000:
+            saturated.append(f"{st['node']} peer-sync")
+        cap = st.get("queue_cap") or 0
+        hw = max(
+            (q.get("highwater") or 0 for q in st.get("queues") or []),
+            default=0,
+        )
+        rows.append(
+            [
+                ep,
+                st["node"],
+                "UP" if st.get("initialized") else "INIT",
+                str(fib.get("programmed_unicast", 0)),
+                f"{fib_boff}ms" + (" SAT" if fib.get("backoff_saturated") else ""),
+                f"{synced}/{len(peers)}",
+                f"{peer_boff}ms",
+                f"{hw}/{cap}" if cap else str(hw),
+            ]
+        )
+    up = sum(1 for r in rows if r[2] != "DOWN")
+    click.echo(f"# {up}/{len(eps)} process(es) up")
+    click.echo(
+        _table(
+            rows,
+            ["endpoint", "node", "state", "routes", "fib-backoff",
+             "peers-synced", "peer-backoff", "queue-hw"],
+        )
+    )
+    if saturated:
+        click.echo("# backoff saturated: " + ", ".join(sorted(set(saturated))))

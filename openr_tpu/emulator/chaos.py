@@ -447,9 +447,9 @@ async def run_schedule(cluster, plan: ChaosPlan, events=None) -> None:
 async def _dispatch(cluster, ev: ChaosEvent) -> None:
     log.debug("chaos: t=%.3fs %s %r", ev.at_s, ev.kind, ev.target)
     if ev.kind == "fail_link":
-        cluster.fail_link(*ev.target)
+        await _maybe_await(cluster.fail_link(*ev.target))
     elif ev.kind == "heal_link":
-        cluster.heal_link(*ev.target)
+        await _maybe_await(cluster.heal_link(*ev.target))
     elif ev.kind == "crash":
         name, graceful = ev.target
         if name in cluster.nodes:
@@ -459,8 +459,16 @@ async def _dispatch(cluster, ev: ChaosEvent) -> None:
         if name in cluster.crashed:
             await cluster.restart_node(name)
     elif ev.kind == "partition":
-        cluster.partition(ev.target)
+        await _maybe_await(cluster.partition(ev.target))
     elif ev.kind == "heal_partition":
-        cluster.heal_partition()
+        await _maybe_await(cluster.heal_partition())
     else:
         raise ValueError(f"unknown chaos event kind {ev.kind!r}")
+
+
+async def _maybe_await(result) -> None:
+    """Link/partition faults are sync dict flips on the in-process
+    Cluster but ctrl round trips on the multi-process ProcCluster —
+    one dispatcher serves both method surfaces."""
+    if asyncio.iscoroutine(result):
+        await result

@@ -354,6 +354,7 @@ WORK_EXEMPT_STAGES = (
     "spf_warm",
     "merge_full",
     "full_sync",
+    "fib_resync",  # periodic / post-failure full-table reprogram (O(table), delta 0 by design)
     "diff",
 )
 

@@ -1,0 +1,469 @@
+"""Cross-process port of the cluster invariant checker.
+
+The in-process checker (emulator/invariants.py) reaches into live
+OpenrNode objects; a ProcCluster's nodes are separate interpreters, so
+every probe here crosses the ctrl RPC boundary instead — the same six
+invariant classes, answered by the harness observation endpoints:
+
+  1. **KvStore consistency** — ``get_kvstore_digest`` from every live
+     process; per-area key/(version, originator, hash) sets must be
+     identical fleet-wide.
+  2. **FIB/oracle parity** — ``check_fib_oracle``: the from-scratch
+     CPU-oracle solve runs *inside* each node process (where its LSDB
+     lives) and only the verdict crosses the wire — at 100k prefixes
+     shipping LSDBs to a central checker would dwarf the routing
+     traffic under test.
+  3. **No stuck state** — ``get_convergence_state``: init gates,
+     Decision backlog, FIB desired-vs-programmed delta and retry
+     backoff, per-peer sync/session/backlog/backoff.
+  4. **Counter sanity** — ``get_counters``: rebuild-path counters sum
+     to spf_runs, the peer add/remove ledger matches the live peer set,
+     no residual FIB failure streak.
+  5. **Bounded seam depth** — policied queue watermarks (riding the
+     convergence-state payload) never exceeded cap + counted overflow.
+  6. **Work proportionality** — ``work_ledger_control``: the ledger is
+     per-PROCESS here (not one shared registry as in-proc), so each
+     node is warmed and audited individually; a breach names the node
+     it happened in.
+
+On failure the checker gathers flight-recorder rings from every
+*surviving* process over ctrl (``get_flight_recorder`` — a SIGKILLed
+node's ring dies with it; its absence is recorded in the manifest) and
+writes one JSON per node under a fresh dump dir, with the chaos replay
+seed embedded in the raised AssertionError.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+from openr_tpu.emulator.invariants import (
+    _DETAIL_CAP,
+    WORK_EXEMPT_STAGES,
+    Violation,
+)
+from openr_tpu.rpc import RpcError
+
+_PROBE_TIMEOUT_S = 60.0  # per-node ctrl call budget (oracle solves included)
+
+
+async def _probe(cluster, name: str, method: str, params=None):
+    """One ctrl probe; an unreachable node is itself a violation (the
+    process should be alive — crash_node moves it out of .nodes), so
+    failures surface as (None, Violation) rather than raising."""
+    try:
+        res = await cluster.call(
+            name, method, params or {}, timeout=_PROBE_TIMEOUT_S
+        )
+        return res, None
+    except (RpcError, OSError, KeyError) as e:
+        return None, Violation(
+            "ctrl.unreachable", name, f"{method} failed: {e}"
+        )
+
+
+# ------------------------------------------------------- 1. kvstore identical
+
+
+async def check_kvstore_consistency(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    digests: dict[str, dict[str, dict]] = {}  # name -> area -> {k: triple}
+    for name in sorted(cluster.nodes):
+        res, bad = await _probe(cluster, name, "get_kvstore_digest")
+        if bad:
+            out.append(bad)
+            continue
+        digests[name] = {
+            area: {k: tuple(v) for k, v in kv.items()}
+            for area, kv in res["areas"].items()
+        }
+    areas = sorted({a for d in digests.values() for a in d})
+    for area in areas:
+        per_node = {
+            n: d[area] for n, d in digests.items() if area in d
+        }
+        if not per_node:
+            continue
+        ref_name = min(per_node)
+        ref = per_node[ref_name]
+        for name, d in per_node.items():
+            if d == ref:
+                continue
+            diff_keys = sorted(
+                k for k in set(d) | set(ref) if d.get(k) != ref.get(k)
+            )
+            out.append(
+                Violation(
+                    "kvstore.divergence",
+                    name,
+                    f"area {area}: {len(diff_keys)} keys differ from "
+                    f"{ref_name}'s store, e.g. {diff_keys[:_DETAIL_CAP]}",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------ 2. fib == oracle rib
+
+
+async def check_fib_oracle_parity(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    for name in sorted(cluster.nodes):
+        res, bad = await _probe(cluster, name, "check_fib_oracle")
+        if bad:
+            out.append(bad)
+            continue
+        if res["pass"]:
+            continue
+        out.append(
+            Violation(
+                "fib.oracle_mismatch",
+                name,
+                f"{res['unicast_mismatches']} unicast / "
+                f"{res['mpls_mismatches']} mpls routes differ from the "
+                f"CPU-oracle rebuild, e.g. {res['sample'][:_DETAIL_CAP]}",
+            )
+        )
+    return out
+
+
+# ----------------------------------------------------------- 3. nothing stuck
+
+
+def _stuck_from_state(name: str, st: dict) -> list[Violation]:
+    out: list[Violation] = []
+    if not st["initialized"]:
+        out.append(
+            Violation("node.uninitialized", name, "init gates not passed")
+        )
+    if st["decision_pending_kvs"] or st["decision_debounce_pending"]:
+        out.append(
+            Violation(
+                "decision.pending",
+                name,
+                f"{st['decision_pending_kvs']} buffered kvs, debounce "
+                f"pending={st['decision_debounce_pending']}",
+            )
+        )
+    fib = st["fib"]
+    if not fib["converged"]:
+        out.append(
+            Violation(
+                "fib.unconverged",
+                name,
+                f"{fib['pending']} desired-vs-programmed deltas, "
+                f"e.g. {fib['stale'][:_DETAIL_CAP]}",
+            )
+        )
+    if fib["backoff_saturated"]:
+        out.append(
+            Violation(
+                "fib.backoff_saturated",
+                name,
+                f"program backoff pinned at {fib['backoff_ms']} ms",
+            )
+        )
+    elif fib["backoff_error"]:
+        out.append(
+            Violation(
+                "fib.backoff_pending",
+                name,
+                f"retry backoff at {fib['backoff_ms']} ms",
+            )
+        )
+    for p in st["peers"]:
+        who = f"peer {p['peer']} (area {p['area']})"
+        if not p["synced"]:
+            out.append(
+                Violation("kvstore.peer_unsynced", name, f"{who} not synced")
+            )
+        if not p["session"]:
+            out.append(
+                Violation(
+                    "kvstore.peer_sessionless", name, f"{who} has no session"
+                )
+            )
+        if p["pending_keys"] or p["pending_expired"]:
+            out.append(
+                Violation(
+                    "kvstore.peer_flood_backlog",
+                    name,
+                    f"{who}: {p['pending_keys']} keys / "
+                    f"{p['pending_expired']} expiries queued",
+                )
+            )
+        if p["backoff_error"]:
+            out.append(
+                Violation(
+                    "kvstore.peer_backoff",
+                    name,
+                    f"{who} sync backoff at {p['backoff_ms']} ms",
+                )
+            )
+    return out
+
+
+def _queue_bounds_from_state(name: str, st: dict) -> list[Violation]:
+    """Class 5 over the watermarks riding the convergence payload —
+    same COALESCE carve-out as the in-process checker (unmergeable
+    admissions past the bound are counted, not breached)."""
+    out: list[Violation] = []
+    cap = st.get("queue_cap") or 0
+    if cap <= 0:
+        return out
+    for q in st.get("queues", ()):
+        if q["highwater"] > cap + q["overflow"]:
+            out.append(
+                Violation(
+                    "queue.depth_breach",
+                    name,
+                    f"{q['key']} reader {q['reader']}: watermark "
+                    f"{q['highwater']} > cap {cap} "
+                    f"(+{q['overflow']} counted overflow)",
+                )
+            )
+    return out
+
+
+async def check_no_stuck_state(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    for name in sorted(cluster.nodes):
+        st, bad = await _probe(cluster, name, "get_convergence_state")
+        if bad:
+            out.append(bad)
+            continue
+        out += _stuck_from_state(name, st)
+        out += _queue_bounds_from_state(name, st)
+    return out
+
+
+# ---------------------------------------------------------- 4. counter sanity
+
+
+async def check_counter_sanity(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    for name in sorted(cluster.nodes):
+        c, bad = await _probe(cluster, name, "get_counters")
+        if bad:
+            out.append(bad)
+            continue
+        st, bad = await _probe(cluster, name, "get_convergence_state")
+        if bad:
+            out.append(bad)
+            continue
+        full = c.get("decision.rebuild.full", 0)
+        pfx = c.get("decision.rebuild.prefix_only", 0)
+        delta = c.get("decision.rebuild.topo_delta", 0)
+        runs = c.get("decision.spf_runs", 0)
+        if full + pfx + delta != runs:
+            out.append(
+                Violation(
+                    "counters.rebuild_sum",
+                    name,
+                    f"rebuild.full({full}) + rebuild.prefix_only({pfx}) "
+                    f"+ rebuild.topo_delta({delta}) != spf_runs({runs})",
+                )
+            )
+        live_peers = len(st["peers"])
+        added = c.get("kvstore.peers_added", 0)
+        removed = c.get("kvstore.peers_removed", 0)
+        if added - removed != live_peers:
+            out.append(
+                Violation(
+                    "counters.peer_ledger",
+                    name,
+                    f"peers_added({added}) - peers_removed({removed}) "
+                    f"!= live peers({live_peers})",
+                )
+            )
+        streak = c.get("fib.program_fail_streak", 0)
+        if streak:
+            out.append(
+                Violation(
+                    "counters.fib_fail_streak",
+                    name,
+                    f"fib.program_fail_streak={streak} after quiescence",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------- 6. work proportionality
+
+
+async def mark_fleet_warm(cluster) -> None:
+    """Arm the work-proportionality gate: each PROCESS has its own
+    ledger, so every live node is marked individually (the in-process
+    emulator marks one shared registry). Call after the first converged
+    round so warmup work (full syncs, first solves) is baseline, not
+    breach."""
+    for name in sorted(cluster.nodes):
+        await _probe(
+            cluster, name, "work_ledger_control", {"op": "mark_warm"}
+        )
+
+
+async def check_work_ratios(cluster) -> list[Violation]:
+    out: list[Violation] = []
+    for name in sorted(cluster.nodes):
+        res, bad = await _probe(
+            cluster, name, "work_ledger_control",
+            {"op": "violations", "exempt": list(WORK_EXEMPT_STAGES)},
+        )
+        if bad:
+            out.append(bad)
+            continue
+        if not res["warm_marked"]:
+            continue
+        for v in res["violations"]:
+            out.append(
+                Violation(
+                    "work.ratio_breach",
+                    name,
+                    f"stage {v['stage']}: worst steady round touched "
+                    f"{v['touched']} entities for delta {v['delta']} "
+                    f"(ratio {v['ratio']:.1f}, bound {v['bound']:.0f}) — "
+                    "a full-table walk crept into a delta-proportional "
+                    "stage",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------- flight-recorder dumps
+
+
+async def dump_flight_recorders(
+    cluster, violations=None, label: str = "invariant-failure"
+) -> str | None:
+    """Gather every SURVIVING process's flight-recorder ring + counter
+    snapshot over ctrl into one JSON per node under a fresh dump dir.
+    A hard-killed process's ring died with it; the dump manifest lists
+    those holes explicitly so a post-mortem reader knows the silence
+    is the fault, not a gap in the tooling."""
+    names = sorted({v.node for v in (violations or []) if v.node})
+    if not names or any(v.node is None for v in (violations or [])):
+        names = sorted(cluster.nodes)
+    dump_dir = tempfile.mkdtemp(prefix="openr-flight-")
+    wrote, missing = [], []
+    for name in names:
+        fr, bad = await _probe(cluster, name, "get_flight_recorder")
+        if bad:
+            missing.append({"node": name, "why": bad.detail})
+            continue
+        counters, _ = await _probe(cluster, name, "get_counters")
+        payload = {
+            "node": name,
+            "label": label,
+            "wrote_at": time.time(),  # orlint: disable=OR006 — post-mortem artifact metadata, not a seeded decision
+            "violations": [
+                str(v) for v in (violations or []) if v.node in (name, None)
+            ],
+            "events": fr["events"],
+            "counters": counters or {},
+        }
+        path = os.path.join(dump_dir, f"{name}.json")
+        await asyncio.to_thread(
+            _write_json, path, payload
+        )
+        wrote.append(name)
+    manifest = {
+        "label": label,
+        "gathered": wrote,
+        "unreachable": missing,
+        "crashed_at_dump": sorted(cluster.crashed),
+    }
+    await asyncio.to_thread(
+        _write_json, os.path.join(dump_dir, "MANIFEST.json"), manifest
+    )
+    return dump_dir if wrote or missing else None
+
+
+def _write_json(path: str, payload: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+
+
+async def _flight_hint(cluster, violations, label: str) -> str:
+    try:
+        d = await dump_flight_recorders(cluster, violations, label=label)
+    except asyncio.CancelledError:
+        raise
+    except Exception:  # noqa: BLE001 — the dump must never mask the failure
+        return ""
+    return f"\nflight-recorder dumps: {d}" if d else ""
+
+
+# -------------------------------------------------------------- entry points
+
+
+async def check_cluster(cluster) -> list[Violation]:
+    """All six invariant classes over ctrl; cheap single-payload checks
+    first so a settling cluster fails fast, the per-node oracle solves
+    last."""
+    out = await check_no_stuck_state(cluster)  # includes queue bounds
+    out += await check_work_ratios(cluster)
+    out += await check_kvstore_consistency(cluster)
+    out += await check_counter_sanity(cluster)
+    out += await check_fib_oracle_parity(cluster)
+    return out
+
+
+async def assert_invariants(cluster, context: str = "") -> None:
+    violations = await check_cluster(cluster)
+    if violations:
+        hint = f" (replay: {context})" if context else ""
+        lines = "\n  ".join(str(v) for v in violations)
+        flight = await _flight_hint(
+            cluster, violations, label=context or "assert"
+        )
+        raise AssertionError(
+            f"{len(violations)} cluster invariant violation(s){hint}:\n"
+            f"  {lines}{flight}"
+        )
+
+
+async def wait_quiescent(
+    cluster,
+    timeout_s: float = 60.0,
+    poll_s: float = 0.5,
+    context: str = "",
+) -> None:
+    """Converged AND two consecutive clean invariant sweeps, or raise
+    with the replay seed and a flight-recorder gather — the gate every
+    multi-process chaos round ends with. The oracle-parity probe runs
+    a real solve per node per sweep, hence the longer default poll."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    clean = 0
+    last: list[Violation] = []
+    while True:
+        if not await cluster.converged():
+            last = [
+                Violation(
+                    "cluster.unconverged",
+                    None,
+                    "cluster.converged() is False",
+                )
+            ]
+            clean = 0
+        else:
+            last = await check_cluster(cluster)
+            clean = 0 if last else clean + 1
+            if clean >= 2:
+                return
+        if loop.time() >= deadline:
+            hint = f" (replay: {context})" if context else ""
+            lines = "\n  ".join(str(v) for v in last[:8])
+            flight = await _flight_hint(
+                cluster, last, label=context or "quiesce-timeout"
+            )
+            raise AssertionError(
+                f"proc cluster failed to quiesce within {timeout_s:.0f}s"
+                f"{hint}; last violations:\n  {lines}{flight}"
+            )
+        await asyncio.sleep(poll_s)

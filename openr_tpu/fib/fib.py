@@ -522,8 +522,13 @@ class Fib(OpenrModule):
         snap_m = dict(self.desired_mpls)
         self._clear_pending()
         # honest O(table) accounting, delta 0: resync/dry-run/warm-boot
-        # are full-table by design and must read that way in work.fib.*
-        work_ledger.commit("fib", len(snap_u) + len(snap_m), 0)
+        # are full-table by design — recorded under their own stage
+        # (the spf_full / merge_full convention) so the delta-native
+        # "fib" stage stays gated at ratio 1 while the periodic resync
+        # doesn't read as a proportionality breach. With one ledger per
+        # PROCESS (the multi-process harness) there is no other node's
+        # churn to pool the ratio down, so the split is load-bearing.
+        work_ledger.commit("fib_resync", len(snap_u) + len(snap_m), 0)
         desired_u = {p: e.to_unicast_route() for p, e in snap_u.items()}  # orlint: disable=OR012 — full-table resync seam (O(P) by design)
         desired_m = {l: e.to_mpls_route() for l, e in snap_m.items()}
         if self.dry_run:

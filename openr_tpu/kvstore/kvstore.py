@@ -29,7 +29,7 @@ from openr_tpu.kvstore.transport import (
 )
 from openr_tpu.messaging import QueueClosedError, ReplicateQueue
 from openr_tpu.monitor import perf, work_ledger
-from openr_tpu.rpc import RpcError
+from openr_tpu.rpc import RpcError, RpcTransportError
 from openr_tpu.types.kvstore import KeyDumpParams, Publication, Value
 
 log = logging.getLogger(__name__)
@@ -81,6 +81,12 @@ class _Peer:
         # Reset on peer flap (the _Peer is rebuilt), so an upgraded
         # neighbor is re-probed with the delta form.
         self.legacy_sync = False
+        # set after the first successful transport connect: a later
+        # successful connect on the SAME _Peer is a reconnect (the far
+        # process died and came back, or the TCP session was torn down
+        # mid-flood) — counted as kvstore.peer_reconnects so kill/
+        # restart chaos is observable separately from first contact
+        self.ever_connected = False
         # pending flood state (coalesced by key: versions only grow, so
         # replacing an unsent value with a newer one is always correct)
         self.pending_keys: dict[str, Value] = {}
@@ -202,8 +208,23 @@ class KvStore(OpenrModule):
 
     async def _add_peer(self, spec: PeerSpec) -> None:
         key = (spec.area, spec.node_name)
-        if key in self.peers:
-            return
+        existing = self.peers.get(key)
+        if existing is not None:
+            if existing.spec.endpoint == spec.endpoint:
+                return
+            # same neighbor, NEW endpoint: a graceful restart holds the
+            # adjacency (the peer is never deleted), but the restarted
+            # process binds fresh ephemeral ports — NEIGHBOR_RESTARTED
+            # re-advertises them here. Without this teardown the old
+            # _Peer's sync loop would retry the dead endpoint until its
+            # backoff saturated, permanently (seen only across real
+            # process boundaries; the in-proc transport keys by name)
+            log.info(
+                "%s: peer %s moved %s -> %s, re-peering",
+                self.name, spec.node_name,
+                existing.spec.endpoint, spec.endpoint,
+            )
+            await self._del_peer(spec.area, spec.node_name)
         if spec.area not in self.dbs:
             # area mismatch between neighbors: reject instead of letting the
             # sync fiber crash-loop on a missing KvStoreDb
@@ -283,6 +304,17 @@ class KvStore(OpenrModule):
                         peer.spec.node_name, peer.spec.endpoint,
                         counters=self.counters,
                     )
+                    if peer.ever_connected:
+                        if self.counters is not None:
+                            self.counters.increment(
+                                "kvstore.peer_reconnects"
+                            )
+                            self.counters.flight_record(
+                                "kvstore.peer_reconnect",
+                                peer=peer.spec.node_name,
+                                area=area,
+                            )
+                    peer.ever_connected = True
                 own_hash = db.store_hash()
                 # delta sync (docs/Wire.md): after the first successful
                 # sync, open with a digestless store-hash probe — a
@@ -357,12 +389,22 @@ class KvStore(OpenrModule):
                 raise
             except Exception as e:  # noqa: BLE001
                 log.debug("%s: sync with %s failed: %s", self.name, peer.spec.node_name, e)
-                # a handler-level rejection (RpcError, not a transport
-                # ConnectionError) from a peer we offered the delta
-                # digest most likely means a pre-delta build choked on
-                # the triple form — retry in the legacy format, which
-                # every build accepts (docs/Wire.md migration story)
-                if not peer.legacy_sync and isinstance(e, RpcError):
+                # a handler-level rejection (plain RpcError — the peer
+                # ANSWERED with an error) from a peer we offered the
+                # delta digest most likely means a pre-delta build
+                # choked on the triple form — retry in the legacy
+                # format, which every build accepts (docs/Wire.md
+                # migration story). RpcTransportError is excluded: a
+                # connection that died mid-call (peer SIGKILLed, RST,
+                # timeout) says nothing about what the peer supports,
+                # and misclassifying it would permanently lock a
+                # delta-capable neighbor onto the O(store) legacy
+                # digest after every crash
+                if (
+                    not peer.legacy_sync
+                    and isinstance(e, RpcError)
+                    and not isinstance(e, RpcTransportError)
+                ):
                     peer.legacy_sync = True
                 peer.backoff.report_error()
                 if peer.session is not None:

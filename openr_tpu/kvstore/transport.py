@@ -20,7 +20,7 @@ import asyncio
 import time
 from typing import Any, Protocol
 
-from openr_tpu.rpc import RpcClient, RpcError, bin_frame
+from openr_tpu.rpc import RpcClient, RpcError, RpcTransportError, bin_frame
 from openr_tpu.types.kvstore import Publication
 from openr_tpu.types.serde import (
     from_jsonable,
@@ -263,15 +263,24 @@ class _TcpSession:
         self, area: str, sender_id: str, digest: dict | None,
         store_hash: int | None = None,
     ) -> dict:
-        return await self._c.call(
-            "kv.fullSync",
-            {
-                "area": area,
-                "sender": sender_id,
-                "digest": digest,
-                "store_hash": store_hash,
-            },
-        )
+        try:
+            return await self._c.call(
+                "kv.fullSync",
+                {
+                    "area": area,
+                    "sender": sender_id,
+                    "digest": digest,
+                    "store_hash": store_hash,
+                },
+            )
+        except (ConnectionError, RpcTransportError) as e:
+            # connection-level death (peer process SIGKILLed mid-sync,
+            # RST, timeout) surfaces as ConnectionError so the KvStore
+            # repair loop treats it exactly like a refused connect:
+            # backoff + retry. A plain RpcError — the peer's HANDLER
+            # answered with an error — passes through untouched; that
+            # is the only signal the legacy-responder probe may use.
+            raise ConnectionError(str(e)) from e
 
     async def flood(self, pub: Publication) -> int:
         try:

@@ -108,6 +108,7 @@ COUNTERS: frozenset[str] = frozenset(
         "kvstore.full_syncs_served",
         "kvstore.merged_updates",
         "kvstore.peer_disconnects",
+        "kvstore.peer_reconnects",
         "kvstore.peers_added",
         "kvstore.peers_rejected_bad_area",
         "kvstore.peers_removed",
@@ -124,9 +125,11 @@ COUNTERS: frozenset[str] = frozenset(
         "spark.heartbeat_sent",
         "spark.hello_recv",
         "spark.hello_sent",
+        "spark.chaos_dropped",
         "spark.inbox_dropped",
         "spark.neighbor_down",
         "spark.neighbor_up",
+        "spark.nongr_restarts_detected",
         "spark.restart_announced",
         "linkmonitor.adj_advertised",
         "linkmonitor.flap_damped",

@@ -50,7 +50,11 @@ assembly), ``merge`` (the scoped cross-area book fold — delta-
 proportional by construction), ``merge_full`` (the full cross-area
 fold, a fallback reached only on first-build / policy / revision-
 mismatch rounds — honest O(routes) like ``spf_full``, and exempt for
-the same reason), ``diff`` (route-db diff), ``fib`` (FIB programming),
+the same reason), ``diff`` (route-db diff), ``fib`` (delta-native FIB
+programming, gated at ratio 1), ``fib_resync`` (the periodic / post-
+failure / warm-boot full-table reprogram — honest O(table) with delta
+0 by design, split out so a per-process ledger doesn't read the
+scheduled resync as a proportionality breach),
 ``redistribute`` (PrefixManager RIB redistribution — delta-native:
 the fold consumes the RouteUpdate delta into the best-entries book and
 the advertisement sync ships only dirty prefixes), ``full_sync``
@@ -77,6 +81,7 @@ STAGES: tuple[str, ...] = (
     "merge_full",
     "diff",
     "fib",
+    "fib_resync",
     "redistribute",
     "full_sync",
 )

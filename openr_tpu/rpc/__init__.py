@@ -27,6 +27,7 @@ from openr_tpu.rpc.core import (  # noqa: F401
     RpcClient,
     RpcError,
     RpcServer,
+    RpcTransportError,
     StreamWriter,
     WireFrameError,
     bin_frame,

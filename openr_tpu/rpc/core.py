@@ -62,6 +62,16 @@ class RpcError(Exception):
     """Remote handler raised / transport failed."""
 
 
+class RpcTransportError(RpcError):
+    """The CONNECTION failed (refused, reset, closed, timed out) — the
+    remote handler never answered. Distinct from a plain RpcError so
+    callers running capability probes (KvStore's delta-sync negotiation)
+    can tell "the peer's handler rejected this method" from "the peer
+    process died mid-call": only the former says anything about what
+    the peer supports. Subclasses RpcError, so every existing
+    `except RpcError` path is unchanged."""
+
+
 class WireFrameError(ValueError):
     """Framing is unrecoverable on this connection (bad varint,
     oversized length prefix): the byte stream can no longer be resynced,
@@ -133,13 +143,13 @@ class StreamWriter:
 
     async def send(self, item: Any) -> None:
         if self.closed:
-            raise RpcError("stream closed")
+            raise RpcTransportError("stream closed")
         try:
             self._conn.write_msg({"id": self._id, "item": item})
             await self._conn.writer.drain()
         except (ConnectionError, RuntimeError) as e:
             self.closed = True
-            raise RpcError(f"stream write failed: {e}") from e
+            raise RpcTransportError(f"stream write failed: {e}") from e
 
     async def end(self) -> None:
         if not self.closed:
@@ -392,7 +402,7 @@ class RpcClient:
         if self._writer:
             self._writer.close()
             self._writer = None
-        self._fail_all(RpcError("client closed"))
+        self._fail_all(RpcTransportError("client closed"))
 
     def _fail_all(self, err: Exception) -> None:
         for fut in self._pending.values():
@@ -416,7 +426,7 @@ class RpcClient:
         path: the SAME immutable frame is handed to every peer client).
         The frame must match this connection's negotiated codec."""
         if self._writer is None:
-            raise RpcError("not connected")
+            raise RpcTransportError("not connected")
         self._writer.write(frame)
         if self.counters is not None:
             self.counters.increment("rpc.bytes_tx", len(frame))
@@ -495,13 +505,13 @@ class RpcClient:
         except asyncio.CancelledError:
             raise
         finally:
-            self._fail_all(RpcError("connection lost"))
+            self._fail_all(RpcTransportError("connection lost"))
 
     async def call(
         self, method: str, params: Any = None, timeout: float = 30.0
     ) -> Any:
         if self._writer is None:
-            raise RpcError("not connected")
+            raise RpcTransportError("not connected")
         req_id = self._next_id
         self._next_id += 1
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
@@ -525,20 +535,22 @@ class RpcClient:
                 # callers see one exception type for "call failed",
                 # whether the transport died before, during or after
                 # the send (RpcError docstring contract)
-                raise RpcError(f"transport failed: {e}") from e
+                raise RpcTransportError(f"transport failed: {e}") from e
             raise
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError as e:
             self._pending.pop(req_id, None)  # don't leak the slot
-            raise RpcError(f"call {method!r} timed out after {timeout}s") from e
+            raise RpcTransportError(
+                f"call {method!r} timed out after {timeout}s"
+            ) from e
 
     async def notify(self, method: str, params: Any = None) -> int:
         """Fire-and-forget. Returns the frame size written, so callers
         doing byte accounting (KvStore flood_bytes) get the real wire
         cost on either codec."""
         if self._writer is None:
-            raise RpcError("not connected")
+            raise RpcTransportError("not connected")
         n = self._write_msg({"method": method, "params": params or {}})
         await self._writer.drain()
         return n
@@ -548,7 +560,7 @@ class RpcClient:
     ) -> AsyncIterator[Any]:
         """Server-push stream; iterate until the server ends it."""
         if self._writer is None:
-            raise RpcError("not connected")
+            raise RpcTransportError("not connected")
         req_id = self._next_id
         self._next_id += 1
         # messaging-seam queue (OR004): bounded, block policy — the rx
@@ -569,14 +581,14 @@ class RpcClient:
                         # the rx loop declared this consumer stalled
                         # (STREAM_STALL_S at the bound) and broke the
                         # stream to protect the rest of the client
-                        raise RpcError(
+                        raise RpcTransportError(
                             "stream dropped: consumer stalled past "
                             "the buffer bound"
                         ) from None
                     if item is _STREAM_END:
                         return
                     if item is _STREAM_ERR:
-                        raise RpcError("stream broken")
+                        raise RpcTransportError("stream broken")
                     yield item
             finally:
                 # consumer stopped iterating (break / aclose / GC):

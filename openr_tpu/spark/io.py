@@ -191,6 +191,26 @@ class UdpIoProvider:
         self.inbox_max = inbox_max
         self.rx_dropped = 0  # oldest-shed count at the rx bound
         self._counters = None
+        # socket-level chaos seam: interfaces in this set neither send
+        # nor deliver received datagrams — the multi-process analogue of
+        # MockIoHub.set_link(up=False), installed over ctrl
+        # (chaos_set_drop) by the cluster supervisor to cut a REAL UDP
+        # path. Dropping rx as well as tx keeps partitions symmetric
+        # even when only one side got the rule
+        self._dropped_ifs: set[str] = set()
+
+    def set_drop(self, if_name: str, dropped: bool) -> None:
+        """Install/remove a per-interface drop rule (partition chaos)."""
+        if dropped:
+            self._dropped_ifs.add(if_name)
+        else:
+            self._dropped_ifs.discard(if_name)
+
+    def clear_drops(self) -> None:
+        self._dropped_ifs.clear()
+
+    def drop_rules(self) -> list[str]:
+        return sorted(self._dropped_ifs)
 
     def attach_counters(self, counters) -> None:
         """Export rx sheds as `spark.inbox_dropped` (wired by Spark)."""
@@ -207,6 +227,12 @@ class UdpIoProvider:
 
         class Proto(asyncio.DatagramProtocol):
             def datagram_received(self, data, addr):
+                if if_name in provider._dropped_ifs:
+                    # partitioned interface: discard at the socket edge,
+                    # exactly where a real filtered link loses packets
+                    if provider._counters is not None:
+                        provider._counters.increment("spark.chaos_dropped")
+                    return
                 # bounded rx: the RQueue sheds its oldest at the bound
                 # (periodic Spark traffic is self-superseding); count
                 # the drop here where the node identity is known
@@ -231,6 +257,10 @@ class UdpIoProvider:
         return await self._rx.get()
 
     async def send(self, if_name: str, payload: bytes) -> None:
+        if if_name in self._dropped_ifs:
+            if self._counters is not None:
+                self._counters.increment("spark.chaos_dropped")
+            return
         t = self._transports.get(if_name)
         peer = self._peers.get(if_name)
         if t is not None and peer is not None:

@@ -370,6 +370,11 @@ class Spark(OpenrModule):
         if hello.restarting:
             if nb.state == SparkNeighborState.ESTABLISHED:
                 nb.state = SparkNeighborState.RESTART
+                # the restarting instance's transport endpoints die with
+                # it: a REAL restart comes back on fresh (ephemeral)
+                # ports, so the cached handshake is void — re-establish
+                # only after the new instance handshakes again
+                nb.handshake_done = False
                 self._emit(NeighborEventType.NEIGHBOR_RESTARTING, nb)
             return
 
@@ -378,6 +383,25 @@ class Spark(OpenrModule):
         nb.last_recv_mono_us = now_us
 
         heard_us = self.node_name in hello.heard
+        if nb.state == SparkNeighborState.ESTABLISHED and not heard_us:
+            # an ESTABLISHED neighbor always carries us in its heard map
+            # (entries are only dropped when the neighbor object is), so
+            # its absence means the sender is a FRESH instance after a
+            # non-graceful restart (SIGKILL/re-exec — it never announced,
+            # so we never entered RESTART) or it expired us via its own
+            # hold timer. Its transport endpoints may have changed with
+            # it: tear down and re-negotiate from scratch so the fresh
+            # handshake re-learns the new kvstore/ctrl ports (exercised
+            # with real SIGKILLs by ProcCluster, docs/Emulator.md).
+            self._neighbor_down(nb, "established neighbor no longer hears us")
+            if self.counters is not None:
+                self.counters.increment("spark.nongr_restarts_detected")
+            nb = self._nb(if_name, hello.node_name)
+            nb.last_heard = now
+            nb.last_seq = hello.seq
+            nb.remote_if = hello.if_name
+            nb.last_their_sent_us = hello.sent_ts_us
+            nb.last_recv_mono_us = now_us
         if nb.state == SparkNeighborState.IDLE:
             nb.state = SparkNeighborState.WARM
         if heard_us:
@@ -392,8 +416,15 @@ class Spark(OpenrModule):
             if nb.state == SparkNeighborState.WARM:
                 nb.state = SparkNeighborState.NEGOTIATE
                 self.spawn(self._send_handshake(nb, is_ack=False))
-            elif nb.state == SparkNeighborState.RESTART:
-                # neighbor came back from graceful restart
+            elif (
+                nb.state == SparkNeighborState.RESTART
+                and nb.handshake_done
+            ):
+                # neighbor came back from graceful restart AND its new
+                # instance has re-handshaked (fresh kvstore/ctrl ports).
+                # Re-establishing on the hello alone would advertise the
+                # pre-restart endpoints — a peer that no longer exists
+                # when the restart was a real process re-exec.
                 nb.state = SparkNeighborState.ESTABLISHED
                 self._emit(NeighborEventType.NEIGHBOR_RESTARTED, nb)
 
@@ -440,6 +471,34 @@ class Spark(OpenrModule):
             nb.state = SparkNeighborState.ESTABLISHED
             nb.handshake_done = True
             self._emit(NeighborEventType.NEIGHBOR_UP, nb)
+        elif nb.state == SparkNeighborState.RESTART:
+            # the restarted instance is a fresh FSM, so it ALWAYS
+            # handshakes anew — this is the moment its new transport
+            # endpoints are known, so re-establish HERE (reference:
+            # Spark GR handshake †), not on the hello that merely
+            # proves it is alive again
+            nb.state = SparkNeighborState.ESTABLISHED
+            nb.handshake_done = True
+            self._emit(NeighborEventType.NEIGHBOR_RESTARTED, nb)
+        elif nb.state == SparkNeighborState.ESTABLISHED and not hs.is_ack:
+            # a steady-state peer never re-handshakes (handshakes are
+            # sent only from NEGOTIATE), so an unsolicited handshake
+            # from an ESTABLISHED neighbor is a fresh FSM after a
+            # restart we never got the GR announcement for (SIGKILL /
+            # re-exec — often the only observable sign: the survivor's
+            # own stale heard entry lets the new instance skip straight
+            # to NEGOTIATE, so no empty-heard hello ever arrives). The
+            # endpoint fields above just took its NEW kvstore/ctrl
+            # ports; re-emit so consumers re-peer instead of flooding
+            # the dead endpoint forever (found by ProcCluster hard
+            # kills, docs/Emulator.md). A duplicate NEGOTIATE-phase
+            # handshake that lost the race to our ack lands here too —
+            # the re-emitted endpoints are then unchanged and the
+            # consumers' re-peer is a no-op.
+            nb.handshake_done = True
+            if self.counters is not None:
+                self.counters.increment("spark.nongr_restarts_detected")
+            self._emit(NeighborEventType.NEIGHBOR_RESTARTED, nb)
 
     def _on_heartbeat(self, if_name: str, hb: HeartbeatMsg) -> None:
         if hb.node_name == self.node_name:

@@ -140,6 +140,122 @@ def fat_tree(k: int = 4, metric: int = 1):
     return _mk_dbs(n, edges)
 
 
+def fat_tree_pod(k: int = 4, pods: int = 1, metric: int = 1):
+    """Pod-granular fat-tree slice: the (k/2)^2 core switches plus
+    ``pods`` pods of k/2 agg + k/2 tor each — the deployment unit a
+    cluster grows by (a full ``fat_tree(k)`` is ``pods=k``). Lets the
+    multi-process harness pick exact fleet sizes ((k/2)^2 + pods*k
+    nodes: k=4, pods=3 -> 16; k=4, pods=15 -> 64) while keeping real
+    fat-tree wiring: tor<->agg full bipartite per pod, agg i uplinked
+    to cores [i*(k/2), (i+1)*(k/2))."""
+    assert k % 2 == 0 and k >= 2, k
+    assert 1 <= pods <= k, (pods, k)
+    half = k // 2
+    n_core = half * half
+    n = n_core + pods * k
+
+    def agg_id(pod, i):
+        return n_core + pod * k + i
+
+    def tor_id(pod, i):
+        return n_core + pod * k + half + i
+
+    edges = []
+    for pod in range(pods):
+        for a in range(half):
+            for t in range(half):
+                u, v = agg_id(pod, a), tor_id(pod, t)
+                edges += [(u, v, metric), (v, u, metric)]
+            for c in range(half):
+                u, v = agg_id(pod, a), a * half + c
+                edges += [(u, v, metric), (v, u, metric)]
+    return _mk_dbs(n, edges)
+
+
+def wan_like(
+    n: int,
+    seed: int = 0,
+    core_frac: float = 0.25,
+    metric_lo: int = 10,
+    metric_hi: int = 100,
+):
+    """WAN-ish topology: a ring of core POPs with seeded long-haul
+    chords (express links), every remaining node a stub site dual-homed
+    to two distinct core POPs. Heterogeneous seeded metrics in
+    [metric_lo, metric_hi] model circuit latency — unlike the
+    uniform-metric DC families, SPF here has real tie-free geography.
+    Deterministic under (n, seed): same arguments, same graph."""
+    assert n >= 4, n
+    rng = np.random.default_rng(seed)
+    n_core = max(3, int(n * core_frac))
+    n_core = min(n_core, n)
+    n_stub = n - n_core
+
+    def m():
+        return int(rng.integers(metric_lo, metric_hi + 1))
+
+    edges = []
+    seen: set[tuple[int, int]] = set()
+
+    def add(u, v, w):
+        if u == v or (u, v) in seen:
+            return
+        seen.add((u, v))
+        seen.add((v, u))
+        edges.append((u, v, w))
+        edges.append((v, u, w))
+
+    for i in range(n_core):  # core POP ring
+        add(i, (i + 1) % n_core, m())
+    # express chords: ~1 per 3 core POPs, endpoints seeded
+    for _ in range(max(1, n_core // 3)):
+        u = int(rng.integers(0, n_core))
+        v = int(rng.integers(0, n_core))
+        add(u, v, m())
+    for s in range(n_stub):  # dual-homed stub sites
+        sid = n_core + s
+        h = int(rng.integers(0, n_core))
+        add(sid, h, m())
+        if n_core > 1:
+            add(sid, (h + 1) % n_core, m())
+    return _mk_dbs(n, edges)
+
+
+def hub_and_spoke(
+    hubs: int = 2, spokes: int = 8, metric: int = 1, spoke_metric: int = 10
+):
+    """``hubs`` fully-meshed hub routers; each spoke dual-homed to a
+    primary hub (round-robin) and the next hub over (single-homed when
+    hubs == 1). The degree-skew extreme the flooding mesh sees in
+    access/aggregation networks: hub fan-out grows with the spoke
+    count while every spoke keeps degree <= 2."""
+    assert hubs >= 1 and spokes >= 0, (hubs, spokes)
+    edges = []
+    for i in range(hubs):
+        for j in range(i + 1, hubs):
+            edges += [(i, j, metric), (j, i, metric)]
+    for s in range(spokes):
+        sid = hubs + s
+        h = s % hubs
+        edges += [(sid, h, spoke_metric), (h, sid, spoke_metric)]
+        if hubs > 1:
+            b = (h + 1) % hubs
+            edges += [(sid, b, spoke_metric), (b, sid, spoke_metric)]
+    return _mk_dbs(hubs + spokes, edges)
+
+
+def edges_of(adj_dbs) -> list[tuple[str, str]]:
+    """Undirected (name_a, name_b) pairs of a generator's adjacency
+    databases — the wiring list the emulator Cluster / multi-process
+    supervisor consume (each pair becomes one point-to-point link)."""
+    pairs: set[tuple[str, str]] = set()
+    for db in adj_dbs:
+        for adj in db.adjacencies:
+            a, b = db.this_node_name, adj.other_node_name
+            pairs.add((a, b) if a < b else (b, a))
+    return sorted(pairs)
+
+
 def erdos_renyi_csr(
     n: int, avg_degree: int = 10, seed: int = 0, max_metric: int = 16
 ):

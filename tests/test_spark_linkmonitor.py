@@ -118,6 +118,74 @@ def test_two_node_discovery_and_hold_timer():
     run(main())
 
 
+def test_nongraceful_restart_detected_via_heard_map():
+    """SIGKILL-style restart with NO graceful announce: the fresh
+    instance's hellos don't carry us in their heard map, so the survivor
+    must tear the ESTABLISHED adjacency down and re-negotiate — the
+    fresh handshake is what carries the NEW kvstore/ctrl endpoints.
+    Without the teardown the survivor keeps flooding a dead endpoint
+    forever (found by the multi-process harness, docs/Emulator.md)."""
+
+    async def main():
+        hub = MockIoHub()
+        sa, qa = mk_spark(hub, "a", kvstore_port=1111)
+        sb, _ = mk_spark(hub, "b", kvstore_port=2222)
+        ra = qa.get_reader()
+        hub.link("a", "if-ab", "b", "if-ba", latency_ms=1)
+        await sa.start()
+        await sb.start()
+        sa.add_interface("if-ab")
+        sb.add_interface("if-ba")
+        ok = await settle(
+            lambda: (nb := sa.neighbors.get(("if-ab", "b"))) is not None
+            and nb.state == SparkNeighborState.ESTABLISHED
+        )
+        assert ok, "initial adjacency did not establish"
+        while ra.try_get() is not None:
+            pass
+
+        # hard-kill b: no announce_restart, inbox dropped (dead
+        # incarnation's backlog gone), fresh instance on a NEW endpoint
+        await sb.stop()
+        hub.drop_node("b")
+        sb2, _ = mk_spark(hub, "b", kvstore_port=3333)
+        await sb2.start()
+        sb2.add_interface("if-ba")
+
+        ok = await settle(
+            lambda: sa.counters.get("spark.nongr_restarts_detected") > 0
+            and (nb := sa.neighbors.get(("if-ab", "b"))) is not None
+            and nb.state == SparkNeighborState.ESTABLISHED
+            and nb.kvstore_port == 3333,
+            timeout=5.0,
+        )
+        assert ok, "survivor never re-learned the restarted instance"
+        # two valid detection paths: usually the survivor's stale heard
+        # entry fast-tracks the fresh FSM to NEGOTIATE and the
+        # unsolicited handshake yields NEIGHBOR_RESTARTED; if the fresh
+        # instance's empty-heard hello wins the race instead, the
+        # heard-map teardown yields NEIGHBOR_DOWN then NEIGHBOR_UP.
+        # Either way the LAST up-ish event must carry the NEW endpoint.
+        events = []
+        while (e := ra.try_get()) is not None:
+            events.append(e)
+        upish = [
+            e
+            for e in events
+            if e.type
+            in (
+                NeighborEventType.NEIGHBOR_UP,
+                NeighborEventType.NEIGHBOR_RESTARTED,
+            )
+        ]
+        assert upish, f"no re-peer event emitted: {[e.type for e in events]}"
+        assert upish[-1].info.kvstore_port == 3333
+        await sa.stop()
+        await sb2.stop()
+
+    run(main())
+
+
 def test_three_node_star():
     """Hub node sees both leaves on separate interfaces."""
 

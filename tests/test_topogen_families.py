@@ -1,0 +1,150 @@
+"""The multi-process rung families (openr_tpu/utils/topogen.py):
+fat-tree pod slices, WAN-like core+stub graphs, hub-and-spoke — node
+and edge counts, connectivity, degree bounds, and seed determinism.
+The emulator supervisor wires real sockets from `edges_of`, so a
+generator bug here becomes a silently partitioned fleet there."""
+
+from collections import defaultdict
+
+from openr_tpu.utils.topogen import (
+    edges_of,
+    fat_tree_pod,
+    hub_and_spoke,
+    node_name,
+    wan_like,
+)
+
+
+def _degrees(edges):
+    deg = defaultdict(int)
+    for a, b in edges:
+        deg[a] += 1
+        deg[b] += 1
+    return deg
+
+
+def _connected(n, edges):
+    adj = defaultdict(set)
+    for a, b in edges:
+        adj[a].add(b)
+        adj[b].add(a)
+    seen = {node_name(0)}
+    frontier = [node_name(0)]
+    while frontier:
+        nxt = frontier.pop()
+        for peer in adj[nxt]:
+            if peer not in seen:
+                seen.add(peer)
+                frontier.append(peer)
+    return len(seen) == n
+
+
+# ------------------------------------------------------------ fat-tree pods
+
+
+def test_fat_tree_pod_counts():
+    # (k/2)^2 cores + pods*k pod switches; per pod (k/2)^2 tor<->agg
+    # edges + (k/2)^2 agg<->core uplinks
+    for k, pods, want_n in [(4, 1, 8), (4, 3, 16), (8, 2, 32), (8, 6, 64)]:
+        adj_dbs, prefix_dbs = fat_tree_pod(k, pods)
+        assert len(adj_dbs) == want_n
+        assert len(prefix_dbs) == want_n
+        edges = edges_of(adj_dbs)
+        half = k // 2
+        assert len(edges) == pods * 2 * half * half
+
+
+def test_fat_tree_pod_connectivity_and_degrees():
+    k, pods = 4, 3
+    adj_dbs, _ = fat_tree_pod(k, pods)
+    edges = edges_of(adj_dbs)
+    assert _connected(len(adj_dbs), edges)
+    half = k // 2
+    n_core = half * half
+    deg = _degrees(edges)
+    for i in range(n_core):
+        # each pod's matching agg uplinks to this core exactly once
+        assert deg[node_name(i)] == pods
+    for pod in range(pods):
+        for a in range(half):
+            # agg: full bipartite to the pod's tors + half core uplinks
+            assert deg[node_name(n_core + pod * k + a)] == k
+        for t in range(half):
+            assert deg[node_name(n_core + pod * k + half + t)] == half
+
+
+def test_fat_tree_pod_deterministic():
+    a1, p1 = fat_tree_pod(4, 2)
+    a2, p2 = fat_tree_pod(4, 2)
+    assert edges_of(a1) == edges_of(a2)
+    assert [db.this_node_name for db in a1] == [db.this_node_name for db in a2]
+    assert len(p1) == len(p2)
+
+
+# --------------------------------------------------------------- WAN-like
+
+
+def test_wan_like_counts_and_connectivity():
+    for n in (8, 16, 32):
+        adj_dbs, prefix_dbs = wan_like(n, seed=7)
+        assert len(adj_dbs) == n
+        assert len(prefix_dbs) == n
+        assert _connected(n, edges_of(adj_dbs))
+
+
+def test_wan_like_stub_degree_bound():
+    n = 24
+    adj_dbs, _ = wan_like(n, seed=3)
+    n_core = max(3, int(n * 0.25))
+    deg = _degrees(edges_of(adj_dbs))
+    for i in range(n_core, n):
+        # every stub site is dual-homed to two distinct core POPs
+        assert deg[node_name(i)] == 2
+
+
+def test_wan_like_seed_determinism():
+    def fingerprint(adj_dbs):
+        return sorted(
+            (db.this_node_name, a.other_node_name, a.metric)
+            for db in adj_dbs
+            for a in db.adjacencies
+        )
+
+    a1, _ = wan_like(16, seed=11)
+    a2, _ = wan_like(16, seed=11)
+    a3, _ = wan_like(16, seed=12)
+    assert fingerprint(a1) == fingerprint(a2)
+    assert fingerprint(a1) != fingerprint(a3)
+
+
+def test_wan_like_metrics_heterogeneous_and_bounded():
+    adj_dbs, _ = wan_like(16, seed=5, metric_lo=10, metric_hi=100)
+    metrics = {a.metric for db in adj_dbs for a in db.adjacencies}
+    assert all(10 <= m <= 100 for m in metrics)
+    assert len(metrics) > 1  # seeded geography, not a uniform mesh
+
+
+# ----------------------------------------------------------- hub-and-spoke
+
+
+def test_hub_and_spoke_counts_and_degrees():
+    hubs, spokes = 3, 9
+    adj_dbs, _ = hub_and_spoke(hubs, spokes)
+    assert len(adj_dbs) == hubs + spokes
+    edges = edges_of(adj_dbs)
+    assert len(edges) == hubs * (hubs - 1) // 2 + 2 * spokes
+    assert _connected(hubs + spokes, edges)
+    deg = _degrees(edges)
+    for s in range(spokes):
+        assert deg[node_name(hubs + s)] == 2  # dual-homed, never more
+    for h in range(hubs):
+        assert deg[node_name(h)] >= hubs - 1  # full hub mesh
+
+
+def test_hub_and_spoke_single_hub():
+    adj_dbs, _ = hub_and_spoke(1, 4)
+    edges = edges_of(adj_dbs)
+    assert len(edges) == 4  # single-homed when there is no second hub
+    deg = _degrees(edges)
+    assert deg[node_name(0)] == 4
+    assert _connected(5, edges)
