@@ -1,0 +1,250 @@
+"""Crash-recovery bench: journal engine micro-bench + warm-boot smoke.
+
+Two halves, one CI lane (docs/Persist.md):
+
+  * **micro** — the journal engine alone: append+fsync-batched write
+    rate and cold replay rate over a synthetic book workload. The row
+    lands in BENCH_HISTORY.jsonl via benchmarks/history.py, so the
+    warn-only sentinel flags drift of the durable-write hot path.
+  * **smoke** — a 16-node multi-process fat-tree pod (real sockets,
+    real SIGKILL) with persistence on: snapshot the victim's durable
+    book digests at quiescence, arm a torn write, drive doomed churn
+    at the victim and real churn at a survivor, announce GR, SIGKILL,
+    restart, then demand
+      - the full cross-process invariant suite,
+      - byte parity of the recovered books vs the pre-crash snapshot
+        plus zero withdrawal window (proc_invariants.check_persist_recovery),
+      - boot-time reconciliation proportional to the genuine
+        desired-vs-durable diff (work.persist_replay.* counters,
+        bound k*delta + floor — a full-reprogram regression trips it),
+      - zero steady-state XLA compiles across the whole cycle.
+
+Run: python benchmarks/bench_persist.py --smoke
+Prints one JSON document (bench.py contract: metric/value/unit/
+vs_baseline/detail); exit 0/1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+#: persist_replay acceptance bound — same family as the work ledger's
+#: steady bound: touched <= K * delta + FLOOR (docs/Monitor.md)
+_REPLAY_K = 8
+_REPLAY_FLOOR = 64
+
+
+def run_micro(n_records: int = 20_000) -> dict:
+    """Journal append + cold-replay rates, engine only (no cluster)."""
+    from openr_tpu.persist.journal import (
+        Journal,
+        JournalRecord,
+        OP_SET,
+        load_journal,
+    )
+
+    d = tempfile.mkdtemp(prefix="openr-persist-micro-")
+    path = os.path.join(d, "journal.bin")
+    try:
+        j = Journal(path)
+        recs = [
+            JournalRecord(
+                "bench", OP_SET, b"k%d" % (i % 4096), b"v%d" % i
+            )
+            for i in range(n_records)
+        ]
+        t0 = time.perf_counter()
+        for r in recs:
+            j.append(r)
+        j.sync()
+        append_s = time.perf_counter() - t0
+        size = j.size
+        j.close()
+
+        t0 = time.perf_counter()
+        replayed, torn = load_journal(path)
+        replay_s = time.perf_counter() - t0
+        assert len(replayed) == n_records and torn == 0
+        return {
+            "records": n_records,
+            "journal_bytes": size,
+            "append_us_per_record": round(append_s / n_records * 1e6, 3),
+            "appends_per_sec": round(n_records / append_s, 1),
+            "replay_us_per_record": round(replay_s / n_records * 1e6, 3),
+            "replays_per_sec": round(n_records / replay_s, 1),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+async def run_smoke(args) -> dict:
+    """CI lane: crash-consistent warm boot across a real process crash
+    on a 16-node fat-tree pod, under an injected torn write."""
+    from bench_cluster import _family_links, _fleet_sum
+
+    from openr_tpu.emulator import proc_invariants
+    from openr_tpu.emulator.procs import ProcCluster
+
+    base = args.workdir or tempfile.mkdtemp(prefix="openr-persist-smoke-")
+    links = _family_links("fat_tree_pod", 16, args.seed)
+    cluster = ProcCluster(
+        links, base, prefixes_per_node=args.smoke_prefixes,
+        # survivors' hold must outlive the victim's re-exec window or
+        # zero-withdrawal is unsatisfiable by construction
+        spark_overrides={
+            "hold_time_ms": 120_000,
+            "graceful_restart_time_ms": 120_000,
+        },
+    )
+    victim = sorted(cluster.nodes)[-1]  # a ToR, not a core
+    survivor = sorted(cluster.nodes)[0]
+    replay = f"bench_persist --smoke seed={args.seed}"
+    try:
+        t0 = time.monotonic()
+        await cluster.start()
+        await proc_invariants.wait_quiescent(
+            cluster, timeout_s=120, context=f"{replay} cold"
+        )
+        cold = time.monotonic() - t0
+        await proc_invariants.mark_fleet_warm(cluster)
+        compiles0 = await _fleet_sum(cluster, "jax.compiles.total")
+
+        pre = await proc_invariants.snapshot_persist(cluster, victim)
+        if not pre["books"]:
+            raise AssertionError(
+                f"{victim} has no durable books at quiescence ({replay})"
+            )
+
+        # torn write armed, then doomed churn AT the victim: applied in
+        # memory, flooded, but never durable — the crashed incarnation
+        # must not resurrect any of it
+        res = await cluster.inject_disk_fault(victim, "torn", at=5)
+        if not res.get("ok"):
+            raise AssertionError(f"fault arm failed: {res} ({replay})")
+        await cluster.call(victim, "advertise_prefixes", {
+            "prefixes": [f"10.96.66.{i}/32" for i in range(8)],
+        })
+        await cluster.call(victim, "spark_announce_restart")
+        await cluster.crash_node(victim)  # SIGKILL
+
+        # real churn at a survivor WHILE the victim is down: its warm
+        # boot must reconcile exactly this delta on top of the durable
+        # table (the persist_replay proportionality gate below)
+        await cluster.call(survivor, "advertise_prefixes", {
+            "prefixes": [f"10.96.77.{i}/32" for i in range(8)],
+        })
+        await asyncio.sleep(1.0)
+        await cluster.restart_node(victim)
+        await proc_invariants.wait_quiescent(
+            cluster, timeout_s=120, context=f"{replay} warm boot"
+        )
+
+        violations = await proc_invariants.check_persist_recovery(
+            cluster, pre
+        )
+        if violations:
+            lines = "; ".join(str(v) for v in violations)
+            raise AssertionError(
+                f"crash-recovery invariant: {lines} ({replay})"
+            )
+
+        status = await cluster.get_persist_status(victim)
+        rec = status.get("recovery") or {}
+        if rec.get("truncated_bytes", 0) <= 0:
+            raise AssertionError(
+                f"torn write never bit: recovery {rec} ({replay})"
+            )
+
+        c = await cluster.call(
+            victim, "get_counters", {"prefix": "work.persist_replay"}
+        )
+        touched = c.get("work.persist_replay.touched", 0)
+        delta = c.get("work.persist_replay.delta", 0)
+        if touched > _REPLAY_K * delta + _REPLAY_FLOOR:
+            raise AssertionError(
+                f"persist_replay reconciliation not delta-proportional: "
+                f"touched {touched} vs delta {delta} "
+                f"(bound {_REPLAY_K}*delta+{_REPLAY_FLOOR}) ({replay})"
+            )
+
+        compiles1 = await _fleet_sum(cluster, "jax.compiles.total")
+        if compiles1 != compiles0:
+            raise AssertionError(
+                f"steady-state crash recovery compiled: jax.compiles."
+                f"total {compiles0} -> {compiles1} ({replay})"
+            )
+        return {
+            "nodes": len(cluster.nodes),
+            "cold_converge_s": round(cold, 2),
+            "victim": victim,
+            "recovered_books": len(pre["books"]),
+            "recovered_truncated_bytes": int(rec["truncated_bytes"]),
+            "persist_replay_touched": int(touched),
+            "persist_replay_delta": int(delta),
+            "steady_compiles": int(compiles1 - compiles0),
+            "invariants": "ok",
+            "replay": replay,
+        }
+    finally:
+        await cluster.stop()
+        if not args.keep:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="bench_persist")
+    ap.add_argument("--smoke", action="store_true",
+                    help="also run the 16-node crash-recovery smoke")
+    ap.add_argument("--micro-records", type=int, default=20_000)
+    ap.add_argument("--smoke-prefixes", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument(
+        "--keep", action="store_true",
+        help="keep the smoke workdir (configs + per-node logs)",
+    )
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    micro = run_micro(args.micro_records)
+    result = {
+        "metric": "persist_journal_append_us",
+        "value": micro["append_us_per_record"],
+        "unit": "us/record",
+        "vs_baseline": None,
+        "detail": {"micro": micro},
+    }
+    if args.smoke:
+        try:
+            result["detail"]["smoke"] = asyncio.run(run_smoke(args))
+        except AssertionError as e:
+            print(f"FAIL: {e}", file=sys.stderr)
+            return 1
+    try:
+        import history
+
+        history.append_row(result)
+    except Exception as e:  # noqa: BLE001 — sentinel is best-effort
+        print(f"history append skipped: {e}", file=sys.stderr)
+    doc = json.dumps(result, indent=2)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

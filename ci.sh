@@ -49,7 +49,7 @@ if [ "$smoke_rc" -ne 1 ]; then
     exit 1
 fi
 for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
-            OR010 OR011 OR012 OR013; do
+            OR010 OR011 OR012 OR013 OR014; do
     if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
         echo "orlint smoke: rule $code produced no finding on the" \
              "known-bad fixture (rule deleted or broken?)"
@@ -57,7 +57,7 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
         exit 1
     fi
 done
-echo "ok: known-bad fixture trips all 13 rules"
+echo "ok: known-bad fixture trips all 14 rules"
 
 echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # the topology-delta acceptance gate (docs/Decision.md): single-link
@@ -181,6 +181,23 @@ rm -rf "$SMOKE_LOG_DIR/proc-smoke"
 JAX_PLATFORMS=cpu python benchmarks/bench_cluster.py --smoke \
     --workdir "$SMOKE_LOG_DIR/proc-smoke" --keep \
     2> >(smoke_log proc_cluster_smoke)
+
+echo "== crash-recovery smoke (journaled warm boot under torn write) =="
+# the crash-consistent persistence gate (docs/Persist.md): journal
+# append/replay micro-bench (row into the BENCH_HISTORY sentinel),
+# then a 16-node multi-process pod with persistence on — durable book
+# digests snapshotted at quiescence, a torn write armed and fed doomed
+# churn, GR announced, the victim SIGKILLed mid-churn and re-exec'd.
+# exits 1 unless the full cross-process invariant suite passes, the
+# recovered books are byte-identical to the pre-crash snapshot with
+# zero withdrawal window observed by survivors, the torn frame was
+# found and truncated at boot, boot reconciliation stayed delta-
+# proportional (work.persist_replay bound), and zero steady-state XLA
+# compiles landed across the whole cycle
+rm -rf "$SMOKE_LOG_DIR/persist-smoke"
+JAX_PLATFORMS=cpu python benchmarks/bench_persist.py --smoke \
+    --workdir "$SMOKE_LOG_DIR/persist-smoke" --keep \
+    2> >(smoke_log persist_smoke)
 
 echo "== pytest tier-1 (not slow) =="
 # the fast lane the PR driver gates on — observability (test_perf),

@@ -43,14 +43,12 @@ log = logging.getLogger("openr_tpu.main")
 
 def _write_ready(path: str, payload: dict) -> None:
     """Atomic readiness handshake: the supervisor polls for this file,
-    so a partially written JSON must never be observable — write to a
-    sibling temp name, fsync, rename."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    so a partially written JSON must never be observable. The persist
+    plane's atomic-write discipline (fsync-temp → rename → fsync-
+    parent-dir) is the one durability implementation in the tree."""
+    from openr_tpu.persist import atomic_write_bytes
+
+    atomic_write_bytes(path, json.dumps(payload).encode())
 
 
 async def run_node(
@@ -58,6 +56,7 @@ async def run_node(
     dataplane: str,
     store_path: str | None,
     ready_file: str | None = None,
+    persist_dir: str | None = None,
 ):
     io = UdpIoProvider()
     # bound ports per interface: with local_port=0 in the config every
@@ -73,10 +72,26 @@ async def run_node(
             u.if_name, u.local_port, peer
         )
 
+    persist = None
+    if persist_dir is not None:
+        from openr_tpu.persist import PersistPlane
+
+        # constructed before the node so the mock dataplane below can
+        # restore its surviving routes from the same journal; the node
+        # attaches its Counters registry on construction
+        persist = PersistPlane(persist_dir)
+
     if dataplane == "netlink":
         from openr_tpu.platform import NetlinkFibService
 
         fib_handler = NetlinkFibService()
+    elif persist is not None:
+        # a real kernel FIB outlives the daemon; the durable mock is
+        # what makes SIGKILL→restart a warm boot instead of a silent
+        # cold boot (persist/dataplane.py)
+        from openr_tpu.persist.dataplane import DurableMockFibHandler
+
+        fib_handler = DurableMockFibHandler(persist)
     else:
         fib_handler = MockFibHandler()
 
@@ -106,6 +121,7 @@ async def run_node(
         enable_ctrl=True,
         ctrl_port=config.node.ctrl_port,
         store_path=store_path,
+        persist=persist,
     )
     node.kvstore.register_rpc(kv_rpc)
     # wire-level byte accounting (rpc.bytes_tx/rx): the listener exists
@@ -178,6 +194,12 @@ def main(argv: list[str] | None = None) -> int:
         "--store-path", default=None,
         help="PersistentStore snapshot path (default: no persistence)",
     )
+    ap.add_argument(
+        "--persist-dir", default=None,
+        help="crash-consistent journal directory (docs/Persist.md):"
+        " originated keys, redistribution books and the programmed FIB"
+        " survive SIGKILL and warm-boot on restart (default: off)",
+    )
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument(
         "--ready-file", default=None,
@@ -207,6 +229,7 @@ def main(argv: list[str] | None = None) -> int:
             run_node(
                 config, args.dataplane, args.store_path,
                 ready_file=args.ready_file,
+                persist_dir=args.persist_dir,
             )
         )
     except OSError as e:

@@ -1210,6 +1210,66 @@ def monitor_flight(ctx, limit, kind):
         click.echo(f"{ts}  {e['kind']:<26} {attrs}")
 
 
+# -------------------------------------------------------------------- persist
+
+
+@cli.group()
+def persist():
+    """Crash-consistent durable-state plane (docs/Persist.md)."""
+
+
+@persist.command("status")
+@click.pass_context
+def persist_status(ctx):
+    """Journal health and recovery provenance: on-disk size, records
+    since the last compaction, last-fsync age, per-book record counts
+    with content digests (the byte-parity token the crash-recovery
+    invariant compares), what this boot recovered, and any armed or
+    fired injected disk faults."""
+    res = _run(ctx, "get_persist_status")
+    if not res.get("enabled"):
+        click.echo(f"node {res['node']}: persistence disabled")
+        return
+    rec = res.get("recovery") or {}
+    rows = [
+        ["dir", res["dir"]],
+        ["journal_bytes", f"{res['journal_bytes']}"],
+        ["journal_records", f"{res['journal_records']}"],
+        ["last_fsync_age_s", f"{res['last_fsync_age_s']:.3f}"],
+        ["compactions", f"{res['compactions']}"],
+        ["append_errors", f"{res['append_errors']}"],
+        ["wedged", f"{res['wedged']}"],
+        ["recovered_snapshot", f"{rec.get('snapshot_records', 0)}"],
+        ["recovered_journal", f"{rec.get('journal_records', 0)}"],
+        ["recovered_truncated_bytes", f"{rec.get('truncated_bytes', 0)}"],
+    ]
+    click.echo(f"# node {res['node']}")
+    click.echo(_table(rows, ["persist", "value"]))
+    books = res.get("books") or {}
+    if books:
+        click.echo(
+            _table(
+                [
+                    [name, f"{b['records']}", b["digest"][:16]]
+                    for name, b in sorted(books.items())
+                ],
+                ["book", "records", "digest"],
+            )
+        )
+    faults = res.get("faults") or {}
+    if faults.get("armed") or faults.get("fired"):
+        click.echo(f"# faults armed={faults['armed']} fired={faults['fired']}")
+
+
+@persist.command("compact")
+@click.option("--force", is_flag=True, help="compact even an empty journal")
+@click.pass_context
+def persist_compact(ctx, force):
+    """Force a snapshot+journal-reset compaction now."""
+    res = _run(ctx, "persist_control", {"op": "compact", "force": force})
+    click.echo("compacted" if res.get("ok") else "compaction skipped/failed")
+
+
 # --------------------------------------------------------------------- device
 
 

@@ -17,6 +17,7 @@ import os
 from typing import Any, Type, TypeVar
 
 from openr_tpu.common.eventbase import OpenrModule
+from openr_tpu.persist import atomic_write_bytes, move_aside
 from openr_tpu.types.serde import from_jsonable, to_jsonable
 
 log = logging.getLogger(__name__)
@@ -61,9 +62,8 @@ class PersistentStore(OpenrModule):
             # a torn write is impossible (rename is atomic); a truly
             # corrupt file means something else wrote it — move it aside
             # so the next store() can't overwrite hand-recoverable state
-            aside = f"{self.path}.corrupt"
             try:
-                os.replace(self.path, aside)
+                aside = move_aside(self.path)
             except OSError:
                 aside = "<unmovable>"
             log.error(
@@ -116,27 +116,20 @@ class PersistentStore(OpenrModule):
         if self._flush_lock is None:
             self._flush_lock = asyncio.Lock()
         async with self._flush_lock:
-            tmp = f"{self.path}.tmp.{os.getpid()}"
             payload = json.dumps(
                 self._data, separators=(",", ":"), sort_keys=True
-            )
+            ).encode()
             # the file is tiny (identity + allocations); a blocking write via
             # the default executor keeps the event loop clean without aiofiles
 
             def write():
-                d = os.path.dirname(os.path.abspath(self.path))
-                os.makedirs(d, exist_ok=True)
-                with open(tmp, "w") as f:
-                    f.write(payload)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.path)
-                # fsync the directory too: without it the rename itself
-                # can be lost on power failure
-                dfd = os.open(d, os.O_RDONLY)
-                try:
-                    os.fsync(dfd)
-                finally:
-                    os.close(dfd)
+                os.makedirs(
+                    os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True,
+                )
+                # the persist plane's snapshot discipline (fsync-temp →
+                # atomic-rename → fsync-parent-dir) — one durability
+                # implementation for every durable file in the tree
+                atomic_write_bytes(self.path, payload)
 
             await asyncio.get_event_loop().run_in_executor(None, write)

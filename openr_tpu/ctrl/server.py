@@ -148,6 +148,7 @@ class CtrlServer(OpenrModule):
             "get_kvstore_digest", "get_convergence_state",
             "check_fib_oracle", "chaos_set_drop", "set_udp_peer",
             "work_ledger_control", "spark_announce_restart",
+            "get_persist_status", "persist_control",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -906,6 +907,43 @@ class CtrlServer(OpenrModule):
         else:
             return {"ok": False, "error": f"unknown op {op!r}"}
         return {"ok": True, "warm_marked": led.warm_marked}
+
+    async def get_persist_status(self, params: dict) -> dict:
+        """Operational view of the durable-state plane (docs/Persist.md):
+        journal size, records since compaction, last-fsync age, per-book
+        digests and the recovery stats from this boot — the byte-parity
+        token the crash-recovery invariant compares across incarnations
+        (`breeze persist status` renders this)."""
+        if self.node.persist is None:
+            return {"node": self.node.name, "enabled": False}
+        return {
+            "node": self.node.name,
+            "enabled": True,
+            **self.node.persist.status(),
+        }
+
+    async def persist_control(self, params: dict) -> dict:
+        """Drive the persist plane from the harness: arm one-shot disk
+        faults (seeded torn/corrupt/enospc/crash_between_rename/
+        slow_fsync — the chaos machinery's disk seam on a live process),
+        force a compaction, or fsync now. ops: inject | compact | sync."""
+        plane = self.node.persist
+        if plane is None:
+            return {"ok": False, "error": "persistence disabled"}
+        op = params.get("op")
+        if op == "inject":
+            kind = params.get("kind")
+            try:
+                plane.faults.arm(kind, **(params.get("params") or {}))
+            except (ValueError, TypeError) as exc:
+                return {"ok": False, "error": str(exc)}
+        elif op == "compact":
+            return {"ok": plane.compact(force=bool(params.get("force")))}
+        elif op == "sync":
+            plane.sync()
+        else:
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        return {"ok": True, "faults": plane.faults.status()}
 
     async def spark_announce_restart(self, params: dict) -> dict:
         """Graceful-restart announcement (the in-process emulator's

@@ -20,6 +20,11 @@ storms *seeded and replayable*:
   * ``ChaosFibHandler`` — MockFibHandler driven by the plan's seeded
     rate-based failure injection, gated by ``plan.active`` so the
     cluster can quiesce for the invariant check.
+  * ``ChaosPlan.disk_injector`` / the ``disk_fault`` event kind — the
+    durable-storage seam (docs/Persist.md): seeded one-shot journal
+    faults (torn write, corrupt record, ENOSPC) armed in a victim's
+    persist plane right before a hard kill, so the storm also proves
+    warm-boot recovery through damaged journals.
 
 The *schedule* (which link flaps when, who crashes, how the cluster
 partitions) is derived purely from the seed, so it is deterministic.
@@ -74,8 +79,14 @@ class ChaosEvent:
     """One scheduled structural fault, relative to storm start."""
 
     at_s: float
-    kind: str  # fail_link | heal_link | crash | restart | partition | heal_partition
+    kind: str  # fail_link | heal_link | crash | restart | partition | heal_partition | disk_fault
     target: tuple = ()
+
+
+#: storm-safe injected disk faults (persist/faults.py KINDS minus
+#: crash_between_rename — compaction rarely runs inside a short storm
+#: window, so arming it would usually be a silent no-op)
+DISK_FAULT_KINDS = ("torn", "corrupt", "enospc")
 
 
 class ChaosPlan:
@@ -152,6 +163,7 @@ class ChaosPlan:
         n_partitions: int = 0,
         heal_after_s: float = 0.6,
         graceful_crashes: bool | None = True,
+        n_disk_faults: int = 0,
     ) -> tuple[ChaosEvent, ...]:
         """Deterministic storm schedule from the plan's seed: same seed +
         same arguments → the identical event list (see `schedule_hash`).
@@ -163,6 +175,11 @@ class ChaosPlan:
         `graceful_crashes`: True → every crash announces Spark GR,
         False → every crash is hard (hold-timer detection), None →
         seeded 50/50 mix.
+        `n_disk_faults`: crash archetypes with a one-shot disk fault
+        (DISK_FAULT_KINDS, seeded) armed in the victim's persist plane
+        just before a HARD kill — the restart must warm-boot through
+        the damaged journal (docs/Persist.md fault matrix). Targets
+        come from the same without-replacement pool as plain crashes.
         """
         rng = self.rng("schedule")
         links = sorted(tuple(sorted(l)) for l in links)
@@ -179,13 +196,24 @@ class ChaosPlan:
             ev.append(
                 ChaosEvent(round(t + heal_after_s, 4), "heal_link", (a, b))
             )
-        for name in rng.sample(nodes, min(n_crashes, len(nodes))):
+        victims = rng.sample(
+            nodes, min(n_crashes + n_disk_faults, len(nodes))
+        )
+        for i, name in enumerate(victims):
             t = round(rng.uniform(0, horizon), 4)
-            graceful = (
-                rng.random() < 0.5
-                if graceful_crashes is None
-                else graceful_crashes
-            )
+            if i < n_crashes:
+                graceful = (
+                    rng.random() < 0.5
+                    if graceful_crashes is None
+                    else graceful_crashes
+                )
+            else:
+                # disk-fault crash: arm the fault, then kill HARD — a
+                # graceful shutdown would fsync/close around the damage
+                kind = DISK_FAULT_KINDS[rng.randrange(len(DISK_FAULT_KINDS))]
+                ev.append(ChaosEvent(t, "disk_fault", (name, kind)))
+                t = round(t + 0.05, 4)
+                graceful = False
             ev.append(ChaosEvent(t, "crash", (name, graceful)))
             ev.append(
                 ChaosEvent(round(t + heal_after_s, 4), "restart", (name,))
@@ -235,6 +263,18 @@ class ChaosPlan:
     def faults_for_link(self, a_node: str, b_node: str) -> LinkFaults:
         return self.link_overrides.get(
             frozenset((a_node, b_node)), self.link_faults
+        )
+
+    def disk_injector(self, node_name: str):
+        """Seeded per-node DiskFaultInjector (persist/faults.py) wired
+        into this plan's stats — the durable-storage seam's equivalent
+        of ChaosFibHandler: fault offsets/bit positions come from the
+        ``disk/<node>`` substream and every fired fault lands in
+        ``plan.stats`` as ``disk.<kind>``."""
+        from openr_tpu.persist.faults import DiskFaultInjector
+
+        return DiskFaultInjector(
+            rng=self.rng(f"disk/{node_name}"), note=self.note
         )
 
 
@@ -462,6 +502,14 @@ async def _dispatch(cluster, ev: ChaosEvent) -> None:
         await _maybe_await(cluster.partition(ev.target))
     elif ev.kind == "heal_partition":
         await _maybe_await(cluster.heal_partition())
+    elif ev.kind == "disk_fault":
+        name, kind = ev.target
+        inject = getattr(cluster, "inject_disk_fault", None)
+        # only the multi-process harness has a persist plane to damage;
+        # the in-process emulator skips the arming (the paired hard
+        # crash still fires)
+        if inject is not None and name in cluster.nodes:
+            await _maybe_await(inject(name, kind))
     else:
         raise ValueError(f"unknown chaos event kind {ev.kind!r}")
 

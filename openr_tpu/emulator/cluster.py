@@ -452,6 +452,7 @@ class Cluster:
         n_crashes: int = 0,
         n_partitions: int = 0,
         heal_after_s: float = 0.6,
+        n_disk_faults: int = 0,
     ):
         """Flap-storm generator: build this cluster's deterministic
         fault schedule on `plan` (a ChaosPlan) from its own link/node
@@ -464,4 +465,5 @@ class Cluster:
             n_crashes=n_crashes,
             n_partitions=n_partitions,
             heal_after_s=heal_after_s,
+            n_disk_faults=n_disk_faults,
         )

@@ -3,7 +3,8 @@
 The in-process checker (emulator/invariants.py) reaches into live
 OpenrNode objects; a ProcCluster's nodes are separate interpreters, so
 every probe here crosses the ctrl RPC boundary instead — the same six
-invariant classes, answered by the harness observation endpoints:
+invariant classes, answered by the harness observation endpoints, plus
+a seventh only a real process crash can exercise:
 
   1. **KvStore consistency** — ``get_kvstore_digest`` from every live
      process; per-area key/(version, originator, hash) sets must be
@@ -25,6 +26,12 @@ invariant classes, answered by the harness observation endpoints:
      per-PROCESS here (not one shared registry as in-proc), so each
      node is warmed and audited individually; a breach names the node
      it happened in.
+  7. **Crash-consistent recovery** — ``get_persist_status``: a
+     SIGKILLed-and-restarted node's boot-time recovery digests must be
+     byte-identical to the pre-crash snapshot (snapshot_persist /
+     check_persist_recovery), and no survivor may observe a
+     withdrawal window across the cycle. Opt-in per crash (the other
+     six are fleet sweeps; this one needs a before/after pair).
 
 On failure the checker gathers flight-recorder rings from every
 *surviving* process over ctrl (``get_flight_recorder`` — a SIGKILLed
@@ -331,6 +338,101 @@ async def check_work_ratios(cluster) -> list[Violation]:
                     "stage",
                 )
             )
+    return out
+
+
+# ------------------------------------------- 7. crash-consistent recovery
+
+
+#: survivor counters that tick iff a peer's keys expired / an adjacency
+#: dropped — the observables of a "withdrawal window" during a crash
+_WITHDRAWAL_COUNTERS = ("kvstore.expired_keys", "linkmonitor.neighbor_down")
+
+
+async def snapshot_persist(cluster, victim: str) -> dict:
+    """Pre-crash snapshot for the persistence invariant. Call at
+    quiescence, BEFORE arming any disk fault: captures the victim's
+    durable book digests (the byte-parity token) and every survivor's
+    withdrawal-window counters. The contract with
+    :func:`check_persist_recovery` is that mutations between this
+    snapshot and the SIGKILL are the doomed, fault-eaten ones — so the
+    restarted incarnation must recover *exactly* this state."""
+    status = await cluster.get_persist_status(victim)
+    if not status.get("enabled"):
+        raise RuntimeError(f"persistence disabled on {victim}")
+    books = {
+        name: b["digest"]
+        for name, b in (status.get("books") or {}).items()
+        if b["records"]
+    }
+    watch: dict[str, dict[str, float]] = {}
+    for name in sorted(cluster.nodes):
+        if name == victim:
+            continue
+        c, _bad = await _probe(cluster, name, "get_counters")
+        if c is not None:
+            watch[name] = {k: c.get(k, 0) for k in _WITHDRAWAL_COUNTERS}
+    return {"victim": victim, "books": books, "watch": watch}
+
+
+async def check_persist_recovery(cluster, pre: dict) -> list[Violation]:
+    """Post-restart half of the crash-recovery invariant:
+
+    * **byte parity** — the restarted process's boot-time recovery
+      digests (what actually came off disk, per book) equal the
+      pre-crash snapshot's, even with torn/corrupt/ENOSPC faults armed
+      in between (the doomed records must be discarded, never
+      half-applied);
+    * **zero withdrawal window** — no survivor saw the victim's keys
+      expire or the adjacency drop across the whole crash+restart cycle
+      (graceful-restart hold + warm boot keep the fleet's view intact).
+    """
+    out: list[Violation] = []
+    victim = pre["victim"]
+    status, bad = await _probe(cluster, victim, "get_persist_status")
+    if bad:
+        return [bad]
+    rec_books: dict[str, str] = (status.get("recovery") or {}).get(
+        "books"
+    ) or {}
+    for name, digest in sorted(pre["books"].items()):
+        got = rec_books.get(name)
+        if got is None:
+            out.append(
+                Violation(
+                    "persist.book_lost",
+                    victim,
+                    f"book {name!r} ({digest[:12]}…) not recovered from "
+                    "disk",
+                )
+            )
+        elif got != digest:
+            out.append(
+                Violation(
+                    "persist.parity",
+                    victim,
+                    f"book {name!r} recovered {got[:12]}… != pre-crash "
+                    f"{digest[:12]}… — the journal replayed different "
+                    "bytes than the crashed incarnation held durable",
+                )
+            )
+    for name, base in sorted(pre["watch"].items()):
+        c, bad = await _probe(cluster, name, "get_counters")
+        if bad:
+            out.append(bad)
+            continue
+        for key, was in base.items():
+            now = c.get(key, 0)
+            if now > was:
+                out.append(
+                    Violation(
+                        "persist.withdrawal_window",
+                        name,
+                        f"{key} rose {was:g} → {now:g} across the "
+                        f"crash/restart of {victim} — a survivor "
+                        "observed a withdrawal window",
+                    )
+                )
     return out
 
 

@@ -81,6 +81,10 @@ class ProcNode:
     ready: dict = field(default_factory=dict)  # the handshake payload
     ctrl: RpcClient | None = None
     interfaces: dict[str, str] = field(default_factory=dict)  # if -> peer
+    #: journal directory (docs/Persist.md); survives crash/restart so a
+    #: re-exec is a WARM boot — originated keys, redistribution books
+    #: and the programmed FIB come back from disk, not from peers
+    persist_dir: str | None = None
 
     @property
     def alive(self) -> bool:
@@ -121,6 +125,8 @@ class ProcCluster:
         prefixes_per_node: int = 0,
         host: str = "127.0.0.1",
         spark_scale_cap: float = 20.0,
+        persist: bool = True,
+        spark_overrides: dict | None = None,
     ):
         self.links = links
         self.workdir = workdir
@@ -166,6 +172,11 @@ class ProcCluster:
                 base.graceful_restart_time_ms * factor
             ),
         )
+        if spark_overrides:
+            # crash-recovery tests pin hold/GR above the worst re-exec
+            # time: a warm boot is only "hitless" if the survivors'
+            # hold timers outlive the victim's restart window
+            spark_cfg = replace(spark_cfg, **spark_overrides)
         self.spark_factor = round(factor, 2)
         debounce = (10, max(60, int(60 * factor)))
         for i, name in enumerate(names):
@@ -223,6 +234,13 @@ class ProcCluster:
                 log_path=os.path.join(workdir, f"{name}.log"),
                 ready_path=os.path.join(workdir, f"{name}.ready.json"),
                 interfaces=ifaces,
+                # persistence on by default: a ProcCluster restart is a
+                # warm boot, which is what the crash-recovery invariants
+                # (proc_invariants.persist_parity) exercise
+                persist_dir=(
+                    os.path.join(workdir, f"{name}.persist")
+                    if persist else None
+                ),
             )
 
     @staticmethod
@@ -268,6 +286,10 @@ class ProcCluster:
                 "--config", pn.config_path,
                 "--ready-file", pn.ready_path,
                 "--log-level", "WARNING",
+                *(
+                    ["--persist-dir", pn.persist_dir]
+                    if pn.persist_dir else []
+                ),
             ],
             stdout=logf,
             stderr=subprocess.STDOUT,
@@ -445,6 +467,24 @@ class ProcCluster:
                 continue
         return aggregate_counters(snaps, prefix=prefix)
 
+    # ------------------------------------------------------------- persist
+
+    async def get_persist_status(self, name: str) -> dict:
+        """Journal health + per-book digests over ctrl — the byte-parity
+        token proc_invariants.persist_parity snapshots BEFORE a crash
+        and compares against the restarted incarnation's recovery."""
+        return await self.call(name, "get_persist_status")
+
+    async def inject_disk_fault(self, name: str, kind: str, **params):
+        """Arm a one-shot disk fault (torn / corrupt / enospc /
+        crash_between_rename / slow_fsync) in the target PROCESS's
+        persist plane — the chaos machinery's durable-storage seam.
+        The fault fires at the next matching journal edge."""
+        return await self.call(
+            name, "persist_control",
+            {"op": "inject", "kind": kind, "params": params},
+        )
+
     # -------------------------------------------------------------- control
 
     def _links_between(self, a: str, b: str) -> list[LinkSpec]:
@@ -580,10 +620,13 @@ class ProcCluster:
         n_crashes: int = 0,
         n_partitions: int = 0,
         heal_after_s: float = 0.6,
+        n_disk_faults: int = 0,
     ):
         """Deterministic fault schedule over this cluster's real link/
         node sets — same generator as the in-process emulator, so a
-        seed replays identically on either harness."""
+        seed replays identically on either harness. Disk-fault crashes
+        (`n_disk_faults`) only bite here: the armed journal fault lands
+        in a real process whose restart warm-boots through the damage."""
         return plan.build_storm(
             [(ls.a, ls.b) for ls in self.links],
             sorted(set(self.nodes) | set(self.crashed)),
@@ -592,4 +635,5 @@ class ProcCluster:
             n_crashes=n_crashes,
             n_partitions=n_partitions,
             heal_after_s=heal_after_s,
+            n_disk_faults=n_disk_faults,
         )

@@ -60,6 +60,9 @@ class SoakConfig:
     n_flaps: int = 3
     n_crashes: int = 1
     n_partitions: int = 0
+    #: disk-fault crash archetypes per round (multi-process soaks only:
+    #: the in-process cluster has no persist plane to damage)
+    n_disk_faults: int = 0
     heal_after_s: float = 0.6
     # rate faults active during each storm
     link_faults: LinkFaults = field(
@@ -287,6 +290,7 @@ async def run_soak(cfg: SoakConfig) -> SoakReport:
                 n_crashes=cfg.n_crashes,
                 n_partitions=cfg.n_partitions,
                 heal_after_s=cfg.heal_after_s,
+                n_disk_faults=cfg.n_disk_faults,
             )
             context = (
                 f"soak seed={cfg.seed} round={rnd} "

@@ -28,8 +28,17 @@ from openr_tpu.types.routes import (
     RouteUpdate,
     RouteUpdateType,
 )
+from openr_tpu.types.serde import WireDecodeError, from_wire_bin, to_wire_bin
 
 log = logging.getLogger(__name__)
+
+
+def _fib_ukey(p: IpPrefix) -> bytes:
+    return b"u:" + p.prefix.encode()
+
+
+def _fib_mkey(label: int) -> bytes:
+    return b"m:%d" % label
 
 
 class FibService(Protocol):
@@ -184,6 +193,7 @@ class Fib(OpenrModule):
     # traces awaiting a successful program: bounded like Decision's
     # pending list so a storm can't grow it between retries
     PERF_PENDING_CAP = 64
+    BOOK = "fib"  # durable programmed-table book name
 
     def __init__(
         self,
@@ -193,10 +203,18 @@ class Fib(OpenrModule):
         fib_updates_queue: ReplicateQueue | None = None,
         perf_events_queue: ReplicateQueue | None = None,
         counters=None,
+        persist=None,
     ):
         super().__init__(f"{config.node_name}.fib", counters=counters)
         self.config = config
         self.handler = fib_handler
+        # durable programmed-table book (docs/Persist.md): control-plane
+        # form of programmed_*, journaled at the program edges; the
+        # warm-boot merge upgrades the kernel dump's routes back to
+        # their full control-plane identity when the dataplane
+        # projections agree
+        self.persist = persist
+        self._persist_warm_keys: tuple[set, set] | None = None
         self.reader = route_updates_reader
         self.fib_updates = fib_updates_queue
         self.perf_queue = perf_events_queue
@@ -272,8 +290,33 @@ class Fib(OpenrModule):
             return
         if not u and not m:
             return
-        self.programmed_unicast = {r.dest: r for r in u}
-        self.programmed_mpls = {r.top_label: r for r in m}
+        # dataplane truth is the dump; the durable book restores the
+        # control-plane identity of every route whose dataplane
+        # projection survived unchanged (book-only routes are routes
+        # the kernel lost — not adopted; dump-only routes are adopted
+        # in dump form and reconciled by the one-shot delta below)
+        durable_u, durable_m = self._load_durable_routes()
+        self.programmed_unicast = {}
+        for r in u:
+            dr = durable_u.get(r.dest)
+            keep = dr is not None and (
+                _dataplane_key_unicast(dr) == _dataplane_key_unicast(r)
+            )
+            self.programmed_unicast[r.dest] = dr if keep else r
+        self.programmed_mpls = {}
+        for r in m:
+            dr = durable_m.get(r.top_label)
+            keep = dr is not None and (
+                _dataplane_key_mpls(dr) == _dataplane_key_mpls(r)
+            )
+            self.programmed_mpls[r.top_label] = dr if keep else r
+        if self.persist is not None:
+            # the `persist_replay` ledger delta baseline: what actually
+            # survived, in dataplane-projection form
+            self._persist_warm_keys = (
+                {_dataplane_key_unicast(r) for r in self.programmed_unicast.values()},  # orlint: disable=OR012,OR013 — one-shot warm-boot baseline, ledgered by persist_replay
+                {_dataplane_key_mpls(r) for r in self.programmed_mpls.values()},  # orlint: disable=OR012,OR013 — one-shot warm-boot baseline, ledgered by persist_replay
+            )
         self._warm_booted = True
         self._need_full_sync = False  # first program = incremental delta
         if self.counters:
@@ -282,6 +325,45 @@ class Fib(OpenrModule):
             "%s: warm boot adopted %d unicast / %d mpls routes",
             self.name, len(u), len(m),
         )
+
+    def _load_durable_routes(
+        self,
+    ) -> tuple[dict[IpPrefix, UnicastRoute], dict[int, MplsRoute]]:
+        """Decode the durable programmed-table book; undecodable
+        records (schema drift) are dropped loudly, never adopted."""
+        durable_u: dict[IpPrefix, UnicastRoute] = {}
+        durable_m: dict[int, MplsRoute] = {}
+        if self.persist is None:
+            return durable_u, durable_m
+        for kb, vb in list(self.persist.book(self.BOOK).items()):
+            try:
+                if kb.startswith(b"u:"):
+                    r = from_wire_bin(vb, UnicastRoute)
+                    durable_u[r.dest] = r
+                elif kb.startswith(b"m:"):
+                    r = from_wire_bin(vb, MplsRoute)
+                    durable_m[r.top_label] = r
+            except WireDecodeError as exc:
+                log.warning(
+                    "%s: dropping undecodable durable route: %s",
+                    self.name, exc,
+                )
+                self.persist.erase(self.BOOK, kb)
+        return durable_u, durable_m
+
+    def _persist_replace(self, desired_u, desired_m) -> None:
+        """Full-table program paths: make the durable book equal the
+        just-programmed table (replace_book journals only the diff, so
+        the resync seam stays delta-proportional on disk)."""
+        if self.persist is None:
+            return
+        mapping = {
+            _fib_ukey(p): to_wire_bin(r) for p, r in desired_u.items()
+        }
+        mapping.update(
+            {_fib_mkey(l): to_wire_bin(r) for l, r in desired_m.items()}
+        )
+        self.persist.replace_book(self.BOOK, mapping)
 
     def _mark_full_sync(self) -> None:
         self._need_full_sync = True
@@ -490,6 +572,19 @@ class Fib(OpenrModule):
             self.programmed_mpls[label] = r
         for label in m_del:
             self.programmed_mpls.pop(label, None)
+        if self.persist is not None:
+            # journal AFTER the handler accepted the delta — the book
+            # mirrors programmed state, not intent
+            for p, r in u_add:
+                self.persist.record(self.BOOK, _fib_ukey(p), to_wire_bin(r))
+            for p in u_del:
+                self.persist.erase(self.BOOK, _fib_ukey(p))
+            for label, r in m_add:
+                self.persist.record(
+                    self.BOOK, _fib_mkey(label), to_wire_bin(r)
+                )
+            for label in m_del:
+                self.persist.erase(self.BOOK, _fib_mkey(label))
         if self.counters:
             self.counters.increment(
                 "fib.routes_programmed",
@@ -534,6 +629,7 @@ class Fib(OpenrModule):
         if self.dry_run:
             self.programmed_unicast = desired_u
             self.programmed_mpls = desired_m
+            self._persist_replace(desired_u, desired_m)
             self._publish_programmed(snap_u, snap_m, full=True)
             return
         if self._need_full_sync:
@@ -542,6 +638,7 @@ class Fib(OpenrModule):
             self._need_full_sync = False
             self.programmed_unicast = desired_u
             self.programmed_mpls = desired_m
+            self._persist_replace(desired_u, desired_m)
             if self.counters:
                 self.counters.increment(
                     "fib.routes_programmed", len(desired_u) + len(desired_m)
@@ -585,10 +682,29 @@ class Fib(OpenrModule):
         self._warm_booted = False
         self.programmed_unicast = desired_u
         self.programmed_mpls = desired_m
+        if self._persist_warm_keys is not None:
+            # persist_replay accounting (docs/Persist.md): touched =
+            # what the boot reconciliation actually shipped to the
+            # handler; delta = the genuine desired-vs-durable dataplane
+            # difference, derived from the warm-boot adoption baseline
+            # — NOT from the add/del lists, so a regression to a full
+            # boot-time reprogram inflates touched while delta stays
+            # small and the (non-exempt) ledger bound trips.
+            du, dm = self._persist_warm_keys
+            self._persist_warm_keys = None
+            want_u = {_dataplane_key_unicast(r) for r in desired_u.values()}
+            want_m = {_dataplane_key_mpls(r) for r in desired_m.values()}
+            work_ledger.commit(
+                "persist_replay",
+                len(u_add) + len(u_del) + len(m_add) + len(m_del),
+                len(want_u ^ du) + len(want_m ^ dm),
+            )
+        self._persist_replace(desired_u, desired_m)
         if self.counters:
             self.counters.set(
                 "fib.warm_boot_reprogrammed", len(u_add) + len(m_add)
             )
+            work_ledger.export_to(self.counters)
         self._publish_programmed(snap_u, snap_m, full=True)
 
     def _complete_traces(self, n_covered: int) -> None:

@@ -16,12 +16,14 @@ from openr_tpu.common.eventbase import OpenrModule
 from openr_tpu.kvstore.kvstore import KvStore
 from openr_tpu.messaging import QueueClosedError, RQueue
 from openr_tpu.types.kvstore import TTL_INFINITY, Publication, Value
+from openr_tpu.types.serde import WireDecodeError, from_wire_bin, to_wire_bin
 
 log = logging.getLogger(__name__)
 
 
 class KvStoreClient(OpenrModule):
     SCAN_PERIOD_S = 1.0  # ttl-refresh scan cadence
+    BOOK = "kv_orig"  # durable self-originated-keys book (docs/Persist.md)
 
     def __init__(
         self,
@@ -29,19 +31,50 @@ class KvStoreClient(OpenrModule):
         node_name: str,
         pub_reader: RQueue,
         counters=None,
+        persist=None,
     ):
         super().__init__(f"{node_name}.kvclient", counters=counters)
         self.kvstore = kvstore
         self.node_name = node_name
         self.pub_reader = pub_reader
+        self.persist = persist
         # (area, key) -> (value_bytes, ttl_ms)
         self._persisted: dict[tuple[str, str], tuple[bytes, int]] = {}
 
     async def main(self) -> None:
+        if self.persist is not None:
+            self._recover()
         self.spawn(self._watch_loop(), name=f"{self.name}.watch")
         self.run_every(
             self.SCAN_PERIOD_S, self._refresh_ttls, name=f"{self.name}.ttl"
         )
+
+    def _recover(self) -> None:
+        """Re-originate every durable self-originated key with a fresh
+        TTL — boot depends on our own journal, never on survivors'
+        caches. A surviving higher-version copy of the same content is
+        left to win (same originator, same value → no bump); a
+        diverging copy is contested exactly like any overwrite."""
+        book = self.persist.book(self.BOOK)
+        for kb, vb in list(book.items()):
+            try:
+                area, key = from_wire_bin(kb)
+                value, ttl_ms = from_wire_bin(vb)
+            except (WireDecodeError, ValueError, TypeError) as exc:
+                # CRC-valid but schema-stale: drop loudly, never advertise
+                log.warning(
+                    "%s: dropping undecodable durable key: %s", self.name, exc
+                )
+                self.persist.erase(self.BOOK, kb)
+                continue
+            self._persisted[(area, key)] = (value, int(ttl_ms))
+            self._advertise(area, key)
+        if self._persisted:
+            log.info(
+                "%s: re-originated %d durable keys from persist",
+                self.name,
+                len(self._persisted),
+            )
 
     # ------------------------------------------------------------- persist
 
@@ -61,6 +94,12 @@ class KvStoreClient(OpenrModule):
         `perf_events` rides this write's publication only (self-healing
         re-advertisements are not part of the traced convergence)."""
         self._persisted[(area, key)] = (value, ttl_ms)
+        if self.persist is not None:
+            self.persist.record(
+                self.BOOK,
+                to_wire_bin([area, key]),
+                to_wire_bin([value, ttl_ms]),
+            )
         self._advertise(area, key, perf_events=perf_events)
 
     def unset_key(self, area: str, key: str) -> None:
@@ -68,6 +107,8 @@ class KvStoreClient(OpenrModule):
 
         reference: KvStoreClientInternal::unsetKey/clearKey †."""
         self._persisted.pop((area, key), None)
+        if self.persist is not None:
+            self.persist.erase(self.BOOK, to_wire_bin([area, key]))
 
     def _advertise(self, area: str, key: str, perf_events=None) -> None:
         value, ttl_ms = self._persisted[(area, key)]

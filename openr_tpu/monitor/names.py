@@ -145,6 +145,17 @@ COUNTERS: frozenset[str] = frozenset(
         "monitor.log_samples",
         "monitor.perf_traces",
         "monitor.perf_traces_multi_origin",
+        # persist plane (persist/plane.py; docs/Persist.md): journal
+        # append/compaction accounting + recovery footprint from boot
+        "persist.appends",
+        "persist.append_errors",
+        "persist.journal_bytes",
+        "persist.journal_records",
+        "persist.fsyncs",
+        "persist.compactions",
+        "persist.compact_errors",
+        "persist.recovered_records",
+        "persist.truncated_bytes",
         # everything else
         "configstore.corrupt",
         "configstore.stores",
@@ -241,6 +252,7 @@ DOCUMENTED: frozenset[str] = frozenset(
     | {n for n in COUNTERS if n.startswith("watchdog.")}
     | {n for n in COUNTERS if n.startswith("spark.inbox_")}
     | {n for n in COUNTERS if n.startswith("jax.")}
+    | {n for n in COUNTERS if n.startswith("persist.")}
 )
 
 #: source files exempt from the per-callsite check: the registry's own

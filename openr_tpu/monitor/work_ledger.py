@@ -84,6 +84,13 @@ STAGES: tuple[str, ...] = (
     "fib_resync",
     "redistribute",
     "full_sync",
+    # crash-recovery replay (persist/): boot-time FIB reconciliation
+    # against the recovered durable book — touched is what the handler
+    # reprogrammed, delta the desired-vs-durable dataplane diff, so a
+    # regression to a full-table boot reprogram breaches the bound
+    # (NOT in WORK_EXEMPT_STAGES; ratio gated ≈ 1 by the crash-recovery
+    # smoke lane)
+    "persist_replay",
 )
 
 #: sanitizer default: a steady-state round may touch up to

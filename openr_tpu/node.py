@@ -47,11 +47,25 @@ class OpenrNode:
         enable_ctrl: bool = False,
         ctrl_port: int = 0,
         store_path: str | None = None,
+        persist_dir: str | None = None,
+        persist=None,
         watchdog_abort_fn=None,
     ):
         self.config = config
         self.name = config.node_name
         self.counters = Counters()
+        # crash-consistent durable-state plane (docs/Persist.md): one
+        # journal per node, mounted by KvStoreClient / PrefixManager /
+        # Fib. Callers that need the plane BEFORE the node exists (the
+        # durable mock dataplane in __main__) construct it themselves
+        # and pass `persist`; otherwise `persist_dir` is enough.
+        self.persist = persist
+        if self.persist is None and persist_dir is not None:
+            from openr_tpu.persist import PersistPlane
+
+            self.persist = PersistPlane(persist_dir, counters=self.counters)
+        elif self.persist is not None and self.persist.counters is None:
+            self.persist.counters = self.counters
         # per-node flight recorder (monitor/flight.py): bounded ring of
         # recent structured events, dumped by the emulator's invariant
         # checker on failure and over ctrl on demand. Attached to the
@@ -142,6 +156,7 @@ class OpenrNode:
             self.name,
             self.kvstore_pubs.get_reader(),
             counters=self.counters,
+            persist=self.persist,
         )
         self.decision = Decision(
             config,
@@ -162,6 +177,7 @@ class OpenrNode:
             fib_updates_queue=self.fib_updates,
             perf_events_queue=self.perf_events,
             counters=self.counters,
+            persist=self.persist,
         )
         self.spark = Spark(
             config,
@@ -220,6 +236,7 @@ class OpenrNode:
             ),
             policy=origination_policy,
             counters=self.counters,
+            persist=self.persist,
         )
         self.prefix_allocator = None
         if config.node.prefix_allocation is not None:
@@ -289,6 +306,8 @@ class OpenrNode:
             await m.stop()
         for q in self.queues.values():
             q.close()
+        if self.persist is not None:
+            self.persist.close()
 
     async def wait_initialized(self, timeout: float = 30.0) -> None:
         """Block until the three init gates pass (reference: initialization

@@ -1,0 +1,43 @@
+"""Crash-consistent durable-state plane.
+
+reference: openr/config-store/PersistentStore.cpp † pairs graceful
+restart with disk-backed state so a crashed daemon re-converges from
+its own journal instead of re-learning the world. This package is that
+seam for the whole node: an append-only binary journal + snapshot/
+compaction engine (``journal``), the book-keeping plane modules mount
+their durable state on (``plane``), seeded disk-fault injection
+(``faults``), and a mock dataplane whose tables survive process death
+(``dataplane``). docs/Persist.md is the grammar + recovery contract.
+
+Every byte that must survive a crash goes through this package —
+orlint rule OR014 flags raw ``open(..., "w")`` / ``os.replace`` /
+``json.dump`` persistence seams elsewhere in the tree.
+"""
+
+from openr_tpu.persist.faults import DiskFaultInjector, InjectedCrash
+from openr_tpu.persist.journal import (
+    OP_DEL,
+    OP_SET,
+    Journal,
+    JournalRecord,
+    atomic_write_bytes,
+    encode_record,
+    move_aside,
+    replay_frames,
+)
+from openr_tpu.persist.plane import PersistPlane, book_digest
+
+__all__ = [
+    "DiskFaultInjector",
+    "InjectedCrash",
+    "Journal",
+    "JournalRecord",
+    "OP_DEL",
+    "OP_SET",
+    "PersistPlane",
+    "atomic_write_bytes",
+    "book_digest",
+    "encode_record",
+    "move_aside",
+    "replay_frames",
+]

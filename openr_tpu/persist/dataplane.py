@@ -1,0 +1,105 @@
+"""A mock dataplane whose tables survive process death.
+
+A real kernel FIB outlives the routing daemon — that is what makes
+warm boot meaningful. ProcCluster's nodes program a MockFibHandler
+that dies with the process, so before this module every SIGKILL
+restart was silently a cold boot. :class:`DurableMockFibHandler`
+persists its route tables through the node's :class:`PersistPlane`
+(books ``dp_unicast`` / ``dp_mpls``) and restores them on construction,
+so Fib's warm-boot dump sees exactly what the "kernel" held when the
+previous incarnation died — including under injected disk faults.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from openr_tpu.fib.fib import MockFibHandler
+from openr_tpu.types.network import MplsRoute, UnicastRoute
+from openr_tpu.types.serde import WireDecodeError, from_wire_bin, to_wire_bin
+
+log = logging.getLogger(__name__)
+
+BOOK_UNICAST = "dp_unicast"
+BOOK_MPLS = "dp_mpls"
+
+
+def _ukey(client_id: int, dest) -> bytes:
+    return f"{client_id}/{dest.prefix}".encode()
+
+
+def _mkey(client_id: int, label: int) -> bytes:
+    return f"{client_id}/{label}".encode()
+
+
+class DurableMockFibHandler(MockFibHandler):
+    def __init__(self, plane, **kwargs):
+        super().__init__(**kwargs)
+        self.plane = plane
+        self._restore()
+
+    def _restore(self) -> None:
+        n = 0
+        for key, wire in self.plane.book(BOOK_UNICAST).items():
+            try:
+                client_id = int(key.split(b"/", 1)[0])
+                r = from_wire_bin(wire, UnicastRoute)
+            except (WireDecodeError, ValueError) as exc:
+                log.warning("dataplane: dropping bad unicast record: %s", exc)
+                continue
+            self.unicast.setdefault(client_id, {})[r.dest] = r
+            n += 1
+        for key, wire in self.plane.book(BOOK_MPLS).items():
+            try:
+                client_id = int(key.split(b"/", 1)[0])
+                r = from_wire_bin(wire, MplsRoute)
+            except (WireDecodeError, ValueError) as exc:
+                log.warning("dataplane: dropping bad mpls record: %s", exc)
+                continue
+            self.mpls.setdefault(client_id, {})[r.top_label] = r
+            n += 1
+        if n:
+            log.info("dataplane: restored %d surviving routes", n)
+
+    # mutators journal AFTER the in-memory apply: _fail_maybe fires
+    # inside super(), so an injected FibProgramError never persists
+
+    async def add_unicast_routes(self, client_id, routes):
+        await super().add_unicast_routes(client_id, routes)
+        for r in routes:
+            self.plane.record(
+                BOOK_UNICAST, _ukey(client_id, r.dest), to_wire_bin(r)
+            )
+
+    async def delete_unicast_routes(self, client_id, prefixes):
+        await super().delete_unicast_routes(client_id, prefixes)
+        for p in prefixes:
+            self.plane.erase(BOOK_UNICAST, _ukey(client_id, p))
+
+    async def add_mpls_routes(self, client_id, routes):
+        await super().add_mpls_routes(client_id, routes)
+        for r in routes:
+            self.plane.record(
+                BOOK_MPLS, _mkey(client_id, r.top_label), to_wire_bin(r)
+            )
+
+    async def delete_mpls_routes(self, client_id, labels):
+        await super().delete_mpls_routes(client_id, labels)
+        for label in labels:
+            self.plane.erase(BOOK_MPLS, _mkey(client_id, label))
+
+    async def sync_fib(self, client_id, routes):
+        await super().sync_fib(client_id, routes)
+        self.plane.replace_book(
+            BOOK_UNICAST,
+            {_ukey(client_id, r.dest): to_wire_bin(r) for r in routes},
+            prefix=f"{client_id}/".encode(),
+        )
+
+    async def sync_mpls_fib(self, client_id, routes):
+        await super().sync_mpls_fib(client_id, routes)
+        self.plane.replace_book(
+            BOOK_MPLS,
+            {_mkey(client_id, r.top_label): to_wire_bin(r) for r in routes},
+            prefix=f"{client_id}/".encode(),
+        )

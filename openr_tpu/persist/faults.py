@@ -1,0 +1,103 @@
+"""Seeded disk-fault injection for the persistence plane.
+
+The chaos layer's named-substream pattern (emulator/chaos.py, PR 3)
+extended to the disk seam: faults are **armed** (one-shot) and consumed
+at the journal/snapshot edges, with offsets drawn from an injectable
+RNG so a failing run replays from its seed. Kinds:
+
+=====================  =====================================================
+``torn``               next journal append writes only the first *k* bytes
+                       of the frame (``at`` param, else seeded) and wedges
+                       the journal — the crash-mid-write model; arm it
+                       immediately before delivering SIGKILL
+``corrupt``            next journal append lands with one seeded bit
+                       flipped somewhere in the frame
+``enospc``             next journal append raises ``OSError(ENOSPC)``
+                       before any byte is written
+``crash_between_rename`` next snapshot write stops after the fsynced temp
+                       file, before the atomic rename (raises
+                       :class:`InjectedCrash`) — old snapshot + journal
+                       stay authoritative
+``slow_fsync``         next fsync sleeps ``delay_s`` (default 0.05)
+=====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import time
+
+KINDS = ("torn", "corrupt", "enospc", "crash_between_rename", "slow_fsync")
+
+
+class InjectedCrash(RuntimeError):
+    """A crash-between-rename injection point firing: the snapshot temp
+    is on disk but the rename never happened."""
+
+
+class DiskFaultInjector:
+    """One-shot armed faults consumed at the persist plane's I/O edges."""
+
+    def __init__(self, rng: random.Random | None = None, note=None):
+        self.rng = rng or random.Random(0)
+        self.note = note  # ChaosPlan.note-compatible stats hook
+        self._armed: list[tuple[str, dict]] = []
+        self.fired: dict[str, int] = {}
+
+    def arm(self, kind: str, **params) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"unknown disk fault kind {kind!r}")
+        self._armed.append((kind, params))
+
+    def _take(self, *kinds: str) -> tuple[str, dict] | None:
+        for i, (kind, params) in enumerate(self._armed):
+            if kind in kinds:
+                del self._armed[i]
+                self.fired[kind] = self.fired.get(kind, 0) + 1
+                if self.note is not None:
+                    self.note(f"disk.{kind}")
+                return kind, params
+        return None
+
+    # ------------------------------------------------------------ I/O edges
+
+    def on_append(self, frame: bytes) -> tuple[bytes, int | None]:
+        """Filter one journal frame. Returns ``(bytes_to_write,
+        torn_at)``; ``torn_at`` non-None wedges the journal. Raises
+        ``OSError(ENOSPC)`` for an armed enospc fault."""
+        if self._take("enospc"):
+            raise OSError(errno.ENOSPC, "injected: no space left on device")
+        hit = self._take("torn")
+        if hit:
+            k = hit[1].get("at")
+            if k is None:
+                k = self.rng.randrange(1, max(len(frame), 2))
+            k = max(0, min(int(k), len(frame) - 1))
+            return frame[:k], k
+        hit = self._take("corrupt")
+        if hit:
+            bit = hit[1].get("bit")
+            if bit is None:
+                bit = self.rng.randrange(len(frame) * 8)
+            buf = bytearray(frame)
+            buf[bit // 8] ^= 1 << (bit % 8)
+            return bytes(buf), None
+        return frame, None
+
+    def on_fsync(self) -> None:
+        hit = self._take("slow_fsync")
+        if hit:
+            time.sleep(float(hit[1].get("delay_s", 0.05)))
+
+    def on_rename(self) -> None:
+        if self._take("crash_between_rename"):
+            raise InjectedCrash("injected: crash between rename")
+
+    # --------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        return {
+            "armed": [kind for kind, _ in self._armed],
+            "fired": dict(self.fired),
+        }

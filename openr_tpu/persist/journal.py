@@ -1,0 +1,246 @@
+"""Append-only binary journal: record grammar, replay, atomic snapshots.
+
+One record on disk is::
+
+    uvarint(len(payload)) | payload | crc32(payload) LE32
+
+where ``payload`` is the PR 8 TLV wire form (`to_wire_bin`) of a
+:class:`JournalRecord`. Snapshots reuse the identical grammar — a
+snapshot file is just a compacted journal of OP_SET records — so there
+is exactly one framing to fuzz and one decoder to trust.
+
+Recovery contract (docs/Persist.md):
+
+* a record whose length or body overruns EOF is a **torn tail** — the
+  file is truncated back to the last good record boundary and replay
+  returns what preceded it;
+* a CRC mismatch on the **final** record is the same torn-at-crash
+  case (the trailer never made it out of the page cache) — truncated;
+* a CRC mismatch with further bytes following is **mid-journal
+  corruption** and raises :class:`WireDecodeError` — loud, never
+  silently accepted;
+* a CRC-valid payload that fails TLV decode is a software/schema bug
+  and also raises :class:`WireDecodeError`.
+
+Durability discipline: appends are write+flush (page-cache durable —
+survives SIGKILL), fsync rides an interval or an explicit ``sync()``
+(power-fail durability); snapshots are fsync-temp → atomic-rename →
+fsync-parent-dir via :func:`atomic_write_bytes`.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+
+from openr_tpu.types.serde import (
+    WireDecodeError,
+    from_wire_bin,
+    to_wire_bin,
+    write_uvarint,
+)
+
+#: record operations: idempotent last-wins upsert / delete — replaying
+#: a duplicate or stale record is harmless by construction.
+OP_SET = 0
+OP_DEL = 1
+
+_CRC = struct.Struct("<I")
+
+
+@dataclass
+class JournalRecord:
+    """One durable mutation: (book, op, key) plus the value for SET."""
+
+    book: str
+    op: int
+    key: bytes
+    value: bytes = b""
+
+
+def encode_record(rec: JournalRecord) -> bytes:
+    payload = to_wire_bin(rec)
+    out = bytearray()
+    write_uvarint(out, len(payload))
+    out += payload
+    out += _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF)
+    return bytes(out)
+
+
+class _TornTail(Exception):
+    """Internal: frame overran EOF — not an error, a crash artifact."""
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    n = 0
+    while True:
+        if pos >= len(data):
+            raise _TornTail
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 63:
+            # a runaway continuation chain is garbage, but by the torn
+            # rule below it can only be salvaged when it is the tail
+            raise _TornTail
+
+
+def replay_frames(
+    data: bytes, *, strict: bool = False
+) -> tuple[list[JournalRecord], int]:
+    """Decode a journal/snapshot byte string into records.
+
+    Returns ``(records, truncated_bytes)`` where ``truncated_bytes`` is
+    the torn tail the caller should cut off the file. With ``strict``
+    (snapshots — atomically renamed, so a torn tail is impossible) any
+    salvage condition raises :class:`WireDecodeError` instead.
+    """
+    records: list[JournalRecord] = []
+    pos = 0
+    good_end = 0
+    while pos < len(data):
+        start = pos
+        try:
+            ln, body = _read_uvarint(data, pos)
+            if body + ln + _CRC.size > len(data):
+                raise _TornTail
+        except _TornTail:
+            if strict:
+                raise WireDecodeError(
+                    f"snapshot: frame at offset {start} overruns EOF"
+                ) from None
+            break
+        payload = data[body : body + ln]
+        end = body + ln + _CRC.size
+        if zlib.crc32(payload) & 0xFFFFFFFF != _CRC.unpack_from(data, body + ln)[0]:
+            if end >= len(data) and not strict:
+                break  # trailer torn at crash: salvage the prefix
+            raise WireDecodeError(
+                f"journal: CRC mismatch at offset {start} with "
+                f"{len(data) - end} bytes following — mid-journal corruption"
+            )
+        records.append(from_wire_bin(payload, JournalRecord))
+        pos = good_end = end
+    return records, len(data) - good_end
+
+
+class Journal:
+    """Writer half: append-only file with flush-per-record durability.
+
+    A torn-write fault wedges the journal (the model is a crash mid-
+    write: the process is about to die, nothing after the torn record
+    may reach disk); ENOSPC raises to the caller so in-memory state is
+    only mutated for records that actually landed.
+    """
+
+    def __init__(self, path: str, faults=None):
+        self.path = path
+        self.faults = faults
+        self._f = open(path, "ab")
+        self.size = os.fstat(self._f.fileno()).st_size
+        self.records = 0  # appended since open/compaction
+        self.wedged = False
+        self.last_fsync = time.monotonic()
+
+    def append(self, rec: JournalRecord) -> bool:
+        """Write one record; True when it (fully) reached the OS."""
+        if self.wedged:
+            return False
+        frame = encode_record(rec)
+        torn_at = None
+        if self.faults is not None:
+            frame, torn_at = self.faults.on_append(frame)  # may raise ENOSPC
+        self._f.write(frame)
+        self._f.flush()
+        self.size += len(frame)
+        if torn_at is not None:
+            # crash-mid-write model: the writer believed the append
+            # succeeded; nothing later may reach disk
+            self.wedged = True
+        self.records += 1
+        return True
+
+    def sync(self) -> None:
+        if self.faults is not None:
+            self.faults.on_fsync()
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.last_fsync = time.monotonic()
+
+    def fsync_age_s(self) -> float:
+        return time.monotonic() - self.last_fsync
+
+    def reset(self) -> None:
+        """Truncate to empty (post-compaction: the snapshot now carries
+        everything)."""
+        self._f.truncate(0)
+        self._f.seek(0)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.size = 0
+        self.records = 0
+        self.last_fsync = time.monotonic()
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+
+
+def load_journal(path: str, *, strict: bool = False) -> tuple[list[JournalRecord], int]:
+    """Replay a journal file, truncating any torn tail in place."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], 0
+    records, torn = replay_frames(data, strict=strict)
+    if torn:
+        with open(path, "r+b") as f:
+            f.truncate(len(data) - torn)
+            f.flush()
+            os.fsync(f.fileno())
+    return records, torn
+
+
+def atomic_write_bytes(path: str, data: bytes, faults=None) -> None:
+    """The snapshot discipline: fsync-temp → atomic-rename →
+    fsync-parent-dir. After return the bytes are power-fail durable; a
+    crash at any point leaves either the old file or the new one,
+    never a mix (recovery ignores ``*.tmp.*`` leftovers)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        if faults is not None:
+            faults.on_fsync()
+        os.fsync(f.fileno())
+    if faults is not None:
+        faults.on_rename()  # crash_between_rename raises here
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def move_aside(path: str) -> str:
+    """Park a corrupt durable file next to itself (never delete
+    evidence) and return the new name."""
+    n = 0
+    while True:
+        aside = f"{path}.corrupt" + (f".{n}" if n else "")
+        if not os.path.exists(aside):
+            break
+        n += 1
+    os.replace(path, aside)
+    return aside

@@ -1,0 +1,277 @@
+"""PersistPlane: the node's durable books, mounted on the journal.
+
+A *book* is a named ``dict[bytes, bytes]`` of durable key → value wire
+bytes. Three production books ride one plane per node (docs/Persist.md):
+
+* ``kv_orig``   — KvStoreClient's self-originated keys,
+* ``pfx_entries`` / ``pfx_ranges`` — PrefixManager's redistribution and
+  range books,
+* ``fib``       — the programmed route table in control-plane form,
+
+plus the mock dataplane's ``dp_unicast`` / ``dp_mpls`` (persist/
+dataplane.py). Writers call :meth:`record` / :meth:`erase` at their
+existing single mutation seams; both dedup against the in-memory book,
+so recovery replays and steady-state re-advertisements journal nothing.
+Compaction rewrites the snapshot atomically *first*, then truncates the
+journal — a crash between the two leaves duplicate records, which
+replay absorbs (last-wins).
+
+In-memory state is only mutated for records that actually reached the
+OS (an ENOSPC'd append drops the write and the next divergent
+advertisement retries it), so the books always describe what recovery
+will see — that is what makes the byte-parity invariant
+(emulator/proc_invariants.py) checkable from digests alone.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import struct
+from typing import Mapping
+
+from openr_tpu.persist.faults import DiskFaultInjector, InjectedCrash
+from openr_tpu.persist.journal import (
+    OP_DEL,
+    OP_SET,
+    Journal,
+    JournalRecord,
+    atomic_write_bytes,
+    encode_record,
+    load_journal,
+    replay_frames,
+)
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+
+
+def book_digest(book: Mapping[bytes, bytes]) -> str:
+    """Order-independent content digest of one book — the byte-parity
+    token the crash-recovery invariant compares across incarnations."""
+    h = hashlib.sha256()
+    for k in sorted(book):
+        h.update(_LEN.pack(len(k)))
+        h.update(k)
+        v = book[k]
+        h.update(_LEN.pack(len(v)))
+        h.update(v)
+    return h.hexdigest()
+
+
+class PersistPlane:
+    SNAPSHOT = "snapshot.bin"
+    JOURNAL = "journal.bin"
+
+    def __init__(
+        self,
+        dirpath: str,
+        counters=None,
+        *,
+        compact_every: int = 4096,
+        fsync_interval_s: float = 1.0,
+        faults: DiskFaultInjector | None = None,
+    ):
+        os.makedirs(dirpath, exist_ok=True)
+        self.dir = dirpath
+        self.counters = counters
+        self.compact_every = compact_every
+        self.fsync_interval_s = fsync_interval_s
+        self.faults = faults if faults is not None else DiskFaultInjector()
+        self.books: dict[str, dict[bytes, bytes]] = {}
+        self.compactions = 0
+        self.append_errors = 0
+        self.recovery = self._load()
+        self.journal = Journal(
+            os.path.join(dirpath, self.JOURNAL), faults=self.faults
+        )
+
+    # -------------------------------------------------------------- recovery
+
+    def _load(self) -> dict:
+        """Snapshot (strict — it was atomically renamed) then journal
+        (torn tail truncated in place); both through the one record
+        grammar. Mid-journal corruption propagates WireDecodeError."""
+        snap_path = os.path.join(self.dir, self.SNAPSHOT)
+        snap_records = 0
+        try:
+            with open(snap_path, "rb") as f:
+                frames, _ = replay_frames(f.read(), strict=True)
+            for rec in frames:
+                self._apply(rec)
+            snap_records = len(frames)
+        except FileNotFoundError:
+            pass
+        journal_records, torn = load_journal(
+            os.path.join(self.dir, self.JOURNAL)
+        )
+        for rec in journal_records:
+            self._apply(rec)
+        if self.counters is not None:
+            self.counters.set(
+                "persist.recovered_records",
+                snap_records + len(journal_records),
+            )
+            self.counters.set("persist.truncated_bytes", torn)
+        return {
+            "snapshot_records": snap_records,
+            "journal_records": len(journal_records),
+            "truncated_bytes": torn,
+            "books": {
+                name: book_digest(book) for name, book in self.books.items()
+            },
+        }
+
+    def _apply(self, rec: JournalRecord) -> None:
+        book = self.books.setdefault(rec.book, {})
+        if rec.op == OP_SET:
+            book[rec.key] = rec.value
+        else:
+            book.pop(rec.key, None)
+
+    # --------------------------------------------------------------- writes
+
+    def book(self, name: str) -> dict[bytes, bytes]:
+        """Live view of one book (treat as read-only; mutate via
+        record/erase so disk stays in lockstep)."""
+        return self.books.setdefault(name, {})
+
+    def record(self, name: str, key: bytes, value: bytes) -> bool:
+        """Durable upsert; False = no-op (dedup) or append failure."""
+        book = self.books.setdefault(name, {})
+        if book.get(key) == value:
+            return False
+        if not self._append(JournalRecord(name, OP_SET, key, value)):
+            return False
+        book[key] = value
+        self._maybe_compact()
+        return True
+
+    def erase(self, name: str, key: bytes) -> bool:
+        book = self.books.setdefault(name, {})
+        if key not in book:
+            return False
+        if not self._append(JournalRecord(name, OP_DEL, key)):
+            return False
+        del book[key]
+        self._maybe_compact()
+        return True
+
+    def replace_book(
+        self, name: str, mapping: Mapping[bytes, bytes], prefix: bytes = b""
+    ) -> int:
+        """Make (the ``prefix`` slice of) a book equal ``mapping``,
+        journaling only the difference — the full-sync seams stay
+        delta-proportional on disk."""
+        book = self.books.setdefault(name, {})
+        stale = [
+            k for k in book if k.startswith(prefix) and k not in mapping
+        ]
+        ops = 0
+        for k in stale:
+            ops += self.erase(name, k)
+        for k, v in mapping.items():
+            ops += self.record(name, k, v)
+        return ops
+
+    def _append(self, rec: JournalRecord) -> bool:
+        try:
+            ok = self.journal.append(rec)
+        except OSError as exc:
+            self.append_errors += 1
+            if self.counters is not None:
+                self.counters.increment("persist.append_errors")
+            log.warning("persist: journal append failed: %s", exc)
+            return False
+        if not ok:  # wedged post-torn: the process is as good as dead
+            self.append_errors += 1
+            if self.counters is not None:
+                self.counters.increment("persist.append_errors")
+            return True  # crash-mid-write model: writer believes it landed
+        if self.counters is not None:
+            self.counters.increment("persist.appends")
+            self.counters.set("persist.journal_bytes", self.journal.size)
+            self.counters.set("persist.journal_records", self.journal.records)
+        return True
+
+    def _maybe_compact(self) -> None:
+        """Runs AFTER the in-memory apply — the snapshot must contain
+        the record whose journal entry the reset is about to drop."""
+        if self.journal.wedged:
+            return
+        if self.journal.records >= self.compact_every:
+            self.compact()
+        elif self.journal.fsync_age_s() >= self.fsync_interval_s:
+            self.sync()
+
+    def sync(self) -> None:
+        """Power-fail durability point (page-cache flush already makes
+        every append SIGKILL-durable)."""
+        if self.journal.wedged:
+            return
+        self.journal.sync()
+        if self.counters is not None:
+            self.counters.increment("persist.fsyncs")
+
+    # ----------------------------------------------------------- compaction
+
+    def compact(self, force: bool = False) -> bool:
+        """Snapshot-then-truncate. Crash after the rename but before the
+        truncate only leaves duplicate records for replay to absorb."""
+        if self.journal.wedged and not force:
+            return False
+        out = bytearray()
+        for name in sorted(self.books):
+            for key in sorted(self.books[name]):
+                out += encode_record(
+                    JournalRecord(name, OP_SET, key, self.books[name][key])
+                )
+        try:
+            atomic_write_bytes(
+                os.path.join(self.dir, self.SNAPSHOT),
+                bytes(out),
+                faults=self.faults,
+            )
+        except (OSError, InjectedCrash) as exc:
+            if self.counters is not None:
+                self.counters.increment("persist.compact_errors")
+            log.warning("persist: compaction aborted: %s", exc)
+            return False
+        self.journal.reset()
+        self.compactions += 1
+        if self.counters is not None:
+            self.counters.increment("persist.compactions")
+            self.counters.set("persist.journal_bytes", 0)
+            self.counters.set("persist.journal_records", 0)
+        return True
+
+    # --------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        """JSON-able operational view (ctrl ``get_persist_status`` /
+        ``breeze persist status``)."""
+        return {
+            "dir": self.dir,
+            "journal_bytes": self.journal.size,
+            "journal_records": self.journal.records,
+            "last_fsync_age_s": round(self.journal.fsync_age_s(), 3),
+            "wedged": self.journal.wedged,
+            "compactions": self.compactions,
+            "append_errors": self.append_errors,
+            "books": {
+                name: {"records": len(book), "digest": book_digest(book)}
+                for name, book in sorted(self.books.items())
+            },
+            "recovery": self.recovery,
+            "faults": self.faults.status(),
+        }
+
+    def close(self) -> None:
+        if not self.journal.wedged:
+            try:
+                self.sync()
+            except OSError:  # pragma: no cover — best-effort on shutdown
+                pass
+        self.journal.close()

@@ -25,10 +25,23 @@ from openr_tpu.messaging import QueueClosedError, RQueue
 from openr_tpu.monitor import work_ledger
 from openr_tpu.types.network import IpPrefix
 from openr_tpu.types.routes import RouteUpdate, RouteUpdateType
-from openr_tpu.types.serde import to_wire
+from openr_tpu.types.serde import (
+    WireDecodeError,
+    from_wire_bin,
+    to_wire,
+    to_wire_bin,
+)
 from openr_tpu.types.topology import PrefixDatabase, PrefixEntry
 
 log = logging.getLogger(__name__)
+
+
+def _entry_book_key(source: "PrefixSource", prefix) -> bytes:
+    return to_wire_bin([int(source), prefix.prefix])
+
+
+def _range_book_key(source: "PrefixSource", rkey: tuple) -> bytes:
+    return to_wire_bin([int(source), list(rkey)])
 
 
 class PrefixSource(enum.IntEnum):
@@ -79,6 +92,12 @@ class _Origination:
 
 
 class PrefixManager(OpenrModule):
+    #: durable books (docs/Persist.md): the redistribution/entry book
+    #: and the range-origination book, journaled at their single
+    #: mutation seams so a crashed node re-originates from its own disk
+    ENTRY_BOOK = "pfx_entries"
+    RANGE_BOOK = "pfx_ranges"
+
     def __init__(
         self,
         config: Config,
@@ -88,6 +107,7 @@ class PrefixManager(OpenrModule):
         route_updates_reader: RQueue | None = None,
         policy=None,  # openr_tpu.policy.PolicyManager (origination policy)
         counters=None,
+        persist=None,
     ):
         super().__init__(f"{config.node_name}.prefixmgr", counters=counters)
         self.policy = policy
@@ -135,6 +155,64 @@ class PrefixManager(OpenrModule):
             _Origination(cfg=op) for op in config.node.originated_prefixes
         ]
         self.ttl_ms = config.node.kvstore.key_ttl_ms
+        self.persist = persist
+        if persist is not None:
+            self._recover()
+
+    def _recover(self) -> None:
+        """Rebuild the entry + range books from the durable plane.
+
+        _entry_set re-derives every incremental book (_best,
+        _owned_count, _by_source) and dirties the prefixes, so main()'s
+        first _sync_advertisements re-originates everything with fresh
+        TTLs — no dependence on survivors' caches. Plane-side dedup
+        makes the replayed record() calls no-ops on disk. Entries that
+        became stale while we were down are withdrawn by the same
+        machinery that retires them live: the first RIB FULL_SYNC
+        purges the RIB slice, and the FIB-gating loop withdraws CONFIG
+        originations whose supporting routes never return."""
+        from openr_tpu.prefixmgr.ranges import PrefixRange
+
+        n = 0
+        for kb, vb in list(self.persist.book(self.ENTRY_BOOK).items()):
+            try:
+                src_i, _pfx = from_wire_bin(kb)
+                entry_wire, areas = from_wire_bin(vb)
+                entry = from_wire_bin(entry_wire, PrefixEntry)
+                source = PrefixSource(src_i)
+            except (WireDecodeError, ValueError, TypeError) as exc:
+                log.warning(
+                    "%s: dropping undecodable entry record: %s",
+                    self.name, exc,
+                )
+                self.persist.erase(self.ENTRY_BOOK, kb)
+                continue
+            self._entry_set(source, entry.prefix, entry, tuple(areas))
+            n += 1
+        for kb, vb in list(self.persist.book(self.RANGE_BOOK).items()):
+            try:
+                src_i, _rk = from_wire_bin(kb)
+                rng_wire, areas = from_wire_bin(vb)
+                rng = from_wire_bin(rng_wire, PrefixRange)
+                source = PrefixSource(src_i)
+            except (WireDecodeError, ValueError, TypeError) as exc:
+                log.warning(
+                    "%s: dropping undecodable range record: %s",
+                    self.name, exc,
+                )
+                self.persist.erase(self.RANGE_BOOK, kb)
+                continue
+            self._range_entries[(source, rng.key())] = (rng, tuple(areas))
+            n += 1
+        # recovered CONFIG originations must stay withdrawable by the
+        # FIB-gating loop (advertised=False would strand the tombstone)
+        for orig in self._originations:
+            if (PrefixSource.CONFIG, orig.prefix) in self._entries:
+                orig.advertised = True
+        if n:
+            log.info(
+                "%s: recovered %d durable prefix records", self.name, n
+            )
 
     async def main(self) -> None:
         if self.events_reader is not None:
@@ -171,18 +249,18 @@ class PrefixManager(OpenrModule):
             # policy engine is exactly what range origination avoids —
             # operators policy the template before handing it over
             for r in ev.ranges:
-                self._range_entries[(ev.source, r.key())] = (r, ev.dest_areas)
+                self._range_set(ev.source, r, ev.dest_areas)
         elif ev.type == PrefixEventType.WITHDRAW_PREFIXES:
             for e in ev.entries:
                 self._entry_del(ev.source, e.prefix)
             for r in ev.ranges:
-                self._range_entries.pop((ev.source, r.key()), None)
+                self._range_del(ev.source, r.key())
         elif ev.type == PrefixEventType.WITHDRAW_SOURCE:
             # O(dropped) via the per-source book — no full-table sweep
             for p in list(self._by_source.get(ev.source, ())):
                 self._entry_del(ev.source, p)
             for key in [k for k in self._range_entries if k[0] == ev.source]:
-                del self._range_entries[key]
+                self._range_del(key[0], key[1])
         self._sync_advertisements()
         if self.counters:
             self.counters.increment("prefixmgr.events")
@@ -204,6 +282,12 @@ class PrefixManager(OpenrModule):
         if prev is not None and prev[0] == entry and prev[1] == areas:
             return  # steady re-fold: nothing moved, nothing dirtied
         self._entries[key] = (entry, areas)
+        if self.persist is not None:
+            self.persist.record(
+                self.ENTRY_BOOK,
+                _entry_book_key(source, prefix),
+                to_wire_bin([to_wire_bin(entry), list(areas)]),
+            )
         if prev is None:
             self._by_source.setdefault(source, set()).add(prefix)
             if source != PrefixSource.RIB:
@@ -224,6 +308,10 @@ class PrefixManager(OpenrModule):
         key = (source, prefix)
         if self._entries.pop(key, None) is None:
             return
+        if self.persist is not None:
+            self.persist.erase(
+                self.ENTRY_BOOK, _entry_book_key(source, prefix)
+            )
         srcs = self._by_source.get(source)
         if srcs is not None:
             srcs.discard(prefix)
@@ -244,6 +332,23 @@ class PrefixManager(OpenrModule):
         else:
             del self._best[prefix]
         self._dirty_adv.add(prefix)
+
+    def _range_set(self, source: PrefixSource, r, areas) -> None:
+        self._range_entries[(source, r.key())] = (r, areas)
+        if self.persist is not None:
+            self.persist.record(
+                self.RANGE_BOOK,
+                _range_book_key(source, r.key()),
+                to_wire_bin([to_wire_bin(r), list(areas)]),
+            )
+
+    def _range_del(self, source: PrefixSource, rkey: tuple) -> None:
+        if self._range_entries.pop((source, rkey), None) is None:
+            return
+        if self.persist is not None:
+            self.persist.erase(
+                self.RANGE_BOOK, _range_book_key(source, rkey)
+            )
 
     # ---------------------------------------------------------- fib gating
 

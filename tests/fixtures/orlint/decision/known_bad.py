@@ -7,13 +7,14 @@ OR006 determinism) apply; the engine's directory walker skips
 explicit argument (``python -m tools.orlint
 tests/fixtures/orlint/decision/known_bad.py``).
 
-EXPECTED: exactly one finding per rule, OR001..OR013 (asserted by
+EXPECTED: exactly one finding per rule, OR001..OR014 (asserted by
 tests/test_orlint.py::test_known_bad_fixture_covers_every_rule and the
 ci.sh smoke lane).
 """
 
 import asyncio
 import json
+import os
 import random
 import time
 
@@ -39,6 +40,8 @@ class Bad:
                 pass
         for _k in self._entries:  # OR013: unscoped full-table walk
             pass
+        # OR014: rename-into-place durability hand-rolled outside persist/
+        os.replace("state.json.tmp", "state.json")
         return json.dumps({"pub": 1})  # OR011: text frame on a wire seam
 
     async def helper(self):

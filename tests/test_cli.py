@@ -419,3 +419,95 @@ def test_device_kernels_table(live):
 def test_device_hbm_degraded_on_cpu(live):
     out = invoke(live, "a", "device", "hbm")
     assert "unavailable" in out
+
+
+def test_persist_status_disabled(live):
+    """In-process emulator nodes run without a journal; the CLI must
+    say so instead of rendering an empty table."""
+    out = invoke(live, "a", "persist", "status")
+    assert "persistence disabled" in out
+
+
+@pytest.fixture()
+def persist_node(tmp_path):
+    """One standalone node with a live journal + ctrl, on its own loop
+    thread (same pattern as ClusterThread, minus the fleet)."""
+    from openr_tpu.config import Config, NodeConfig, OriginatedPrefix
+    from openr_tpu.kvstore import InProcKvTransport
+    from openr_tpu.node import OpenrNode
+    from openr_tpu.spark import MockIoHub
+
+    holder = {}
+    ready = threading.Event()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            ncfg = NodeConfig(
+                node_name="pnode",
+                originated_prefixes=(
+                    OriginatedPrefix(prefix="10.99.7.1/32"),
+                ),
+            )
+            node = OpenrNode(
+                Config(ncfg),
+                MockIoHub().io_for("pnode"),
+                InProcKvTransport(),
+                enable_ctrl=True,
+                persist_dir=str(tmp_path / "pnode.persist"),
+            )
+            await node.start()
+            holder["node"] = node
+            ready.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=30.0), "persist node failed to start"
+
+    class Handle:
+        def port(self, name):
+            return holder["node"].ctrl.port
+
+    yield Handle()
+
+    async def down():
+        await holder["node"].stop()
+
+    asyncio.run_coroutine_threadsafe(down(), loop).result(timeout=10.0)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10.0)
+
+
+def test_persist_status_and_compact(persist_node, tmp_path):
+    """`breeze persist status` renders journal health + book digests
+    against a node whose originated prefix has already journaled, and
+    `persist compact --force` folds the journal into a snapshot (status
+    afterwards shows the compaction and an empty journal)."""
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        out = invoke(persist_node, "pnode", "persist", "status")
+        if "kv_orig" in out:
+            break
+        time.sleep(0.2)
+    assert "# node pnode" in out
+    assert str(tmp_path / "pnode.persist") in out
+    assert "journal_records" in out and "wedged" in out
+    # the originated loopback reached the durable books
+    assert "kv_orig" in out and "pfx_entries" in out
+
+    out = invoke(persist_node, "pnode", "persist", "compact", "--force")
+    assert out.strip() == "compacted"
+
+    out = invoke(persist_node, "pnode", "persist", "status")
+    kv = {
+        parts[0]: parts[1]
+        for parts in (r.split() for r in out.splitlines())
+        if len(parts) == 2
+    }
+    assert int(kv["compactions"]) >= 1
+    assert int(kv["journal_records"]) == 0

@@ -24,7 +24,7 @@ KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
 
 ALL_CODES = {
     "OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007",
-    "OR008", "OR009", "OR010", "OR011", "OR012", "OR013",
+    "OR008", "OR009", "OR010", "OR011", "OR012", "OR013", "OR014",
 }
 
 
@@ -682,6 +682,60 @@ def test_or013_work_scope(tmp_path):
         select={"OR013"},
     )
     assert codes_of(nested) == ["OR013"]
+
+
+def test_or014_raw_persistence_seam(tmp_path):
+    """Hand-rolled durable writes (write-mode open / rename-into-place /
+    json.dump) in state-owning subsystems must route through persist/;
+    persist itself, the emulator harness, and read-mode opens stay
+    clean."""
+    snippet = """
+    import json
+    import os
+
+    def save(self, path, state):
+        with open(path + ".tmp", "w") as f:
+            json.dump(state, f)
+        os.replace(path + ".tmp", path)
+    """
+    for rel in (
+        "openr_tpu/configstore/m.py",
+        "openr_tpu/kvstore/m.py",
+        "openr_tpu/fib/m.py",
+    ):
+        hit = lint_snippet(tmp_path, snippet, rel=rel, select={"OR014"})
+        assert codes_of(hit) == ["OR014", "OR014", "OR014"], rel
+    for rel in (
+        "openr_tpu/persist/m.py",  # the one sanctioned home
+        "openr_tpu/emulator/m.py",  # harness artifacts, not durable state
+        "openr_tpu/other/m.py",  # not a state-owning subsystem
+    ):
+        out = lint_snippet(tmp_path, snippet, rel=rel, select={"OR014"})
+        assert codes_of(out) == [], rel
+    clean = lint_snippet(
+        tmp_path,
+        """
+        from openr_tpu.persist import atomic_write_bytes
+
+        def save(self, path, payload):
+            with open(path, "rb") as f:
+                _old = f.read()
+            atomic_write_bytes(path, payload)
+        """,
+        rel="openr_tpu/configstore/m.py",
+        select={"OR014"},
+    )
+    assert codes_of(clean) == []
+    kw_mode = lint_snippet(
+        tmp_path,
+        """
+        def save(self, path):
+            return open(path, mode="ab")
+        """,
+        rel="openr_tpu/kvstore/m.py",
+        select={"OR014"},
+    )
+    assert codes_of(kw_mode) == ["OR014"]
 
 
 # ------------------------------------------- suppression + baseline plumbing

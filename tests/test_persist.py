@@ -1,0 +1,423 @@
+"""Crash-consistent persistence plane: journal grammar, recovery
+contract, fault injection, durable books, and the migrated consumers
+(docs/Persist.md).
+
+Crashes are modelled at the byte level — a "crash" is reopening the
+directory with a fresh PersistPlane, optionally after damaging the
+files the way the injectors would. The full process-level story
+(SIGKILL → warm boot under armed faults) lives in
+tests/test_proc_cluster.py and benchmarks/bench_persist.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+
+import pytest
+
+from openr_tpu.persist import (
+    DiskFaultInjector,
+    InjectedCrash,
+    Journal,
+    JournalRecord,
+    OP_DEL,
+    OP_SET,
+    PersistPlane,
+    atomic_write_bytes,
+    book_digest,
+    encode_record,
+    move_aside,
+    replay_frames,
+)
+from openr_tpu.persist.journal import load_journal
+from openr_tpu.types.serde import WireDecodeError
+
+
+def recs(*pairs) -> list[JournalRecord]:
+    return [JournalRecord("b", OP_SET, k, v) for k, v in pairs]
+
+
+# ------------------------------------------------------------ record grammar
+
+
+def test_frame_roundtrip():
+    rec = JournalRecord("kv_orig", OP_SET, b"\x00key", b"value\xff")
+    frames = encode_record(rec) + encode_record(
+        JournalRecord("kv_orig", OP_DEL, b"\x00key")
+    )
+    out, torn = replay_frames(frames)
+    assert torn == 0
+    assert out == [rec, JournalRecord("kv_orig", OP_DEL, b"\x00key", b"")]
+
+
+def test_empty_and_missing(tmp_path):
+    assert replay_frames(b"") == ([], 0)
+    assert load_journal(str(tmp_path / "nope.bin")) == ([], 0)
+
+
+def test_torn_tail_truncated_at_every_boundary():
+    """Cutting a valid journal ANYWHERE mid-record salvages exactly the
+    records whose full frames precede the cut."""
+    records = recs((b"a", b"1"), (b"b", b"22"), (b"c", b"333"))
+    frames = [encode_record(r) for r in records]
+    blob = b"".join(frames)
+    bounds = [0]
+    for f in frames:
+        bounds.append(bounds[-1] + len(f))
+    for cut in range(len(blob) + 1):
+        out, torn = replay_frames(blob[:cut])
+        n_whole = sum(1 for b in bounds[1:] if b <= cut)
+        assert len(out) == n_whole, cut
+        assert torn == cut - bounds[n_whole], cut
+        assert out == records[:n_whole]
+
+
+def test_final_record_crc_flip_is_torn():
+    """A CRC mismatch on the LAST record is the torn-at-crash case —
+    the trailer never left the page cache — and must salvage the
+    prefix, not raise."""
+    blob = b"".join(encode_record(r) for r in recs((b"a", b"1"), (b"b", b"2")))
+    bad = bytearray(blob)
+    bad[-1] ^= 0x40  # inside the final CRC trailer
+    out, torn = replay_frames(bytes(bad))
+    assert [r.key for r in out] == [b"a"]
+    assert torn > 0
+
+
+def test_mid_journal_corruption_is_loud():
+    blob = b"".join(encode_record(r) for r in recs((b"a", b"1"), (b"b", b"2")))
+    first_len = len(encode_record(recs((b"a", b"1"))[0]))
+    bad = bytearray(blob)
+    bad[first_len - 1] ^= 0x01  # first record's CRC, bytes follow
+    with pytest.raises(WireDecodeError, match="bytes following"):
+        replay_frames(bytes(bad))
+
+
+def test_strict_mode_never_salvages():
+    blob = encode_record(recs((b"a", b"1"))[0])
+    with pytest.raises(WireDecodeError):
+        replay_frames(blob[:-2], strict=True)  # torn tail
+    bad = bytearray(blob)
+    bad[-1] ^= 0x01
+    with pytest.raises(WireDecodeError):
+        replay_frames(bytes(bad), strict=True)  # final-CRC flip
+
+
+def test_runaway_uvarint_is_torn_tail():
+    out, torn = replay_frames(b"\xff" * 32)
+    assert out == [] and torn == 32
+
+
+def test_load_journal_truncates_file_in_place(tmp_path):
+    path = str(tmp_path / "j.bin")
+    blob = b"".join(encode_record(r) for r in recs((b"a", b"1"), (b"b", b"2")))
+    with open(path, "wb") as f:
+        f.write(blob + b"\x7f\x00garbage-half-frame")
+    out, torn = load_journal(path)
+    assert len(out) == 2 and torn > 0
+    assert os.path.getsize(path) == len(blob)
+    # idempotent: the second replay sees a clean file
+    assert load_journal(path) == (out, 0)
+
+
+# ----------------------------------------------------------- atomic snapshot
+
+
+def test_atomic_write_and_move_aside(tmp_path):
+    path = str(tmp_path / "snap.bin")
+    atomic_write_bytes(path, b"v1")
+    atomic_write_bytes(path, b"v2")
+    with open(path, "rb") as f:
+        assert f.read() == b"v2"
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    aside = move_aside(path)
+    assert aside.endswith(".corrupt") and not os.path.exists(path)
+    atomic_write_bytes(path, b"v3")
+    assert move_aside(path).endswith(".corrupt.1")  # evidence kept
+
+
+def test_crash_between_rename_leaves_old_file(tmp_path):
+    path = str(tmp_path / "snap.bin")
+    atomic_write_bytes(path, b"old")
+    faults = DiskFaultInjector()
+    faults.arm("crash_between_rename")
+    with pytest.raises(InjectedCrash):
+        atomic_write_bytes(path, b"new", faults=faults)
+    with open(path, "rb") as f:
+        assert f.read() == b"old"
+
+
+# ------------------------------------------------------------- persist plane
+
+
+def test_plane_record_erase_recover(tmp_path):
+    d = str(tmp_path / "p")
+    p = PersistPlane(d)
+    assert p.record("kv", b"k1", b"v1")
+    assert p.record("kv", b"k2", b"v2")
+    assert not p.record("kv", b"k1", b"v1")  # dedup no-op
+    assert p.record("kv", b"k1", b"v1b")  # changed value journals
+    assert p.erase("kv", b"k2")
+    assert not p.erase("kv", b"missing")
+    digest = book_digest(p.book("kv"))
+    p.close()
+
+    p2 = PersistPlane(d)
+    assert p2.book("kv") == {b"k1": b"v1b"}
+    assert p2.recovery["books"]["kv"] == digest
+    assert p2.recovery["truncated_bytes"] == 0
+    p2.close()
+
+
+def test_plane_compaction_preserves_trigger_record(tmp_path):
+    """The compaction ordering bug class: the record whose append trips
+    the threshold must be in the snapshot the reset relies on."""
+    d = str(tmp_path / "p")
+    p = PersistPlane(d, compact_every=4)
+    for i in range(10):
+        p.record("kv", b"k%d" % i, b"v%d" % i)
+    assert p.compactions >= 2
+    digest = book_digest(p.book("kv"))
+    p.close()
+    p2 = PersistPlane(d)
+    assert len(p2.book("kv")) == 10
+    assert p2.recovery["books"]["kv"] == digest
+    p2.close()
+
+
+def test_plane_replace_book_is_delta_proportional(tmp_path):
+    p = PersistPlane(str(tmp_path / "p"))
+    p.replace_book("fib", {b"a": b"1", b"b": b"2"})
+    before = p.journal.records
+    assert p.replace_book("fib", {b"a": b"1", b"b": b"2"}) == 0
+    assert p.journal.records == before  # no-op sync journals nothing
+    assert p.replace_book("fib", {b"a": b"1", b"c": b"3"}) == 2  # del b, add c
+    assert p.book("fib") == {b"a": b"1", b"c": b"3"}
+    # prefix-scoped replace leaves other keyspaces alone
+    p.replace_book("fib", {b"u:x": b"9"}, prefix=b"u:")
+    assert p.book("fib") == {b"a": b"1", b"c": b"3", b"u:x": b"9"}
+    p.close()
+
+
+def test_plane_torn_fault_discards_doomed_record(tmp_path):
+    """Crash-mid-write: the writer believes the append landed and the
+    in-memory book advances, but the journal wedges — recovery returns
+    the pre-fault state, byte-identical."""
+    d = str(tmp_path / "p")
+    p = PersistPlane(d)
+    p.record("kv", b"stable", b"s")
+    pre = book_digest(p.book("kv"))
+    p.faults.arm("torn", at=3)
+    assert p.record("kv", b"doomed", b"d")  # writer can't tell
+    assert p.journal.wedged
+    assert p.book("kv") == {b"stable": b"s", b"doomed": b"d"}
+    assert not p.record("kv", b"later", b"l") or True  # nothing durable now
+    p.journal.close()  # SIGKILL stand-in: no clean close/sync
+
+    p2 = PersistPlane(d)
+    assert p2.book("kv") == {b"stable": b"s"}
+    assert p2.recovery["books"]["kv"] == pre
+    assert p2.recovery["truncated_bytes"] > 0
+    p2.close()
+
+
+def test_plane_corrupt_final_record_is_torn(tmp_path):
+    d = str(tmp_path / "p")
+    p = PersistPlane(d)
+    p.record("kv", b"stable", b"s")
+    pre = book_digest(p.book("kv"))
+    p.faults.arm("corrupt", bit=8)
+    p.record("kv", b"doomed", b"d")
+    p.journal.close()
+    p2 = PersistPlane(d)
+    assert p2.recovery["books"]["kv"] == pre
+    p2.close()
+
+
+def test_plane_enospc_keeps_memory_and_disk_in_lockstep(tmp_path):
+    """ENOSPC raises BEFORE the write, so the in-memory book must NOT
+    advance — the next divergent advertisement retries naturally."""
+    d = str(tmp_path / "p")
+    p = PersistPlane(d)
+    p.faults.arm("enospc")
+    assert not p.record("kv", b"k", b"v")
+    assert b"k" not in p.book("kv")
+    assert p.append_errors == 1
+    assert p.record("kv", b"k", b"v")  # retry lands
+    p.close()
+    p2 = PersistPlane(d)
+    assert p2.book("kv") == {b"k": b"v"}
+    p2.close()
+
+
+def test_plane_compact_abort_keeps_journal(tmp_path):
+    d = str(tmp_path / "p")
+    p = PersistPlane(d)
+    p.record("kv", b"k", b"v")
+    p.faults.arm("crash_between_rename")
+    assert not p.compact(force=True)
+    assert p.journal.records == 1  # journal untouched, still authoritative
+    p.close()
+    p2 = PersistPlane(d)
+    assert p2.book("kv") == {b"k": b"v"}
+    p2.close()
+
+
+def test_plane_duplicate_snapshot_journal_records_absorbed(tmp_path):
+    """Crash after the snapshot rename but before the journal truncate:
+    replay sees every record twice and last-wins absorbs it."""
+    d = str(tmp_path / "p")
+    p = PersistPlane(d)
+    p.record("kv", b"k", b"v1")
+    p.record("kv", b"k", b"v2")
+    assert p.compact(force=True)
+    # resurrect the pre-compaction journal next to the new snapshot
+    with open(os.path.join(d, PersistPlane.JOURNAL), "ab") as f:
+        f.write(encode_record(JournalRecord("kv", OP_SET, b"k", b"v1")))
+        f.write(encode_record(JournalRecord("kv", OP_SET, b"k", b"v2")))
+    p.journal.close()
+    p2 = PersistPlane(d)
+    assert p2.book("kv") == {b"k": b"v2"}
+    p2.close()
+
+
+def test_plane_status_shape(tmp_path):
+    p = PersistPlane(str(tmp_path / "p"))
+    p.record("kv", b"k", b"v")
+    st = p.status()
+    assert st["journal_records"] == 1
+    assert st["books"]["kv"]["records"] == 1
+    assert st["books"]["kv"]["digest"] == book_digest({b"k": b"v"})
+    assert st["recovery"]["snapshot_records"] == 0
+    assert st["faults"] == {"armed": [], "fired": {}}
+    assert not st["wedged"]
+    p.close()
+
+
+def test_slow_fsync_fires_once(tmp_path):
+    p = PersistPlane(str(tmp_path / "p"))
+    p.faults.arm("slow_fsync", delay_s=0.01)
+    p.record("kv", b"k", b"v")
+    p.sync()
+    assert p.faults.fired == {"slow_fsync": 1}
+    p.sync()  # one-shot: no second sleep
+    p.close()
+
+
+def test_injector_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        DiskFaultInjector().arm("meteor_strike")
+
+
+# --------------------------------------------------------- durable dataplane
+
+
+def _routes():
+    from openr_tpu.types.network import (
+        IpPrefix,
+        MplsRoute,
+        NextHop,
+        UnicastRoute,
+    )
+
+    u = UnicastRoute(
+        dest=IpPrefix.make("10.1.0.0/24"),
+        nexthops=(NextHop(address="peer1", if_name="if0"),),
+    )
+    m = MplsRoute(
+        top_label=100, nexthops=(NextHop(address="peer2", if_name="if1"),)
+    )
+    return u, m
+
+
+def test_durable_mock_fib_survives_reopen(tmp_path):
+    from openr_tpu.persist.dataplane import DurableMockFibHandler
+
+    d = str(tmp_path / "p")
+    u, m = _routes()
+
+    async def program():
+        plane = PersistPlane(d)
+        h = DurableMockFibHandler(plane)
+        await h.add_unicast_routes(786, [u])
+        await h.add_mpls_routes(786, [m])
+        plane.journal.close()  # crash, not close(): no final sync needed
+
+    async def recover():
+        plane = PersistPlane(d)
+        h = DurableMockFibHandler(plane)
+        assert await h.get_route_table_by_client(786) == [u]
+        assert await h.get_mpls_route_table_by_client(786) == [m]
+        await h.delete_unicast_routes(786, [u.dest])
+        await h.sync_mpls_fib(786, [])
+        plane.close()
+
+    async def empty():
+        plane = PersistPlane(d)
+        h = DurableMockFibHandler(plane)
+        assert await h.get_route_table_by_client(786) == []
+        assert await h.get_mpls_route_table_by_client(786) == []
+        plane.close()
+
+    asyncio.run(program())
+    asyncio.run(recover())
+    asyncio.run(empty())
+
+
+def test_durable_mock_fib_failed_op_never_persists(tmp_path):
+    from openr_tpu.fib.fib import FibProgramError
+    from openr_tpu.persist.dataplane import DurableMockFibHandler
+
+    d = str(tmp_path / "p")
+    u, _ = _routes()
+
+    async def run():
+        plane = PersistPlane(d)
+        h = DurableMockFibHandler(plane)
+        h.fail_next_n = 1
+        with pytest.raises(FibProgramError):
+            await h.add_unicast_routes(786, [u])
+        assert plane.book("dp_unicast") == {}
+        plane.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------ configstore migration
+
+
+def test_configstore_on_shared_durability(tmp_path):
+    """PersistentStore rides persist.atomic_write_bytes now (one
+    durability implementation): survives reopen, leaves no temp files,
+    parks corrupt snapshots aside instead of overwriting evidence."""
+    from openr_tpu.configstore import PersistentStore
+
+    path = str(tmp_path / "store" / "state.json")
+
+    async def write():
+        s = PersistentStore(path)
+        await s.store("who", {"name": "node1"})
+
+    async def read_and_check():
+        s = PersistentStore(path)
+        s.load()
+        assert s.get("who") == {"name": "node1"}
+
+    asyncio.run(write())
+    asyncio.run(read_and_check())
+    assert not [
+        p for p in os.listdir(os.path.dirname(path)) if ".tmp." in p
+    ]
+    with open(path, "w") as f:
+        f.write("{corrupt")
+
+    async def corrupt_boot():
+        s = PersistentStore(path)
+        s.load()
+        assert s.get("who") is None
+        await s.store("who", {"name": "node2"})
+
+    asyncio.run(corrupt_boot())
+    assert os.path.exists(path + ".corrupt")  # evidence preserved

@@ -1,0 +1,296 @@
+"""Recovery fuzz for the persistence plane (test_fuzz_wire pattern).
+
+The property under test (docs/Persist.md "Recovery semantics"): for ANY
+damage to the on-disk journal — truncation at arbitrary offsets,
+bit flips, duplicated or stale records, snapshot/journal disagreement —
+recovery either
+
+  * returns a **prefix-consistent** state (the books exactly as they
+    were after some prefix of the append sequence; torn tails truncate
+    to the last good record boundary), or
+  * raises the loud typed error (:class:`WireDecodeError`) for damage
+    that cannot be attributed to a crash (mid-journal corruption,
+    any damage at all inside an atomically-renamed snapshot),
+
+and NEVER silently accepts a state that no incarnation held.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from openr_tpu.persist import (
+    JournalRecord,
+    OP_DEL,
+    OP_SET,
+    PersistPlane,
+    encode_record,
+)
+from openr_tpu.types.serde import WireDecodeError
+
+SEED = 20260807
+N_RECORDS = 40
+N_RANDOM_CUTS = 60
+N_BIT_FLIPS = 120
+
+BOOKS = ("kv_orig", "pfx_entries", "fib")
+
+
+def _workload(rng) -> list[JournalRecord]:
+    """A mixed SET/DEL sequence over a few books with key reuse, so
+    prefix states genuinely differ and stale replays are detectable."""
+    out: list[JournalRecord] = []
+    live: set[tuple[str, bytes]] = set()
+    for i in range(N_RECORDS):
+        book = BOOKS[int(rng.integers(0, len(BOOKS)))]
+        if live and rng.random() < 0.25:
+            book, key = sorted(live)[int(rng.integers(0, len(live)))]
+            out.append(JournalRecord(book, OP_DEL, key))
+            live.discard((book, key))
+            continue
+        key = b"k%d" % int(rng.integers(0, 12))  # reuse keys across ops
+        out.append(
+            JournalRecord(book, OP_SET, key, b"v%d:" % i + rng.bytes(8))
+        )
+        live.add((book, key))
+    return out
+
+
+def _prefix_states(records) -> list[dict[str, dict[bytes, bytes]]]:
+    """states[k] = books after applying the first k records."""
+    states = [{}]
+    cur: dict[str, dict[bytes, bytes]] = {}
+    for rec in records:
+        book = cur.setdefault(rec.book, {})
+        if rec.op == OP_SET:
+            book[rec.key] = rec.value
+        else:
+            book.pop(rec.key, None)
+        states.append({b: dict(kv) for b, kv in cur.items() if kv})
+    return states
+
+
+def _books_of(plane) -> dict[str, dict[bytes, bytes]]:
+    return {b: dict(kv) for b, kv in plane.books.items() if kv}
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """One journal-only plane directory + its expected prefix states."""
+    rng = np.random.default_rng(SEED)
+    records = _workload(rng)
+    d = str(tmp_path_factory.mktemp("persist-fuzz") / "plane")
+    p = PersistPlane(d, compact_every=10**9)  # journal-only: no snapshot
+    applied: list[JournalRecord] = []
+    for rec in records:
+        if rec.op == OP_SET:
+            if p.record(rec.book, rec.key, rec.value):
+                applied.append(rec)
+        else:
+            if p.erase(rec.book, rec.key):
+                applied.append(rec)
+    p.close()
+    with open(os.path.join(d, PersistPlane.JOURNAL), "rb") as f:
+        blob = f.read()
+    assert blob == b"".join(encode_record(r) for r in applied)
+    return d, blob, _prefix_states(applied)
+
+
+def _recover(tmp_path, blob: bytes):
+    d = str(tmp_path / "r")
+    os.makedirs(d)
+    with open(os.path.join(d, PersistPlane.JOURNAL), "wb") as f:
+        f.write(blob)
+    p = PersistPlane(d)
+    books = _books_of(p)
+    p.close()
+    return books
+
+
+def _assert_prefix_consistent(books, states, ctx):
+    assert books in states, (
+        f"{ctx}: recovered state matches NO prefix of the append "
+        f"sequence — silent corruption"
+    )
+
+
+# ------------------------------------------------------------------ truncation
+
+
+def test_truncate_every_record_boundary(corpus, tmp_path):
+    d, blob, states = corpus
+    # frame boundaries reconstructed by re-encoding each replayed record
+    offs = [0]
+    cur = 0
+    from openr_tpu.persist.journal import replay_frames
+
+    records, torn = replay_frames(blob)
+    assert torn == 0
+    for rec in records:
+        cur += len(encode_record(rec))
+        offs.append(cur)
+    for k, off in enumerate(offs):
+        books = _recover(tmp_path / f"b{k}", blob[:off])
+        assert books == states[k], f"boundary cut after {k} records"
+
+
+def test_truncate_random_mid_record_offsets(corpus, tmp_path):
+    d, blob, states = corpus
+    rng = np.random.default_rng(SEED + 1)
+    for i in range(N_RANDOM_CUTS):
+        cut = int(rng.integers(0, len(blob) + 1))
+        books = _recover(tmp_path / f"c{i}", blob[:cut])
+        _assert_prefix_consistent(books, states, f"cut at {cut}")
+
+
+def test_truncated_then_appended_garbage(corpus, tmp_path):
+    """A torn tail followed by pre-crash garbage bytes: salvage must
+    stop at the last good boundary or be loud — never resync onto a
+    lucky frame inside the garbage."""
+    d, blob, states = corpus
+    rng = np.random.default_rng(SEED + 2)
+    for i in range(20):
+        cut = int(rng.integers(1, len(blob)))
+        junk = rng.bytes(int(rng.integers(1, 40)))
+        try:
+            books = _recover(tmp_path / f"g{i}", blob[:cut] + junk)
+        except WireDecodeError:
+            continue  # loud is always acceptable
+        _assert_prefix_consistent(books, states, f"cut {cut} + junk")
+
+
+# ------------------------------------------------------------------- bit flips
+
+
+def test_bit_flips_prefix_consistent_or_loud(corpus, tmp_path):
+    d, blob, states = corpus
+    rng = np.random.default_rng(SEED + 3)
+    loud = 0
+    for i in range(N_BIT_FLIPS):
+        bit = int(rng.integers(0, len(blob) * 8))
+        bad = bytearray(blob)
+        bad[bit // 8] ^= 1 << (bit % 8)
+        try:
+            books = _recover(tmp_path / f"f{i}", bytes(bad))
+        except WireDecodeError:
+            loud += 1
+            continue
+        _assert_prefix_consistent(books, states, f"bit flip {bit}")
+    # flips inside a non-final record's payload/CRC must be loud; with
+    # 40 records nearly all flips hit one — if nothing was loud the
+    # mid-journal corruption check is broken
+    assert loud > N_BIT_FLIPS // 2
+
+
+def test_crc_flip_every_record(corpus, tmp_path):
+    """Deterministic sweep: flip one CRC bit in EACH record. Final
+    record → torn (prefix state); any earlier record → loud."""
+    d, blob, states = corpus
+    from openr_tpu.persist.journal import replay_frames
+
+    records, _ = replay_frames(blob)
+    off = 0
+    for k, rec in enumerate(records):
+        frame = encode_record(rec)
+        crc_last = off + len(frame) - 1
+        bad = bytearray(blob)
+        bad[crc_last] ^= 0x10
+        if k == len(records) - 1:
+            books = _recover(tmp_path / f"crc{k}", bytes(bad))
+            assert books == states[k]  # last record torn away
+        else:
+            with pytest.raises(WireDecodeError, match="bytes following"):
+                _recover(tmp_path / f"crc{k}", bytes(bad))
+        off += len(frame)
+
+
+# --------------------------------------------------- duplicate / stale replay
+
+
+def test_duplicate_and_stale_records_last_wins(corpus, tmp_path):
+    """Compaction-crash artifact: journal records that also exist in
+    the snapshot (or appear twice) must be absorbed by last-wins
+    replay, landing on the exact final state."""
+    d, blob, states = corpus
+    from openr_tpu.persist.journal import replay_frames
+
+    records, _ = replay_frames(blob)
+    rng = np.random.default_rng(SEED + 4)
+    for i in range(10):
+        k = int(rng.integers(0, len(records)))
+        dup = blob + encode_record(records[k])
+        books = _recover(tmp_path / f"d{i}", dup)
+        # replaying record k on the final state
+        expect = {b: dict(kv) for b, kv in states[-1].items()}
+        rec = records[k]
+        book = expect.setdefault(rec.book, {})
+        if rec.op == OP_SET:
+            book[rec.key] = rec.value
+        else:
+            book.pop(rec.key, None)
+        expect = {b: kv for b, kv in expect.items() if kv}
+        assert books == expect, f"dup of record {k}"
+
+
+# ------------------------------------------- snapshot/journal disagreement
+
+
+def _compacted_dir(corpus, tmp_path):
+    d, blob, states = corpus
+    nd = str(tmp_path / "snap")
+    os.makedirs(nd)
+    with open(os.path.join(nd, PersistPlane.JOURNAL), "wb") as f:
+        f.write(blob)
+    p = PersistPlane(nd)
+    assert p.compact(force=True)
+    p.close()
+    return nd, states
+
+
+def test_snapshot_plus_stale_journal(corpus, tmp_path):
+    """Journal records older than the snapshot (crash between rename
+    and journal truncate): last-wins replay must land on the snapshot
+    state, not resurrect the stale values."""
+    nd, states = _compacted_dir(corpus, tmp_path)
+    from openr_tpu.persist.journal import replay_frames
+
+    with open(os.path.join(nd, PersistPlane.SNAPSHOT), "rb") as f:
+        snap_records, _ = replay_frames(f.read(), strict=True)
+    # a stale journal: every snapshot key rewritten with an OLD value,
+    # then the snapshot value again (the pre-compaction tail)
+    stale = bytearray()
+    for rec in snap_records:
+        stale += encode_record(
+            JournalRecord(rec.book, OP_SET, rec.key, b"stale")
+        )
+        stale += encode_record(rec)
+    with open(os.path.join(nd, PersistPlane.JOURNAL), "wb") as f:
+        f.write(bytes(stale))
+    p = PersistPlane(nd)
+    assert _books_of(p) == states[-1]
+    p.close()
+
+
+def test_snapshot_damage_is_always_loud(corpus, tmp_path):
+    """Snapshots are atomically renamed — there is no crash that can
+    tear one, so ANY damage (truncation or flip, even in the final
+    record) is WireDecodeError, never salvage."""
+    nd, _states = _compacted_dir(corpus, tmp_path)
+    snap_path = os.path.join(nd, PersistPlane.SNAPSHOT)
+    with open(snap_path, "rb") as f:
+        snap = f.read()
+    rng = np.random.default_rng(SEED + 5)
+    damages = [snap[: int(rng.integers(1, len(snap)))] for _ in range(8)]
+    for _ in range(8):
+        bit = int(rng.integers(0, len(snap) * 8))
+        bad = bytearray(snap)
+        bad[bit // 8] ^= 1 << (bit % 8)
+        damages.append(bytes(bad))
+    for i, bad_snap in enumerate(damages):
+        with open(snap_path, "wb") as f:
+            f.write(bad_snap)
+        with pytest.raises(WireDecodeError):
+            PersistPlane(nd)

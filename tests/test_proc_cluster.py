@@ -235,6 +235,86 @@ def test_kill_restart_reconnects_same_peer(tmp_path):
 
 
 @pytest.mark.timeout(240)
+def test_proc_cluster_sigkill_warm_boot_parity(tmp_path):
+    """Crash-recovery invariant (class 7, docs/Persist.md) across a
+    REAL process crash: snapshot the victim's durable book digests at
+    quiescence, arm a torn write, drive one doomed advertisement (it
+    applies in memory, floods, and wedges the journal mid-frame), then
+    SIGKILL. The re-exec'd incarnation must truncate the torn tail and
+    recover byte-identical pre-crash state, while survivors — whose
+    hold timers outlive the restart — observe zero withdrawal window
+    (no key expiry, no neighbor_down)."""
+
+    async def main():
+        links = [
+            LinkSpec("node-0", "node-1"),
+            LinkSpec("node-1", "node-2"),
+        ]
+        cluster = ProcCluster(
+            links, workdir=str(tmp_path), prefixes_per_node=2,
+            # hold/GR must outlive the SIGKILL→ready window or the
+            # zero-withdrawal half of the invariant is unsatisfiable
+            spark_overrides={
+                "hold_time_ms": 60000,
+                "graceful_restart_time_ms": 60000,
+            },
+        )
+        try:
+            await cluster.start()
+            await proc_invariants.wait_quiescent(
+                cluster, timeout_s=120, context="persist cold boot"
+            )
+            pre = await proc_invariants.snapshot_persist(cluster, "node-2")
+            assert pre["books"], "no durable books at quiescence"
+            assert set(pre["watch"]) == {"node-0", "node-1"}
+
+            res = await cluster.inject_disk_fault("node-2", "torn", at=3)
+            assert res["ok"], res
+            # the doomed mutation: applies in memory + floods to peers,
+            # but its journal frame tears at byte 3 and wedges the
+            # journal — the crash model where the writer believes the
+            # write succeeded
+            await cluster.call(
+                "node-2", "advertise_prefixes",
+                {"prefixes": ["10.97.255.1/32"]},
+            )
+
+            async def wedged():
+                st = await cluster.get_persist_status("node-2")
+                return st.get("wedged") or None
+
+            await _poll("journal wedged on node-2", wedged, timeout=30)
+
+            # announce GR, then SIGKILL: peers park the adjacency in
+            # RESTART (no NEIGHBOR_DOWN — the zero-withdrawal half),
+            # while the process still dies hard with the torn frame on
+            # disk (an unannounced kill is CORRECTLY flapped by Spark's
+            # non-GR restart detection, so it can't be hitless)
+            await cluster.call("node-2", "spark_announce_restart")
+            await cluster.crash_node("node-2")  # SIGKILL, nothing flushed
+            await cluster.restart_node("node-2")
+            await proc_invariants.wait_quiescent(
+                cluster, timeout_s=120, context="persist warm boot"
+            )
+            violations = await proc_invariants.check_persist_recovery(
+                cluster, pre
+            )
+            assert not violations, [str(v) for v in violations]
+
+            post = await cluster.get_persist_status("node-2")
+            rec = post["recovery"]
+            # evidence the fault actually bit: the torn frame was found
+            # and truncated at boot, and real records came off disk
+            assert rec["truncated_bytes"] > 0
+            assert rec["snapshot_records"] + rec["journal_records"] > 0
+            assert not post["wedged"]
+        finally:
+            await cluster.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.timeout(240)
 def test_proc_cluster_graceful_restart_rehandshake(tmp_path):
     """3-process line via the supervisor: graceful restart of an end
     node rebinds every listener on NEW ephemeral ports, so the

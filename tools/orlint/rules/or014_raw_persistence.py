@@ -1,0 +1,95 @@
+"""OR014: raw persistence seam outside ``persist/``.
+
+Durable state has exactly one home: ``openr_tpu/persist`` — the
+journaled plane whose append-frame grammar, fsync discipline and
+atomic-rename snapshot path are crash-tested against injected disk
+faults (docs/Persist.md). A hand-rolled ``open(..., "w")`` /
+``os.replace`` / ``json.dump`` in a state-owning subsystem is a second
+durability implementation: it silently reintroduces the torn-write and
+missing-parent-fsync windows the plane exists to close (the
+configstore's pre-migration gap was exactly this). Flagged calls should
+route through ``persist.atomic_write_bytes`` / ``PersistPlane``; a
+genuinely non-durable artifact (debug dump, human log) carries an
+inline ``# orlint: disable=OR014`` naming why loss is acceptable.
+
+Scope: subsystems that own node state. The emulator/cli harness layers
+(post-mortem dumps, spawned-process configs and logs) and ``persist``
+itself are out of scope by directory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name
+
+# state-owning subsystems where an ad-hoc durable write is a second
+# persistence implementation; harness layers (emulator, cli, tools) and
+# the one sanctioned home (persist) are not listed
+DURABLE_DIRS = frozenset(
+    {
+        "configstore", "kvstore", "prefixmgr", "fib", "decision",
+        "allocators", "linkmonitor", "spark", "ctrl", "monitor",
+        "types", "config", "policy",
+    }
+)
+
+RAW_MOVES = frozenset({"os.replace", "os.rename", "json.dump"})
+
+WRITE_MODES = ("w", "a", "x")
+
+
+def _open_write_mode(node: ast.Call) -> str | None:
+    """Literal write/append mode of an ``open()`` call, else None."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        if any(c in mode.value for c in WRITE_MODES):
+            return mode.value
+    return None
+
+
+class RawPersistenceRule(Rule):
+    code = "OR014"
+    name = "raw-persistence-seam"
+    description = "ad-hoc durable write outside the persist/ plane"
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        parts = ctx.part_set()
+        if not (parts & DURABLE_DIRS):
+            return
+        if parts & {"persist", "emulator"}:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn in RAW_MOVES:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{dn}() is a raw persistence seam — durable writes "
+                    f"go through persist.atomic_write_bytes / "
+                    f"PersistPlane (docs/Persist.md), or justify a "
+                    f"non-durable artifact inline",
+                    subject=dn,
+                )
+            elif dn == "open":
+                mode = _open_write_mode(node)
+                if mode is not None:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"open(..., {mode!r}) is a raw persistence seam "
+                        f"— durable writes go through "
+                        f"persist.atomic_write_bytes / PersistPlane "
+                        f"(docs/Persist.md), or justify a non-durable "
+                        f"artifact inline",
+                        subject="open",
+                    )
