@@ -49,7 +49,7 @@ if [ "$smoke_rc" -ne 1 ]; then
     exit 1
 fi
 for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
-            OR010 OR011 OR012 OR013 OR014; do
+            OR010 OR011 OR012 OR013 OR014 OR015; do
     if ! printf '%s\n' "$smoke_out" | grep -q " $code "; then
         echo "orlint smoke: rule $code produced no finding on the" \
              "known-bad fixture (rule deleted or broken?)"
@@ -57,7 +57,26 @@ for code in OR001 OR002 OR003 OR004 OR005 OR006 OR007 OR008 OR009 \
         exit 1
     fi
 done
-echo "ok: known-bad fixture trips all 14 rules"
+# the legal evolution move must stay silent: the fixture's AppendedMsg
+# adds a DEFAULTED trailing field, which OR015 must NOT flag
+if printf '%s\n' "$smoke_out" | grep -q "AppendedMsg"; then
+    echo "orlint smoke: OR015 flagged AppendedMsg — a defaulted" \
+         "trailing append is the LEGAL evolution move and must pass"
+    echo "$smoke_out"
+    exit 1
+fi
+echo "ok: known-bad fixture trips all 15 rules (legal append silent)"
+
+echo "== wire-schema lock (extracted schema vs committed lock + goldens) =="
+# the schema-lock lane (docs/Wire.md "Schema evolution"): re-extract
+# the wire/persist schema from source, fail on ANY drift vs
+# openr_tpu/types/wire_schema.lock.json (breaking drift additionally
+# trips orlint OR015 above; benign drift means the committed lock text
+# is stale — regenerate with `python -m tools.orlint.wireschema
+# --write`), verify the lock covers 100% of serde-registered types,
+# and verify the golden-frame corpus exists and regenerates
+# byte-identically for the current lock version
+JAX_PLATFORMS=cpu python -m tools.orlint.wireschema --check
 
 echo "== topo-churn smoke (fixed seed, warm-start counter + parity gate) =="
 # the topology-delta acceptance gate (docs/Decision.md): single-link

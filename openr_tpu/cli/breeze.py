@@ -152,6 +152,9 @@ def version(ctx):
             v = m.group(1) if m else "unknown"
     name = _run(ctx, "get_my_node_name")
     click.echo(f"openr_tpu {v} (node {name})")
+    from openr_tpu.types.wirelock import locked_version
+
+    click.echo(f"wire schema lock: v{locked_version()}")
 
 
 @cli.command("tech-support")
@@ -227,6 +230,52 @@ def validate(ctx):
     if not res["pass"]:
         raise SystemExit(1)
     click.echo("all checks passed")
+
+
+# ---------------------------------------------------------------------- wire
+
+
+@cli.group()
+def wire():
+    """Wire/persist schema lock introspection (docs/Wire.md "Schema
+    evolution")."""
+
+
+@wire.command("schema")
+@click.option("--dump", is_flag=True,
+              help="print the node's full schema JSON instead of diffing")
+@click.pass_context
+def wire_schema(ctx, dump):
+    """The queried node's LIVE wire/persist schema diffed against the
+    operator's committed lock — run before an upgrade so version skew
+    shows up as a named field-level report, not as mis-decoded frames.
+    Exits 1 when the diff contains breaking drift."""
+    from openr_tpu.types import wirelock
+
+    res = _run(ctx, "get_wire_schema")
+    if dump:
+        click.echo(json.dumps(res["schema"], indent=2, sort_keys=True))
+        return
+    click.echo(
+        f"node {res['node']}: lock v{res['lock_version']}, "
+        f"{len(res['schema']['types'])} wire types"
+    )
+    lock = wirelock.load_lock()
+    if lock is None:
+        raise click.ClickException(
+            "no local wire_schema.lock.json to diff against"
+        )
+    click.echo(f"local lock: v{lock['lock_version']}")
+    drifts = wirelock.diff_schemas(lock, res["schema"])
+    if not drifts:
+        click.echo("in sync: no drift between node schema and local lock")
+        return
+    breaking, benign = wirelock.classify(drifts)
+    for d in breaking + benign:
+        click.echo(str(d))
+    click.echo(f"{len(breaking)} breaking, {len(benign)} benign")
+    if breaking:
+        raise SystemExit(1)
 
 
 # --------------------------------------------------------------------- spark

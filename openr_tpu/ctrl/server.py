@@ -148,7 +148,7 @@ class CtrlServer(OpenrModule):
             "get_kvstore_digest", "get_convergence_state",
             "check_fib_oracle", "chaos_set_drop", "set_udp_peer",
             "work_ledger_control", "spark_announce_restart",
-            "get_persist_status", "persist_control",
+            "get_persist_status", "persist_control", "get_wire_schema",
         ):
             s.register(name, getattr(self, name))
         s.register_stream("subscribe_kvstore", self.subscribe_kvstore)
@@ -944,6 +944,21 @@ class CtrlServer(OpenrModule):
         else:
             return {"ok": False, "error": f"unknown op {op!r}"}
         return {"ok": True, "faults": plane.faults.status()}
+
+    async def get_wire_schema(self, params: dict) -> dict:
+        """The wire/persist schema this node actually runs: the lock
+        version it was built against plus the live extracted schema
+        (docs/Wire.md "Schema evolution"). `breeze wire schema` diffs
+        this against the operator's local lock, so version skew is
+        found as a named field-level report BEFORE an upgrade, not as
+        mis-decodes after one."""
+        from openr_tpu.types import wirelock
+
+        return {
+            "node": self.node.name,
+            "lock_version": wirelock.locked_version(),
+            "schema": wirelock.extract_schema(),
+        }
 
     async def spark_announce_restart(self, params: dict) -> dict:
         """Graceful-restart announcement (the in-process emulator's

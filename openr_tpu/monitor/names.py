@@ -156,6 +156,11 @@ COUNTERS: frozenset[str] = frozenset(
         "persist.compact_errors",
         "persist.recovered_records",
         "persist.truncated_bytes",
+        # wire/persist schema lock (types/wirelock.py; docs/Wire.md
+        # "Schema evolution"): the lock_version this node was built
+        # against, stamped as a gauge at Node construction — fleet
+        # monitoring catches version skew before it mis-decodes
+        "wire.schema_lock_version",
         # everything else
         "configstore.corrupt",
         "configstore.stores",
@@ -253,6 +258,7 @@ DOCUMENTED: frozenset[str] = frozenset(
     | {n for n in COUNTERS if n.startswith("spark.inbox_")}
     | {n for n in COUNTERS if n.startswith("jax.")}
     | {n for n in COUNTERS if n.startswith("persist.")}
+    | {n for n in COUNTERS if n.startswith("wire.")}
 )
 
 #: source files exempt from the per-callsite check: the registry's own

@@ -75,6 +75,14 @@ class OpenrNode:
 
         self.flight = FlightRecorder(node=self.name)
         self.counters.flight = self.flight
+        # wire/persist schema lock version as a gauge (docs/Wire.md
+        # "Schema evolution"): fleet monitoring spots a version-skewed
+        # node BEFORE drift surfaces as peer/journal mis-decodes
+        from openr_tpu.types.wirelock import locked_version
+
+        lockv = locked_version()
+        if lockv is not None:
+            self.counters.set("wire.schema_lock_version", lockv)
 
         # ---- queues (reference: Main.cpp queue construction †) ----------
         # Every seam is depth-gauged; the policied ones are bounded with

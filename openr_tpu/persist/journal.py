@@ -39,6 +39,7 @@ from dataclasses import dataclass
 from openr_tpu.types.serde import (
     WireDecodeError,
     from_wire_bin,
+    register_wire_types,
     to_wire_bin,
     write_uvarint,
 )
@@ -244,3 +245,9 @@ def move_aside(path: str) -> str:
         n += 1
     os.replace(path, aside)
     return aside
+
+
+# wire-schema lock registration: every journal/snapshot payload is the
+# TLV form of THIS record — schema drift here corrupts warm boots the
+# same way flood-frame drift corrupts peers (docs/Persist.md)
+register_wire_types(JournalRecord)

@@ -18,6 +18,7 @@ import ipaddress
 from dataclasses import dataclass, field, replace
 
 from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.serde import register_wire_types
 from openr_tpu.types.topology import PrefixEntry
 
 #: prefixes per advertised PrefixDatabase chunk (one KvStore key each):
@@ -96,3 +97,9 @@ class PrefixRange:
         """Identity of the block (base, plen, count) — the origination
         book's dict key."""
         return (self.base, self.plen, self.count)
+
+
+# wire-schema lock registration: PrefixRange is a persist-plane book
+# value (pfx_ranges), so its positional contract is locked like any
+# flood-frame type (docs/Persist.md)
+register_wire_types(PrefixRange)

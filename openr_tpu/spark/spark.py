@@ -33,7 +33,12 @@ from openr_tpu.types.events import (
     NeighborEventType,
     NeighborInfo,
 )
-from openr_tpu.types.serde import from_wire_auto, to_wire, to_wire_bin
+from openr_tpu.types.serde import (
+    from_wire_auto,
+    register_wire_types,
+    to_wire,
+    to_wire_bin,
+)
 
 log = logging.getLogger(__name__)
 
@@ -563,3 +568,7 @@ class Spark(OpenrModule):
         )
         if self.counters is not None and etype == NeighborEventType.NEIGHBOR_UP:
             self.counters.increment("spark.neighbor_up")
+
+
+# wire-schema lock registration: the four UDP discovery frame types
+register_wire_types(HelloMsg, HandshakeMsg, HeartbeatMsg, SparkPacket)

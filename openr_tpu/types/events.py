@@ -7,6 +7,7 @@ import enum
 from dataclasses import dataclass, field
 
 from openr_tpu.monitor.perf import PerfEvents
+from openr_tpu.types.serde import register_wire_types
 
 
 class NeighborEventType(enum.IntEnum):
@@ -65,3 +66,8 @@ class InterfaceInfo:
 @dataclass
 class InterfaceEvent:
     interfaces: list[InterfaceInfo] = field(default_factory=list)
+
+
+# wire-schema lock registration: neighbor/interface events cross the
+# module pipeline and ride ctrl RPC payloads
+register_wire_types(NeighborInfo, NeighborEvent, InterfaceInfo, InterfaceEvent)

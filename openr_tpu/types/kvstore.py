@@ -10,7 +10,8 @@ import hashlib
 from dataclasses import dataclass, field
 
 from openr_tpu.common.constants import DEFAULT_AREA
-from openr_tpu.monitor.perf import PerfEvents
+from openr_tpu.monitor.perf import HopSpan, PerfEvent, PerfEvents
+from openr_tpu.types.serde import register_wire_types
 
 # TTL sentinel: key never expires (reference: openr/common/Constants.h †
 # kTtlInfinity == INT32_MIN in some versions; we use -1).
@@ -98,3 +99,14 @@ class KeyDumpParams:
     originator_ids: list[str] = field(default_factory=list)
     keys: list[str] = field(default_factory=list)
     ignore_ttl: bool = True
+
+
+# wire-schema lock registration: the flood/full-sync frame payloads.
+# The perf trio is registered HERE, not in monitor/perf.py: perf is
+# imported by the types package, so it cannot import types.serde back
+# (circular), and HopSpan is only reachable through the packed span_bin
+# extension — never through a dataclass field hint the registry closure
+# could walk.
+register_wire_types(
+    Value, Publication, KeyDumpParams, PerfEvent, HopSpan, PerfEvents
+)

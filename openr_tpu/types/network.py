@@ -14,6 +14,8 @@ import ipaddress
 from dataclasses import dataclass
 from functools import cached_property, total_ordering
 
+from openr_tpu.types.serde import register_wire_types
+
 
 class MplsActionType(enum.IntEnum):
     """reference: openr/if/Network.thrift † MplsActionCode."""
@@ -143,3 +145,9 @@ def sorted_nexthops(nhs) -> tuple[NextHop, ...]:
     sort key: `sorted(nhs)` would recompute _key twice per comparison
     through __lt__ (measured hot in 10k-route rebuilds)."""
     return tuple(sorted(nhs, key=NextHop._key))
+
+
+# wire-schema lock registration (docs/Wire.md "Schema evolution"):
+# everything below travels through the serde codecs — on flood frames
+# and in the persist plane's fib/dataplane books
+register_wire_types(MplsAction, IpPrefix, NextHop, UnicastRoute, MplsRoute)

@@ -11,6 +11,7 @@ import enum
 from dataclasses import dataclass, field
 
 from openr_tpu.types.network import IpPrefix, MplsRoute, NextHop, UnicastRoute
+from openr_tpu.types.serde import register_wire_types
 from openr_tpu.types.topology import PrefixEntry
 
 
@@ -261,3 +262,8 @@ def diff_route_dbs(
             if prev_m is not mentry and prev_m != mentry:
                 upd.mpls_to_update[label] = mentry
     return upd
+
+
+# wire-schema lock registration: RIB snapshots/deltas (ctrl export and
+# the persist plane's route books)
+register_wire_types(RibEntry, RibMplsEntry, RouteDatabase, RouteUpdate)

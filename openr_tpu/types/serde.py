@@ -909,3 +909,124 @@ def from_wire_auto(data: bytes, cls: Type[T]) -> T:
     if data[:1] == _BIN_HEADER[:1]:
         return from_wire_bin(data, cls)
     return from_wire(data, cls)
+
+
+# ------------------------------------------- schema-lock introspection hooks
+#
+# The wire-schema lock (docs/Wire.md "Schema evolution",
+# tools/orlint/wireschema.py, orlint rule OR015) needs a ground-truth
+# enumeration of every dataclass that travels through either codec plus
+# a canonical rendering of each type's positional contract. Modules
+# that define wire types register them at import time; the closure in
+# :func:`registered_wire_types` pulls in every nested dataclass/enum a
+# registered type references, so a type cannot silently escape the lock
+# by being reachable-only.
+
+_WIRE_TYPES: dict[str, type] = {}
+
+
+def register_wire_types(*classes: type) -> None:
+    """Declare dataclasses as lock-covered wire schema types."""
+    for cls in classes:
+        if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)):
+            raise TypeError(f"not a dataclass type: {cls!r}")
+        prev = _WIRE_TYPES.get(cls.__name__)
+        if prev is not None and prev is not cls:
+            raise ValueError(
+                f"wire type name collision: {cls.__name__} "
+                f"({prev.__module__} vs {cls.__module__})"
+            )
+        _WIRE_TYPES[cls.__name__] = cls
+
+
+def _reachable_schema_types(hint: Any) -> list[type]:
+    """Dataclass / Enum classes inside a field hint, through Optional,
+    union, list/tuple/dict nesting."""
+    found: list[type] = []
+    stack = [hint]
+    seen: set[int] = set()
+    while stack:
+        h = stack.pop()
+        if id(h) in seen:
+            continue
+        seen.add(id(h))
+        if isinstance(h, type):
+            if dataclasses.is_dataclass(h) or issubclass(h, enum.Enum):
+                found.append(h)
+            continue
+        stack.extend(get_args(h))
+    return found
+
+
+def registered_wire_types() -> dict[str, type]:
+    """Every registered wire type plus every dataclass/enum reachable
+    through registered types' field hints, sorted by name. Reachability
+    is what makes lock coverage structural: a nested type joins the
+    lock the moment any registered type references it."""
+    out: dict[str, type] = {}
+    stack = list(_WIRE_TYPES.values())
+    while stack:
+        cls = stack.pop()
+        if cls.__name__ in out:
+            continue
+        out[cls.__name__] = cls
+        if dataclasses.is_dataclass(cls):
+            hints = _hints(cls)
+            for f in _wire_fields(cls):
+                stack.extend(
+                    t
+                    for t in _reachable_schema_types(hints[f.name])
+                    if t.__name__ not in out
+                )
+    return dict(sorted(out.items()))
+
+
+def normalize_type_str(ann: Any) -> str:
+    """Canonical rendering of a field annotation for the lock: the
+    source annotation string (PEP 563 — every schema module uses
+    ``from __future__ import annotations``) with whitespace and quote
+    characters stripped, so formatting churn can never read as drift."""
+    if not isinstance(ann, str):
+        ann = getattr(ann, "__name__", None) or repr(ann)
+    return ann.replace(" ", "").replace('"', "").replace("'", "")
+
+
+def _default_token(f: dataclasses.Field) -> str | None:
+    """Stable token for a field's default: None means REQUIRED (no
+    default — appends without one are a breaking schema change)."""
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        return f"factory:{getattr(f.default_factory, '__name__', '?')}"
+    if f.default is dataclasses.MISSING:
+        return None
+    v = f.default
+    if isinstance(v, enum.Enum):
+        return f"{type(v).__name__}.{v.name}"
+    return repr(v)
+
+
+def wire_schema_of(cls: type) -> dict:
+    """Canonical schema dict of one registered type, as committed in
+    ``wire_schema.lock.json``: positional field order, normalized type
+    strings, default presence, transient-underscore exclusions; enums
+    lock their member→value map (renumbering is wire drift too)."""
+    if isinstance(cls, type) and issubclass(cls, enum.Enum):
+        return {
+            "kind": "enum",
+            "module": cls.__module__,
+            "members": {m.name: int(m.value) for m in cls},
+        }
+    return {
+        "kind": "dataclass",
+        "module": cls.__module__,
+        "fields": [
+            {
+                "name": f.name,
+                "type": normalize_type_str(f.type),
+                "default": _default_token(f),
+            }
+            for f in _wire_fields(cls)
+        ],
+        "transient": [
+            f.name for f in dataclasses.fields(cls) if f.name.startswith("_")
+        ],
+    }

@@ -14,6 +14,7 @@ from dataclasses import dataclass
 
 from openr_tpu.common.constants import DEFAULT_AREA
 from openr_tpu.types.network import IpPrefix
+from openr_tpu.types.serde import register_wire_types
 
 
 class ForwardingType(enum.IntEnum):
@@ -122,3 +123,9 @@ class PrefixDatabase:
     prefix_entries: tuple[PrefixEntry, ...] = ()
     area: str = DEFAULT_AREA
     delete_prefix: bool = False  # per-prefix-key withdrawal marker
+
+
+# wire-schema lock registration: the adj:/prefix: KvStore key payloads
+register_wire_types(
+    Adjacency, AdjacencyDatabase, PrefixMetrics, PrefixEntry, PrefixDatabase
+)

@@ -1,0 +1,555 @@
+# orlint: disable-file=OR011 (lock JSON is a dev artifact, not wire)
+"""Wire-schema lock: extraction, drift classification, golden frames.
+
+The TLV codec's evolution contract (serde module docstring: append-only,
+trailing defaults, transient underscores) is load-bearing for live
+mixed-version interop AND for crash recovery — journals and snapshots
+persist the same frames. This module is the runtime half of the lock
+that makes the contract enforceable:
+
+  * :func:`extract_schema` renders the CURRENT source tree's schema —
+    every serde-registered dataclass/enum (``serde.register_wire_types``
+    closure) plus the RPC method/notification/stream name surface
+    scraped from ``rpc/``, ``ctrl/`` and ``kvstore/``.
+  * ``wire_schema.lock.json`` (next to this file) is the COMMITTED
+    schema. :func:`diff_schemas` classifies extracted-vs-lock drift as
+    breaking (reorder / removal / rename / retype / default change /
+    un-defaulted append / enum renumber / RPC removal) or benign
+    (defaulted trailing append, new type, new RPC name) — the legal /
+    illegal table in docs/Wire.md "Schema evolution".
+  * :func:`build_sample` / :func:`golden_frame` mint the deterministic
+    per-type fixture frames under ``tests/fixtures/wire/golden/`` that
+    turn the lock into an executable decode-forever contract, and the
+    raw-frame helpers below it power the schema-driven fuzzer
+    (tests/test_wire_schema.py) — mutations are derived from the lock's
+    own type strings, so a newly locked type is fuzzed for free.
+
+Consumers: ``tools/orlint/wireschema.py`` (CLI: check / write /
+goldens), orlint rule OR015 (lint-time breaking-drift findings),
+``breeze wire schema`` (operator dump+diff), ctrl ``get_wire_schema``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import hashlib
+import importlib
+import json
+import pathlib
+import re
+from typing import Any, get_args, get_origin
+
+from openr_tpu.types import serde
+
+LOCK_FILENAME = "wire_schema.lock.json"
+LOCK_PATH = pathlib.Path(__file__).resolve().parent / LOCK_FILENAME
+
+#: every module that registers wire types — imported before extraction
+#: so the registry is complete no matter who asks first
+WIRE_MODULES = (
+    "openr_tpu.types.network",
+    "openr_tpu.types.topology",
+    "openr_tpu.types.kvstore",  # also registers the monitor.perf trio
+    "openr_tpu.types.routes",
+    "openr_tpu.types.events",
+    "openr_tpu.spark.spark",
+    "openr_tpu.persist.journal",
+    "openr_tpu.prefixmgr.ranges",
+)
+
+#: files whose ``.register`` / ``.notify`` / ``.call`` literals define
+#: the RPC name surface (server registrations + peer-facing sends)
+RPC_SCAN_FILES = (
+    "rpc/core.py",
+    "ctrl/server.py",
+    "kvstore/kvstore.py",
+    "kvstore/transport.py",
+)
+
+_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.]*$")
+
+
+# ------------------------------------------------------------- extraction
+
+
+def extract_schema() -> dict:
+    """Schema of the source tree as currently importable: the lock's
+    ``types`` + ``rpc`` sections, freshly rendered."""
+    for mod in WIRE_MODULES:
+        importlib.import_module(mod)
+    return {
+        "types": {
+            name: serde.wire_schema_of(cls)
+            for name, cls in serde.registered_wire_types().items()
+        },
+        "rpc": extract_rpc_surface(),
+    }
+
+
+def extract_rpc_surface() -> dict:
+    """AST-scrape the RPC name surface: method names from ``register``
+    / ``call`` literals and the ctrl ``_register_all`` tuple, stream
+    names from ``register_stream``, notification names from ``notify``.
+    Renaming or dropping any of these strands a version-skewed peer the
+    same way a field reorder does, so they are locked alongside types."""
+    import openr_tpu
+
+    pkg = pathlib.Path(openr_tpu.__file__).resolve().parent
+    methods: set[str] = set()
+    notifications: set[str] = set()
+    streams: set[str] = set()
+
+    def lit(call: ast.Call) -> str | None:
+        if (
+            call.args
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)
+        ):
+            return call.args[0].value
+        return None
+
+    for rel in RPC_SCAN_FILES:
+        tree = ast.parse((pkg / rel).read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                name = lit(node)
+                if name is None:
+                    continue
+                if node.func.attr in ("register", "call"):
+                    methods.add(name)
+                elif node.func.attr == "notify":
+                    notifications.add(name)
+                elif node.func.attr == "register_stream":
+                    streams.add(name)
+            elif (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "_register_all"
+            ):
+                # ctrl registers through a name tuple + getattr; scoop
+                # every identifier-shaped string constant in the body
+                # (docstrings contain spaces and drop out)
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str)
+                        and _NAME_RE.match(sub.value)
+                    ):
+                        methods.add(sub.value)
+    methods -= streams
+    return {
+        "methods": sorted(methods),
+        "notifications": sorted(notifications),
+        "streams": sorted(streams),
+    }
+
+
+# ---------------------------------------------------------------- lock IO
+
+
+def load_lock(path: pathlib.Path | None = None) -> dict | None:
+    p = path or LOCK_PATH
+    try:
+        return json.loads(p.read_text())
+    except FileNotFoundError:
+        return None
+
+
+_VERSION_CACHE: list = []
+
+
+def locked_version() -> int | None:
+    """lock_version of the committed lock, read once per process —
+    cheap enough to stamp as a gauge on every Node construction and
+    print from ``breeze version``. None only when the lock is missing
+    (a source checkout mid-surgery)."""
+    if not _VERSION_CACHE:
+        lock = load_lock()
+        _VERSION_CACHE.append(
+            None if lock is None else lock["lock_version"]
+        )
+    return _VERSION_CACHE[0]
+
+
+def render_lock(extracted: dict, lock_version: int, changelog: list) -> str:
+    """Canonical lock text: sorted keys, 2-space indent, trailing
+    newline — byte-stable so ci.sh can literally ``diff`` it."""
+    doc = {
+        "lock_version": lock_version,
+        "changelog": changelog,
+        "types": extracted["types"],
+        "rpc": extracted["rpc"],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+# ---------------------------------------------------- drift classification
+
+
+@dataclasses.dataclass(frozen=True)
+class Drift:
+    """One extracted-vs-lock divergence. ``breaking`` is the OR015 /
+    bump-required verdict; benign drift only means the lock text is
+    stale (regenerate, no version bump)."""
+
+    kind: str
+    breaking: bool
+    subject: str  # "Value", "Value.ttl", "rpc:get_counters"
+    detail: str
+
+    def __str__(self) -> str:
+        sev = "BREAKING" if self.breaking else "benign"
+        return f"[{sev}] {self.kind}: {self.subject} — {self.detail}"
+
+
+def _diff_dataclass(name: str, lock_t: dict, ext_t: dict) -> list[Drift]:
+    out: list[Drift] = []
+    lf = lock_t.get("fields", [])
+    ef = ext_t.get("fields", [])
+    lnames = [f["name"] for f in lf]
+    enames = [f["name"] for f in ef]
+    if enames[: len(lnames)] == lnames:
+        # positional prefix intact: only type/default/append questions
+        for a, b in zip(lf, ef):
+            if a.get("type") != b.get("type"):
+                out.append(Drift(
+                    "field-retyped", True, f"{name}.{a['name']}",
+                    f"locked type {a.get('type')!r} is now "
+                    f"{b.get('type')!r}",
+                ))
+            if a.get("default") != b.get("default"):
+                out.append(Drift(
+                    "default-changed", True, f"{name}.{a['name']}",
+                    f"locked default {a.get('default')!r} is now "
+                    f"{b.get('default')!r} (old frames omitting the "
+                    f"field decode to a different value)",
+                ))
+        for b in ef[len(lnames):]:
+            if b.get("default") is None:
+                out.append(Drift(
+                    "append-no-default", True, f"{name}.{b['name']}",
+                    "appended field has no default — frames from "
+                    "locked-schema peers cannot decode",
+                ))
+            else:
+                out.append(Drift(
+                    "field-appended", False, f"{name}.{b['name']}",
+                    "legal defaulted trailing append — regenerate the "
+                    "lock (no version bump needed)",
+                ))
+    else:
+        eset = set(enames)
+        removed = [n for n in lnames if n not in eset]
+        for n in removed:
+            out.append(Drift(
+                "field-removed", True, f"{name}.{n}",
+                "locked wire field removed or renamed — every peer and "
+                "journal frame shifts positionally",
+            ))
+        if not removed:
+            out.append(Drift(
+                "field-reordered", True, name,
+                f"locked order {lnames} vs extracted "
+                f"{enames[: len(lnames)]} (positional codec: reorders "
+                f"and mid-inserts silently mis-decode old frames)",
+            ))
+    lt = lock_t.get("transient", [])
+    et = ext_t.get("transient", [])
+    if sorted(lt) != sorted(et):
+        out.append(Drift(
+            "transient-changed", False, name,
+            f"transient exclusions {lt} -> {et} (never on the wire; "
+            f"regenerate the lock)",
+        ))
+    return out
+
+
+def _diff_enum(name: str, lock_t: dict, ext_t: dict) -> list[Drift]:
+    out: list[Drift] = []
+    lm = lock_t.get("members", {})
+    em = ext_t.get("members", {})
+    for m, v in lm.items():
+        if m not in em:
+            out.append(Drift(
+                "enum-member-removed", True, f"{name}.{m}",
+                "locked enum member removed — its wire value decodes as "
+                "WireDecodeError on new nodes",
+            ))
+        elif em[m] != v:
+            out.append(Drift(
+                "enum-member-renumbered", True, f"{name}.{m}",
+                f"locked value {v} is now {em[m]} — old frames decode "
+                f"to the WRONG member",
+            ))
+    for m in em:
+        if m not in lm:
+            out.append(Drift(
+                "enum-member-added", False, f"{name}.{m}",
+                "new enum member (old peers reject its value as "
+                "WireDecodeError — legal; regenerate the lock)",
+            ))
+    return out
+
+
+def diff_schemas(lock_doc: dict, extracted: dict) -> list[Drift]:
+    """All divergences between a committed lock and a fresh extraction,
+    breaking and benign. An empty list means lock and source agree."""
+    out: list[Drift] = []
+    lock_types = lock_doc.get("types", {})
+    ext_types = extracted.get("types", {})
+    for name, lock_t in sorted(lock_types.items()):
+        ext_t = ext_types.get(name)
+        if ext_t is None:
+            out.append(Drift(
+                "type-removed", True, name,
+                "locked wire type no longer registered/reachable",
+            ))
+            continue
+        if lock_t.get("kind") != ext_t.get("kind"):
+            out.append(Drift(
+                "kind-changed", True, name,
+                f"{lock_t.get('kind')} became {ext_t.get('kind')}",
+            ))
+        elif lock_t.get("kind") == "enum":
+            out.extend(_diff_enum(name, lock_t, ext_t))
+        else:
+            out.extend(_diff_dataclass(name, lock_t, ext_t))
+        if lock_t.get("module") != ext_t.get("module"):
+            out.append(Drift(
+                "type-moved", False, name,
+                f"{lock_t.get('module')} -> {ext_t.get('module')} "
+                f"(modules never travel on the wire; regenerate)",
+            ))
+    for name in sorted(set(ext_types) - set(lock_types)):
+        out.append(Drift(
+            "type-added", False, name,
+            "serde-registered type missing from the lock — regenerate "
+            "(completeness: 100% of registered types must be locked)",
+        ))
+    lock_rpc = lock_doc.get("rpc", {})
+    ext_rpc = extracted.get("rpc", {})
+    for sect in ("methods", "notifications", "streams"):
+        ls, es = set(lock_rpc.get(sect, [])), set(ext_rpc.get(sect, []))
+        for n in sorted(ls - es):
+            out.append(Drift(
+                f"rpc-{sect[:-1]}-removed", True, f"rpc:{n}",
+                "locked RPC name no longer served/sent — version-skewed "
+                "peers calling it get method-not-found",
+            ))
+        for n in sorted(es - ls):
+            out.append(Drift(
+                f"rpc-{sect[:-1]}-added", False, f"rpc:{n}",
+                "new RPC name (legal — regenerate the lock)",
+            ))
+    return out
+
+
+def classify(drifts: list[Drift]) -> tuple[list[Drift], list[Drift]]:
+    """Split into (breaking, benign)."""
+    return (
+        [d for d in drifts if d.breaking],
+        [d for d in drifts if not d.breaking],
+    )
+
+
+# ------------------------------------------------- deterministic samples
+
+
+def _stable_int(path: str) -> int:
+    """Seedless determinism: content-addressed small ints (sha256, not
+    hash() — PYTHONHASHSEED must not leak into committed fixtures)."""
+    return int.from_bytes(
+        hashlib.sha256(path.encode()).digest()[:2], "big"
+    ) % 97 + 3
+
+
+def _sample_value(hint: Any, path: str) -> Any:
+    origin = get_origin(hint)
+    if origin is not None and origin not in (list, tuple, dict):
+        # Optional[X] / unions: exercise the first concrete arm
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if args:
+            return _sample_value(args[0], path)
+        return None
+    if hint is bool:
+        return True
+    if hint is int:
+        return _stable_int(path)
+    if hint is float:
+        return _stable_int(path) / 8.0  # /8: exact in binary, f8be-stable
+    if hint is str:
+        leaf = path.rsplit(".", 1)[-1]
+        return f"{leaf}-{_stable_int(path) % 10}"
+    if hint is bytes:
+        leaf = path.rsplit(".", 1)[-1]
+        return leaf.encode() + bytes([_stable_int(path) % 256])
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        return next(iter(hint))
+    if isinstance(hint, type) and dataclasses.is_dataclass(hint):
+        return build_sample(hint)
+    if origin in (list, tuple):
+        args = [a for a in get_args(hint) if a is not Ellipsis]
+        if origin is tuple and len(args) > 1:  # heterogeneous tuple
+            return tuple(
+                _sample_value(a, f"{path}.{i}") for i, a in enumerate(args)
+            )
+        inner = args[0] if args else None
+        if inner is None:
+            return () if origin is tuple else []
+        v = [_sample_value(inner, f"{path}.item")]
+        return tuple(v) if origin is tuple else v
+    if origin is dict:
+        args = get_args(hint)
+        if not args:
+            return {}
+        k, vt = args
+        return {
+            _sample_value(k, f"{path}.key"): _sample_value(vt, f"{path}.val")
+        }
+    if hint is list:
+        return []  # untyped list (e.g. RouteUpdate.perf_events): decodes
+        # generically, so goldens keep it empty for byte-stable roundtrips
+    if hint is dict:
+        return {}
+    return _stable_int(path)
+
+
+def build_sample(cls: type) -> Any:
+    """Deterministic, byte-stable-encoding instance of a locked type.
+    Optional fields are populated (exercise the payload, not the None
+    arm); types with construction invariants get canonical overrides."""
+    if cls.__name__ == "IpPrefix":
+        return cls(prefix="10.32.0.0/24")  # canonical: dict-key roundtrip
+    if cls.__name__ == "PrefixRange":
+        return cls(base="10.64.0.0", plen=24, count=2)  # aligned base
+    hints = serde._hints(cls)
+    kwargs = {
+        f.name: _sample_value(hints[f.name], f"{cls.__name__}.{f.name}")
+        for f in serde._wire_fields(cls)
+    }
+    return cls(**kwargs)
+
+
+def golden_frame(cls: type) -> bytes:
+    """The committed fixture frame for one locked dataclass type."""
+    return serde.to_wire_bin(build_sample(cls))
+
+
+# ----------------------------------------------- schema-driven mutations
+#
+# Raw-frame helpers for the fuzzer: operate on the lock's own field
+# counts / type strings, never on the dataclasses, so coverage follows
+# the lock automatically.
+
+_DC_TAG = 0x09  # serde._T_DC: positional dataclass frame
+
+
+def build_raw_frame(values: list) -> bytes:
+    """Hand-rolled top-level dataclass frame: header + DC tag + count +
+    generically-encoded field values (what a peer with a DIFFERENT
+    schema would send)."""
+    out = bytearray(serde._BIN_HEADER)
+    out.append(_DC_TAG)
+    serde._w_uvarint(out, len(values))
+    for v in values:
+        serde._bin_encode_any(v, out)
+    return bytes(out)
+
+
+def field_spans(frame: bytes) -> list[tuple[int, int]]:
+    """(start, end) byte span of each top-level field of a DC frame."""
+    if len(frame) < 3 or frame[2] != _DC_TAG:
+        raise ValueError("not a top-level dataclass frame")
+    n, pos = serde._r_uvarint(frame, 3)
+    spans = []
+    for _ in range(n):
+        end = serde._bin_skip(frame, pos)
+        spans.append((pos, end))
+        pos = end
+    return spans
+
+
+def append_unknown_field(frame: bytes, extra: Any) -> bytes:
+    """A newer peer's frame: same fields plus one appended unknown —
+    MUST decode (the forward-compat half of the contract)."""
+    if len(frame) < 3 or frame[2] != _DC_TAG:
+        raise ValueError("not a top-level dataclass frame")
+    n, pos = serde._r_uvarint(frame, 3)
+    out = bytearray(frame[:3])
+    serde._w_uvarint(out, n + 1)
+    out += frame[pos:]
+    serde._bin_encode_any(extra, out)
+    return bytes(out)
+
+
+def swap_fields(frame: bytes, i: int, j: int) -> bytes:
+    """Reordered-TLV mutation: exchange two field payloads in place."""
+    spans = field_spans(frame)
+    (a0, a1), (b0, b1) = sorted([spans[i], spans[j]])
+    return (
+        frame[:a0] + frame[b0:b1] + frame[a1:b0] + frame[a0:a1] + frame[b1:]
+    )
+
+
+def _split_top(s: str, sep: str) -> list[str]:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == sep and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+def sample_for_type_str(ts: str, registry: dict[str, type]) -> Any:
+    """A well-typed generic value for one lock type string — the fuzzer
+    builds whole frames from these without touching the dataclasses."""
+    arms = [a for a in _split_top(ts, "|") if a and a != "None"]
+    if not arms:
+        return None
+    ts = arms[0]
+    if ts.endswith("]"):
+        head, inner = ts.split("[", 1)
+        args = _split_top(inner[:-1], ",")
+        if head in ("list", "set", "frozenset"):
+            return [sample_for_type_str(args[0], registry)]
+        if head == "tuple":
+            args = [a for a in args if a != "..."]
+            return tuple(sample_for_type_str(a, registry) for a in args)
+        if head == "dict":
+            return {
+                sample_for_type_str(args[0], registry):
+                    sample_for_type_str(args[1], registry)
+            }
+        return [1]
+    prim = {
+        "int": 5, "str": "s", "bytes": b"s", "bool": True,
+        "float": 1.5, "list": [], "dict": {}, "Any": 1,
+    }
+    if ts in prim:
+        return prim[ts]
+    cls = registry.get(ts)
+    if cls is not None:
+        if issubclass(cls, enum.Enum):
+            return int(next(iter(cls)).value)
+        return build_sample(cls)
+    return 1
+
+
+def wrong_value_for_type_str(ts: str) -> Any:
+    """A value from a DIFFERENT TLV family than the locked type — the
+    field-type-swap mutation (a mis-evolved peer)."""
+    arms = [a for a in _split_top(ts, "|") if a and a != "None"]
+    head = (arms[0].split("[", 1)[0]) if arms else "None"
+    if head in ("int", "bool", "float"):
+        return "type-swapped"
+    return 20071  # strs/bytes/lists/dicts/dataclasses/enums get an int

@@ -7,7 +7,7 @@ OR006 determinism) apply; the engine's directory walker skips
 explicit argument (``python -m tools.orlint
 tests/fixtures/orlint/decision/known_bad.py``).
 
-EXPECTED: exactly one finding per rule, OR001..OR014 (asserted by
+EXPECTED: exactly one finding per rule, OR001..OR015 (asserted by
 tests/test_orlint.py::test_known_bad_fixture_covers_every_rule and the
 ci.sh smoke lane).
 """
@@ -73,3 +73,29 @@ def bad_callers(jobs):
     fixed = np.zeros(8, np.int32)
     # OR010: static k varies per call — one full recompile per job count
     return bad_kernel(jnp.asarray(fixed), jnp.int32(1), k=len(jobs))
+
+# ---- wire-schema lock (OR015) ---------------------------------------
+# The __wire_lock__ mini-lock freezes each dataclass's positional
+# contract; DriftedMsg reorders its locked fields (one breaking
+# finding), AppendedMsg grows a DEFAULTED trailing field — the legal
+# append-only evolution move, which must stay silent (the ci.sh smoke
+# lane asserts both directions).
+
+from dataclasses import dataclass
+
+__wire_lock__ = {
+    "DriftedMsg": {"fields": [["a", "int", None], ["b", "str", None]]},
+    "AppendedMsg": {"fields": [["x", "int", None]]},
+}
+
+
+@dataclass
+class DriftedMsg:  # OR015: wire fields reordered vs the locked order
+    b: str
+    a: int
+
+
+@dataclass
+class AppendedMsg:  # NOT flagged: defaulted trailing append is legal
+    x: int
+    extra: int = 0

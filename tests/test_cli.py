@@ -511,3 +511,42 @@ def test_persist_status_and_compact(persist_node, tmp_path):
     }
     assert int(kv["compactions"]) >= 1
     assert int(kv["journal_records"]) == 0
+
+
+def test_wire_schema_in_sync(live):
+    """`breeze wire schema` diffs the live node's extracted schema
+    against the local committed lock — a source checkout is always in
+    sync with itself."""
+    out = invoke(live, "a", "wire", "schema")
+    assert "node a: lock v" in out
+    assert "wire types" in out
+    assert "local lock: v" in out
+    assert "in sync" in out
+    assert "BREAKING" not in out
+
+
+def test_wire_schema_dump(live):
+    """--dump prints the node's full schema JSON: locked types and the
+    RPC name surface it actually serves."""
+    out = invoke(live, "a", "wire", "schema", "--dump")
+    doc = json.loads(out)
+    assert doc["types"]["Publication"]["kind"] == "dataclass"
+    assert "get_wire_schema" in doc["rpc"]["methods"]
+
+
+def test_version_reports_lock_version(live):
+    from openr_tpu.types.wirelock import locked_version
+
+    out = invoke(live, "a", "version")
+    assert f"wire schema lock: v{locked_version()}" in out
+
+
+def test_wire_schema_gauge_exported(live):
+    """Node construction stamps wire.schema_lock_version; visible over
+    the ordinary counters surface for fleet monitoring."""
+    from openr_tpu.types.wirelock import locked_version
+
+    out = invoke(live, "b", "monitor", "counters",
+                 "--prefix", "wire.")
+    assert "wire.schema_lock_version" in out
+    assert str(locked_version()) in out

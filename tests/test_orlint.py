@@ -3,7 +3,7 @@ and baseline mechanics, the known-bad smoke fixture, and the shipped
 baseline's zero-stale self-check.
 
 Deleting any rule module must fail this suite: the catalog test pins
-the full OR001..OR007 set, and each rule has a positive fixture that
+the full OR001..OR015 set, and each rule has a positive fixture that
 yields no findings without its module.
 """
 
@@ -25,6 +25,7 @@ KNOWN_BAD = "tests/fixtures/orlint/decision/known_bad.py"
 ALL_CODES = {
     "OR001", "OR002", "OR003", "OR004", "OR005", "OR006", "OR007",
     "OR008", "OR009", "OR010", "OR011", "OR012", "OR013", "OR014",
+    "OR015",
 }
 
 
@@ -736,6 +737,123 @@ def test_or014_raw_persistence_seam(tmp_path):
         select={"OR014"},
     )
     assert codes_of(kw_mode) == ["OR014"]
+
+
+def test_or015_breaking_drift_variants(tmp_path):
+    """Every breaking move against an embedded ``__wire_lock__`` trips:
+    reorder, removal, retype, default change, un-defaulted append, and
+    deleting a locked type outright."""
+    res = lint_snippet(
+        tmp_path,
+        """
+        from dataclasses import dataclass, field
+
+        __wire_lock__ = {
+            "Reordered": {"fields": [["a", "int", None],
+                                     ["b", "str", None]]},
+            "Removed": {"fields": [["a", "int", None],
+                                   ["b", "str", None]]},
+            "Retyped": {"fields": [["a", "int", None]]},
+            "Redefaulted": {"fields": [["a", "int", "1"]]},
+            "BareAppend": {"fields": [["a", "int", None]]},
+            "Deleted": {"fields": [["a", "int", None]]},
+        }
+
+        @dataclass
+        class Reordered:
+            b: str
+            a: int
+
+        @dataclass
+        class Removed:
+            a: int
+
+        @dataclass
+        class Retyped:
+            a: str
+
+        @dataclass
+        class Redefaulted:
+            a: int = 2
+
+        @dataclass
+        class BareAppend:
+            a: int
+            b: str  # appended WITHOUT a default: old frames underflow
+        """,
+        select={"OR015"},
+    )
+    kinds = sorted(f.fingerprint.split(":", 3)[3] for f in res.findings)
+    assert kinds == [
+        "append-no-default:BareAppend.b",
+        "default-changed:Redefaulted.a",
+        "field-removed:Removed.b",
+        "field-reordered:Reordered",
+        "field-retyped:Retyped.a",
+        "type-removed:Deleted",
+    ]
+
+
+def test_or015_legal_evolution_is_silent(tmp_path):
+    """The sanctioned moves stay clean: defaulted trailing append
+    (plain default AND default_factory), brand-new unlocked types,
+    transient-underscore additions, cosmetic type-string respelling."""
+    res = lint_snippet(
+        tmp_path,
+        """
+        from dataclasses import dataclass, field
+
+        __wire_lock__ = {
+            "Msg": {"fields": [["a", "int", None],
+                               ["b", "list[int]", "factory:list"]]},
+        }
+
+        @dataclass
+        class Msg:
+            a: int
+            b: list[int] = field(default_factory=list)
+            c: int = 0                    # defaulted trailing append
+            d: list = field(default_factory=list)  # factory append
+            _cache: dict | None = None    # transient: not on the wire
+
+        @dataclass
+        class Unlocked:                   # new type: lock is merely stale
+            x: int
+        """,
+        select={"OR015"},
+    )
+    assert codes_of(res) == []
+
+
+def test_or015_sandbox_without_lock_skips_finalize(tmp_path):
+    """A tree with no wire_schema.lock.json (every fixture sandbox)
+    must not run the repo-level extract-vs-lock finalize pass."""
+    res = lint_snippet(
+        tmp_path,
+        """
+        from dataclasses import dataclass
+
+        @dataclass
+        class Anything:
+            x: int
+        """,
+        select={"OR015"},
+    )
+    assert codes_of(res) == []
+
+
+def test_or015_repo_lock_matches_source():
+    """The committed lock is in sync with the source tree: the
+    finalize pass over the real repo yields no breaking findings (and
+    the ci.sh schema-lock lane separately fails on benign staleness)."""
+    from openr_tpu.types import wirelock
+
+    lock = wirelock.load_lock()
+    assert lock is not None
+    breaking, _ = wirelock.classify(
+        wirelock.diff_schemas(lock, wirelock.extract_schema())
+    )
+    assert breaking == [], "\n".join(str(d) for d in breaking)
 
 
 # ------------------------------------------- suppression + baseline plumbing

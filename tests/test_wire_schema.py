@@ -1,0 +1,327 @@
+"""Wire-schema lock acceptance: lock/source sync, golden-frame
+decode-forever, and the schema-driven fuzzer.
+
+Three layers of the docs/Wire.md "Schema evolution" contract:
+
+* the committed ``wire_schema.lock.json`` agrees with the source tree
+  byte-for-byte (no drift, benign included — ci.sh schema-lock lane)
+  and covers 100% of serde-registered types;
+* every committed golden frame under ``tests/fixtures/wire/golden/``
+  — one per locked dataclass per lock version — decodes FOREVER via
+  :func:`from_wire_auto`, and the current version's frames regenerate
+  byte-identically and roundtrip to the deterministic sample object;
+* the fuzzer derives its mutations (truncation, field-type swap,
+  appended-unknown-field, reordered-TLV) from the LOCK's own field
+  lists and type strings — never from the dataclasses — so a newly
+  locked type is fuzzed with zero new test code. The decode contract
+  under mutation: success or :class:`WireDecodeError`, nothing else,
+  on both the live wire path and the journal/snapshot replay path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import zlib
+
+import pytest
+
+from openr_tpu.persist.journal import (
+    JournalRecord,
+    encode_record,
+    replay_frames,
+)
+from openr_tpu.types import serde, wirelock
+from openr_tpu.types.serde import (
+    WireDecodeError,
+    from_wire_auto,
+    from_wire_bin,
+    write_uvarint,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+GOLDEN = REPO / "tests" / "fixtures" / "wire" / "golden"
+
+LOCK = wirelock.load_lock()
+EXTRACTED = wirelock.extract_schema()  # imports every WIRE_MODULES entry
+REGISTRY = serde.registered_wire_types()
+DC_NAMES = sorted(
+    n for n, t in LOCK["types"].items() if t["kind"] == "dataclass"
+)
+ENUM_NAMES = sorted(
+    n for n, t in LOCK["types"].items() if t["kind"] == "enum"
+)
+CURRENT = GOLDEN / f"v{LOCK['lock_version']}"
+
+
+def _golden_bytes(name: str) -> bytes:
+    return (CURRENT / f"{name}.bin").read_bytes()
+
+
+def _decode_or_wire_error(frame: bytes, cls: type):
+    """The fuzz contract: a mutated frame either decodes or raises
+    WireDecodeError — any other exception propagates and fails."""
+    try:
+        return from_wire_bin(frame, cls)
+    except WireDecodeError:
+        return None
+
+
+def _lock_sample_values(name: str) -> list:
+    """Well-typed field values minted from the LOCK's type strings."""
+    return [
+        wirelock.sample_for_type_str(f["type"], REGISTRY)
+        for f in LOCK["types"][name]["fields"]
+    ]
+
+
+def _journal_wrap(payload: bytes) -> bytes:
+    """CRC-valid journal framing around an arbitrary payload."""
+    out = bytearray()
+    write_uvarint(out, len(payload))
+    out += payload
+    out += (zlib.crc32(payload) & 0xFFFFFFFF).to_bytes(4, "little")
+    return bytes(out)
+
+
+# ------------------------------------------------------- lock <-> source
+
+
+def test_lock_exists_and_matches_source_exactly():
+    """Zero drift of ANY kind: breaking drift is an OR015 finding,
+    benign drift means a stale committed lock — both fail CI."""
+    assert LOCK is not None, "wire_schema.lock.json missing"
+    drifts = wirelock.diff_schemas(LOCK, EXTRACTED)
+    assert drifts == [], "\n".join(str(d) for d in drifts)
+
+
+def test_lock_covers_every_registered_type():
+    """Completeness: 100% of serde-registered types (closure included)
+    are locked, and nothing locked has vanished from the registry."""
+    assert set(LOCK["types"]) == set(REGISTRY)
+    assert len(REGISTRY) >= 30  # the seed surface never silently shrinks
+
+
+def test_lock_text_regenerates_byte_identically():
+    committed = (wirelock.LOCK_PATH).read_text()
+    once = wirelock.render_lock(
+        EXTRACTED, LOCK["lock_version"], LOCK["changelog"]
+    )
+    twice = wirelock.render_lock(
+        EXTRACTED, LOCK["lock_version"], LOCK["changelog"]
+    )
+    assert once == twice == committed
+
+
+def test_lock_changelog_discipline():
+    """Every note is non-empty, every version 1..current has at least
+    one entry (a bump never lands without its justification), and the
+    log is append-only ordered — benign regenerations may add extra
+    same-version "auto:" notes."""
+    versions = [e["version"] for e in LOCK["changelog"]]
+    assert versions == sorted(versions)
+    assert sorted(set(versions)) == list(range(1, LOCK["lock_version"] + 1))
+    assert all(e["note"].strip() for e in LOCK["changelog"])
+
+
+def test_rpc_surface_locked():
+    """The live ctrl/rpc name surface is part of the lock."""
+    rpc = LOCK["rpc"]
+    assert "get_my_node_name" in rpc["methods"]
+    assert "subscribe_kvstore" in rpc["streams"]
+    assert not set(rpc["streams"]) & set(rpc["methods"])
+
+
+# ------------------------------------------------------- golden corpus
+
+
+def test_golden_corpus_complete_for_current_lock():
+    """One committed frame per locked dataclass type, plus a manifest
+    whose hashes match the bytes on disk."""
+    assert CURRENT.is_dir(), f"no golden dir for v{LOCK['lock_version']}"
+    names = sorted(p.stem for p in CURRENT.glob("*.bin"))
+    assert names == DC_NAMES
+    manifest = json.loads((CURRENT / "MANIFEST.json").read_text())
+    assert manifest["lock_version"] == LOCK["lock_version"]
+    for name in DC_NAMES:
+        digest = hashlib.sha256(_golden_bytes(name)).hexdigest()
+        assert manifest["sha256"][name] == digest, name
+
+
+def _all_golden_frames() -> list:
+    out = []
+    for vdir in sorted(GOLDEN.glob("v*")):
+        for p in sorted(vdir.glob("*.bin")):
+            out.append(pytest.param(p, id=f"{vdir.name}/{p.stem}"))
+    return out
+
+
+@pytest.mark.parametrize("path", _all_golden_frames())
+def test_golden_decodes_forever(path):
+    """EVERY committed golden — current and all prior lock versions —
+    must decode via from_wire_auto for as long as the type exists.
+    This is the executable form of the append-only promise: a frame,
+    once written (to a peer or a journal), is never orphaned."""
+    cls = REGISTRY.get(path.stem)
+    assert cls is not None, (
+        f"golden {path} exists for unregistered type {path.stem} — "
+        f"removing a locked type orphans its historical frames"
+    )
+    obj = from_wire_auto(path.read_bytes(), cls)
+    assert isinstance(obj, cls)
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_golden_current_version_roundtrips(name):
+    """Current-version goldens additionally roundtrip byte-exactly and
+    reproduce the deterministic sample object."""
+    cls = REGISTRY[name]
+    frame = _golden_bytes(name)
+    obj = from_wire_auto(frame, cls)
+    assert serde.to_wire_bin(obj) == frame
+    assert obj == wirelock.build_sample(cls)
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_golden_regeneration_is_byte_stable(name):
+    """golden_frame() is a pure function of the source tree: two mints
+    agree with each other and with the committed bytes (PYTHONHASHSEED
+    and dict order must not leak into fixtures)."""
+    a = wirelock.golden_frame(REGISTRY[name])
+    b = wirelock.golden_frame(REGISTRY[name])
+    assert a == b == _golden_bytes(name)
+
+
+# ------------------------------------------------- schema-driven fuzzer
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_fuzz_truncation(name):
+    """Every proper prefix of every golden frame decodes or raises
+    WireDecodeError — no IndexError/struct.error/KeyError ever escapes
+    a torn read."""
+    cls = REGISTRY[name]
+    frame = _golden_bytes(name)
+    for cut in range(len(frame)):
+        _decode_or_wire_error(frame[:cut], cls)
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_fuzz_field_type_swap(name):
+    """A mis-evolved peer: each field in turn carries a value from a
+    DIFFERENT TLV family (types and wrong-values both minted from the
+    lock's type strings). Decode must fail typed, or succeed — never
+    crash, never mis-file silently into a non-WireDecodeError."""
+    cls = REGISTRY[name]
+    fields = LOCK["types"][name]["fields"]
+    base = _lock_sample_values(name)
+    for i, f in enumerate(fields):
+        values = list(base)
+        values[i] = wirelock.wrong_value_for_type_str(f["type"])
+        frame = wirelock.build_raw_frame(values)
+        _decode_or_wire_error(frame, cls)
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_fuzz_appended_unknown_field(name):
+    """A NEWER peer's frame — same fields plus unknown trailing ones —
+    MUST decode to the same object (the forward-compat half; this is
+    what makes the defaulted-append evolution move legal at all)."""
+    cls = REGISTRY[name]
+    frame = _golden_bytes(name)
+    want = from_wire_auto(frame, cls)
+    for extra in (7, "future", b"\x00\x01", [1, 2], {"new_field": 1}):
+        mutated = wirelock.append_unknown_field(frame, extra)
+        assert from_wire_auto(mutated, cls) == want, (name, extra)
+    # two appended unknowns skip just as cleanly as one
+    twice = wirelock.append_unknown_field(
+        wirelock.append_unknown_field(frame, 1), {"k": [2]}
+    )
+    assert from_wire_auto(twice, cls) == want
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_fuzz_reordered_tlv(name):
+    """Field payloads exchanged in place (the reorder OR015 exists to
+    prevent): decode is success-or-WireDecodeError, never a crash."""
+    cls = REGISTRY[name]
+    frame = _golden_bytes(name)
+    spans = wirelock.field_spans(frame)
+    n = len(spans)
+    pairs = [(i, i + 1) for i in range(n - 1)] + ([(0, n - 1)] if n > 1
+                                                  else [])
+    for i, j in pairs:
+        _decode_or_wire_error(wirelock.swap_fields(frame, i, j), cls)
+
+
+def _enum_fields() -> list:
+    out = []
+    for name in DC_NAMES:
+        for i, f in enumerate(LOCK["types"][name]["fields"]):
+            head = f["type"].split("|", 1)[0]
+            if head in ENUM_NAMES:
+                out.append(pytest.param(
+                    name, i, head, id=f"{name}.{f['name']}"
+                ))
+    return out
+
+
+def test_every_locked_enum_rides_some_dataclass_field():
+    """The enum fuzz arm below covers every locked enum (otherwise a
+    locked enum would be dead weight nothing exercises)."""
+    covered = {p.values[2] for p in _enum_fields()}
+    assert covered == set(ENUM_NAMES)
+
+
+@pytest.mark.parametrize("name,idx,ename", _enum_fields())
+def test_fuzz_unknown_enum_value(name, idx, ename):
+    """An enum value minted by a NEWER schema (member we don't have)
+    must fail typed at the boundary — decoding it to a wrong member
+    would corrupt routing decisions silently."""
+    cls = REGISTRY[name]
+    values = _lock_sample_values(name)
+    known = set(LOCK["types"][ename]["members"].values())
+    values[idx] = max(known) + 17
+    frame = wirelock.build_raw_frame(values)
+    with pytest.raises(WireDecodeError):
+        from_wire_bin(frame, cls)
+
+
+# ------------------------------------------------- journal/persist arm
+
+
+@pytest.mark.parametrize("name", DC_NAMES)
+def test_fuzz_journal_payloads(name):
+    """The SAME mutation corpus pushed through the persist plane's
+    framing (uvarint | payload | crc32): replay_frames in strict mode
+    (the snapshot path — no torn-tail salvage) must yield records or
+    WireDecodeError, nothing else. This is the crash-recovery face of
+    the schema lock: a journal is a conversation with your own past."""
+    frame = _golden_bytes(name)
+    spans = wirelock.field_spans(frame)
+    mutations = [
+        frame,                                   # wrong record type
+        frame[: len(frame) // 2],                # truncated payload
+        wirelock.append_unknown_field(frame, 3),
+    ]
+    if len(spans) > 1:
+        mutations.append(wirelock.swap_fields(frame, 0, len(spans) - 1))
+    for payload in mutations:
+        try:
+            replay_frames(_journal_wrap(payload), strict=True)
+        except WireDecodeError:
+            pass
+
+
+def test_journal_crc_and_record_roundtrip():
+    """Anchors the arm above: a real record replays; one flipped bit
+    in a CRC-valid-length stream is caught as WireDecodeError."""
+    rec = JournalRecord(book="adj", op=0, key=b"k", value=b"v")
+    good = encode_record(rec)
+    recs, truncated = replay_frames(good, strict=True)
+    assert recs == [rec] and truncated == 0
+    flipped = bytearray(good)
+    flipped[len(flipped) // 2] ^= 0x40
+    with pytest.raises(WireDecodeError):
+        replay_frames(bytes(flipped), strict=True)

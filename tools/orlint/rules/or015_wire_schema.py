@@ -1,0 +1,182 @@
+"""OR015: wire-schema drift against the committed lock.
+
+The binary codec is positional: a reordered, removed, renamed, retyped
+or default-changed field in a serde-registered dataclass silently
+mis-decodes every old peer's frame AND every journal/snapshot written
+before the edit — the two places PR 8/PR 19 made the append-only
+contract load-bearing. ``openr_tpu/types/wire_schema.lock.json`` pins
+the contract; this rule diffs the schema extracted from source against
+it at lint time (extraction + classification:
+``openr_tpu.types.wirelock``; policy: docs/Wire.md "Schema evolution").
+
+Legal without a finding: trailing appends WITH defaults, new types,
+new RPC names, transient-underscore additions — benign drift that only
+means the lock text is stale (the ci.sh schema-lock lane catches that
+via ``wireschema --check``). Everything else is a hard finding until
+the lock version is bumped with a written migration justification
+(``python -m tools.orlint.wireschema --write --bump --justification
+"..."`` — the same mandatory-justification discipline as the PR 5
+baseline).
+
+Self-test seam: a module that assigns a literal ``__wire_lock__``
+mini-lock (``{"Type": {"fields": [[name, type, default], ...]}}``) has
+its OWN dataclasses AST-diffed against it — both sides of that compare
+are rendered by the same AST walker, so the fixture check can never
+drift from the runtime renderer. The known-bad fixture uses this to
+prove the rule trips on a reorder and stays silent on a defaulted
+trailing append.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable
+
+from tools.orlint import Finding, ModuleCtx, Rule
+from tools.orlint.astutil import dotted_name
+
+LOCK_REL = "openr_tpu/types/wire_schema.lock.json"
+
+
+def _norm(ts: str) -> str:
+    return ts.replace(" ", "").replace('"', "").replace("'", "")
+
+
+def _ast_default_token(node: ast.expr | None) -> str | None:
+    """AST rendering of a field default, same token vocabulary as the
+    runtime extractor: None = required, ``factory:<name>`` for
+    default_factory, repr-ish source text otherwise."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        if dn in ("field", "dataclasses.field"):
+            for kw in node.keywords:
+                if kw.arg == "default_factory":
+                    return f"factory:{ast.unparse(kw.value)}"
+                if kw.arg == "default":
+                    return ast.unparse(kw.value)
+            return "factory:?"
+    return ast.unparse(node)
+
+
+def _ast_dataclass_schema(node: ast.ClassDef) -> dict:
+    """Schema dict of one AST dataclass, shaped like the lock's."""
+    fields: list[dict] = []
+    transient: list[str] = []
+    for stmt in node.body:
+        if not (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+        ):
+            continue
+        name = stmt.target.id
+        if name.startswith("_"):
+            transient.append(name)
+            continue
+        fields.append({
+            "name": name,
+            "type": _norm(ast.unparse(stmt.annotation)),
+            "default": _ast_default_token(stmt.value),
+        })
+    return {"kind": "dataclass", "fields": fields, "transient": transient}
+
+
+def _is_dataclass_def(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if dotted_name(target) in ("dataclass", "dataclasses.dataclass"):
+            return True
+    return False
+
+
+def _embedded_lock(tree: ast.Module) -> dict | None:
+    """The module-level ``__wire_lock__`` literal, if present."""
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "__wire_lock__"
+        ):
+            try:
+                return ast.literal_eval(stmt.value)
+            except ValueError:
+                return None
+    return None
+
+
+class WireSchemaDriftRule(Rule):
+    code = "OR015"
+    name = "wire-schema-drift"
+    description = (
+        "breaking wire/persist schema change vs wire_schema.lock.json"
+    )
+
+    def check(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        mini = _embedded_lock(ctx.tree)
+        if mini is None:
+            return
+        from openr_tpu.types import wirelock
+
+        classes = {
+            n.name: n
+            for n in ctx.tree.body
+            if isinstance(n, ast.ClassDef) and _is_dataclass_def(n)
+        }
+        for tname, spec in sorted(mini.items()):
+            node = classes.get(tname)
+            if node is None:
+                yield self.finding(
+                    ctx,
+                    None,
+                    f"{tname} is in __wire_lock__ but not defined here "
+                    f"(locked wire type removed)",
+                    subject=f"type-removed:{tname}",
+                )
+                continue
+            lock_t = {
+                "kind": "dataclass",
+                "fields": [
+                    {"name": f[0], "type": _norm(f[1]), "default": f[2]}
+                    for f in spec.get("fields", [])
+                ],
+                "transient": spec.get("transient", []),
+            }
+            ext_t = _ast_dataclass_schema(node)
+            if not lock_t["transient"]:
+                ext_t["transient"] = []  # mini-locks may omit transients
+            for d in wirelock._diff_dataclass(tname, lock_t, ext_t):
+                if not d.breaking:
+                    continue  # defaulted trailing appends etc. are legal
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{d.kind}: {d.subject} — {d.detail} (bump the lock "
+                    f"with a migration justification: docs/Wire.md)",
+                    scope=tname,
+                    subject=f"{d.kind}:{d.subject}",
+                )
+
+    def finalize(self, ctxs, root: str) -> Iterable[Finding]:
+        lock_path = pathlib.Path(root) / LOCK_REL
+        if not lock_path.exists():
+            # fixture sandboxes carry no lock; the real tree always does
+            return
+        from openr_tpu.types import wirelock
+
+        lock = wirelock.load_lock(lock_path)
+        breaking, _benign = wirelock.classify(
+            wirelock.diff_schemas(lock, wirelock.extract_schema())
+        )
+        for d in breaking:
+            yield self.finding(
+                None,
+                None,
+                f"{d.kind}: {d.subject} — {d.detail} (regenerating the "
+                f"lock over this requires --bump --justification: "
+                f"docs/Wire.md)",
+                subject=f"{d.kind}:{d.subject}",
+                path=LOCK_REL,
+            )

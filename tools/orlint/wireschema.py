@@ -1,0 +1,213 @@
+"""Wire-schema lock tool: check, (re)generate, and mint golden frames.
+
+The committed artifact is ``openr_tpu/types/wire_schema.lock.json`` —
+the canonical schema of every serde-registered wire/persist type plus
+the RPC name surface (extraction + drift semantics live in
+``openr_tpu.types.wirelock``; policy in docs/Wire.md "Schema
+evolution").
+
+Modes::
+
+    python -m tools.orlint.wireschema --check
+        Extract the schema from source and diff against the committed
+        lock. Exits 1 on ANY drift — benign drift means the lock text
+        is stale (run --write), breaking drift means the change needs a
+        version bump with a written migration justification (the PR 5
+        baseline discipline). Also verifies the current lock version's
+        golden corpus is complete and byte-identical to regeneration.
+
+    python -m tools.orlint.wireschema --write
+        Regenerate the lock from source. Benign drift (defaulted
+        trailing appends, new types, new RPC names) is auto-described
+        in the changelog under the SAME lock version. Breaking drift is
+        REFUSED (exit 2) unless ``--bump --justification "..."`` spells
+        out the migration story; the justification is committed in the
+        lock's changelog.
+
+    python -m tools.orlint.wireschema --write-golden
+        Mint the golden-frame corpus for the current lock version under
+        tests/fixtures/wire/golden/v<N>/ (one deterministic frame per
+        locked dataclass type + MANIFEST.json). Frames from PREVIOUS
+        versions are never rewritten — they are the decode-forever
+        contract.
+
+    python -m tools.orlint.wireschema --dump
+        Print the freshly extracted schema JSON (no lock comparison).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+GOLDEN_DIR = REPO_ROOT / "tests" / "fixtures" / "wire" / "golden"
+
+
+def _golden_expected(wirelock, lock: dict) -> dict[str, bytes]:
+    """name -> frame for every locked dataclass type, freshly minted."""
+    import importlib
+
+    for m in wirelock.WIRE_MODULES:
+        importlib.import_module(m)
+    from openr_tpu.types import serde
+
+    reg = serde.registered_wire_types()
+    out = {}
+    for name, t in sorted(lock.get("types", {}).items()):
+        if t.get("kind") != "dataclass":
+            continue
+        cls = reg.get(name)
+        if cls is None:
+            continue  # reported as type-removed drift by the diff
+        out[name] = wirelock.golden_frame(cls)
+    return out
+
+
+def check(wirelock) -> int:
+    lock = wirelock.load_lock()
+    if lock is None:
+        print(f"FAIL: {wirelock.LOCK_PATH} missing — run --write")
+        return 1
+    drifts = wirelock.diff_schemas(lock, wirelock.extract_schema())
+    breaking, benign = wirelock.classify(drifts)
+    for d in breaking + benign:
+        print(d)
+    rc = 0
+    if breaking:
+        print(
+            f"FAIL: {len(breaking)} breaking schema change(s) vs lock "
+            f"v{lock.get('lock_version')} — a reorder/removal/retype/"
+            f"default-change needs --write --bump --justification "
+            f"'<migration story>' (docs/Wire.md)"
+        )
+        rc = 1
+    if benign:
+        print(
+            f"FAIL: lock is stale ({len(benign)} legal change(s) not "
+            f"yet locked) — run --write and commit the result"
+        )
+        rc = 1
+    # golden corpus completeness for the CURRENT version: one frame per
+    # locked dataclass type, byte-identical to deterministic regeneration
+    ver = lock.get("lock_version")
+    vdir = GOLDEN_DIR / f"v{ver}"
+    for name, frame in _golden_expected(wirelock, lock).items():
+        p = vdir / f"{name}.bin"
+        if not p.exists():
+            print(f"FAIL: golden frame missing: {p} — run --write-golden")
+            rc = 1
+        elif p.read_bytes() != frame:
+            print(
+                f"FAIL: golden frame {p} differs from regeneration — "
+                f"generator drift (goldens are append-only per version)"
+            )
+            rc = 1
+    if rc == 0:
+        n = len(lock.get("types", {}))
+        print(
+            f"ok: wire schema in sync with lock v{ver} "
+            f"({n} types, golden corpus complete)"
+        )
+    return rc
+
+
+def write_lock(wirelock, bump: bool, justification: str | None) -> int:
+    extracted = wirelock.extract_schema()
+    lock = wirelock.load_lock()
+    if lock is None:
+        version = 1
+        changelog = [
+            {"version": 1, "note": "initial wire/persist schema lock"}
+        ]
+    else:
+        drifts = wirelock.diff_schemas(lock, extracted)
+        breaking, benign = wirelock.classify(drifts)
+        version = int(lock.get("lock_version", 1))
+        changelog = list(lock.get("changelog", []))
+        if breaking and not bump:
+            for d in breaking:
+                print(d)
+            print(
+                "REFUSED: breaking schema drift — rewriting the lock "
+                "over it requires --bump --justification '<why every "
+                "old frame/journal still decodes or how it migrates>'"
+            )
+            return 2
+        if bump:
+            if not justification:
+                print("REFUSED: --bump requires --justification")
+                return 2
+            version += 1
+            changelog.append({"version": version, "note": justification})
+        elif benign:
+            changelog.append({
+                "version": version,
+                "note": "auto: " + "; ".join(
+                    f"{d.kind} {d.subject}" for d in benign
+                ),
+            })
+        elif not drifts:
+            print(f"lock already current (v{version})")
+            return 0
+    text = wirelock.render_lock(extracted, version, changelog)
+    wirelock.LOCK_PATH.write_text(text)
+    print(f"wrote {wirelock.LOCK_PATH} (v{version})")
+    return 0
+
+
+def write_golden(wirelock) -> int:
+    lock = wirelock.load_lock()
+    if lock is None:
+        print("FAIL: no lock — run --write first")
+        return 1
+    ver = lock.get("lock_version")
+    vdir = GOLDEN_DIR / f"v{ver}"
+    vdir.mkdir(parents=True, exist_ok=True)
+    manifest: dict[str, str] = {}
+    for name, frame in _golden_expected(wirelock, lock).items():
+        (vdir / f"{name}.bin").write_bytes(frame)
+        manifest[name] = hashlib.sha256(frame).hexdigest()
+    (vdir / "MANIFEST.json").write_text(
+        json.dumps(
+            {"lock_version": ver, "sha256": manifest},
+            indent=2,
+            sort_keys=True,
+        )
+        + "\n"
+    )
+    print(f"wrote {len(manifest)} golden frames under {vdir}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true")
+    mode.add_argument("--write", action="store_true")
+    mode.add_argument("--write-golden", action="store_true")
+    mode.add_argument("--dump", action="store_true")
+    ap.add_argument("--bump", action="store_true",
+                    help="with --write: bump the lock version")
+    ap.add_argument("--justification",
+                    help="with --bump: committed migration justification")
+    args = ap.parse_args(argv)
+
+    from openr_tpu.types import wirelock
+
+    if args.write:
+        return write_lock(wirelock, args.bump, args.justification)
+    if args.write_golden:
+        return write_golden(wirelock)
+    if args.dump:
+        print(json.dumps(wirelock.extract_schema(), indent=2,
+                         sort_keys=True))
+        return 0
+    return check(wirelock)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
